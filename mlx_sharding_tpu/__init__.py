@@ -1,0 +1,13 @@
+"""mlx_sharding_tpu — a TPU-native pipeline-sharded LLM serving framework.
+
+A ground-up JAX/XLA re-design of the capability set of mzbac/mlx_sharding
+(pipeline-parallel LLM inference with an OpenAI-compatible front end):
+stages are pjit/shard_map programs on a TPU mesh, inter-stage hand-off is a
+compiled collective over ICI, and the KV cache is a functional HBM-resident
+pytree — no RPC, no Python serialization inside the token loop.
+"""
+
+__version__ = "0.1.0"
+
+from mlx_sharding_tpu.config import config_from_dict  # noqa: F401
+from mlx_sharding_tpu.models import build_model, get_model_class  # noqa: F401

@@ -1,0 +1,105 @@
+"""Functional, preallocated KV cache.
+
+TPU-native replacement for the reference's growable per-layer ``KVCache``
+objects (ref: shard/server/server.py:9-10,22; shard/utils.py:142-150). The
+reference mutates a Python-global list of caches per RPC; on TPU that would
+force re-compilation and host round-trips, so instead the cache is a pytree of
+fixed-capacity HBM buffers carried through the jitted step function and
+updated with ``lax.dynamic_update_slice`` — donated each step so XLA updates
+in place.
+
+Layout: keys/values are stacked across the stage's local layers:
+    k, v : (num_layers, batch, max_seq, n_kv_heads, head_dim)
+plus a scalar ``offset`` (the reference's ``KVCache.offset``, used for the
+causal-mask shift at shard/server/model/llama.py:48-53).
+
+MLA models cache differently-shaped tensors (tuple head dims,
+ref: shard/server/model/deepseek_v2.py:120-125); they use the same structure
+with their own head dims per tensor.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # (L, B, S, H_kv, D_k)
+    v: jax.Array  # (L, B, S, H_kv, D_v)
+    offset: jax.Array  # scalar int32 — number of valid positions
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+
+def init_cache(
+    num_layers: int,
+    batch: int,
+    max_seq: int,
+    n_kv_heads: int,
+    head_dim,
+    dtype=jnp.bfloat16,
+) -> KVCache:
+    """Allocate an empty cache. ``head_dim`` may be an int or a
+    ``(k_dim, v_dim)`` tuple for MLA (ref: deepseek_v2.py:120-125)."""
+    if isinstance(head_dim, (tuple, list)):
+        k_dim, v_dim = head_dim
+    else:
+        k_dim = v_dim = head_dim
+    return KVCache(
+        k=jnp.zeros((num_layers, batch, max_seq, n_kv_heads, k_dim), dtype),
+        v=jnp.zeros((num_layers, batch, max_seq, n_kv_heads, v_dim), dtype),
+        offset=jnp.zeros((), jnp.int32),
+    )
+
+
+def write_layer_kv(
+    k_buf: jax.Array,
+    v_buf: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    offset: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Write ``k_new``/``v_new`` (B, T, H_kv, D) into one layer's
+    full-capacity buffers (B, S, H_kv, D) at position ``offset``.
+
+    Used inside the per-layer body of the ``lax.scan`` over stacked layers:
+    the scan consumes ``cache.k``/``cache.v`` as per-layer xs and re-stacks
+    the returned buffers as ys, so no dynamic indexing on the layer axis is
+    ever needed. The shared ``offset`` counter is advanced once per step by
+    :func:`advance` (as in the reference, every layer's cache grows in
+    lockstep)."""
+    zero = jnp.zeros((), jnp.int32)
+    k = jax.lax.dynamic_update_slice(k_buf, k_new.astype(k_buf.dtype), (zero, offset, zero, zero))
+    v = jax.lax.dynamic_update_slice(v_buf, v_new.astype(v_buf.dtype), (zero, offset, zero, zero))
+    return k, v
+
+
+def advance(cache: KVCache, n_tokens) -> KVCache:
+    return cache._replace(offset=cache.offset + jnp.asarray(n_tokens, jnp.int32))
+
+
+def check_capacity(cache: KVCache, n_new: int) -> None:
+    """Host-side guard: ``dynamic_update_slice`` clamps out-of-range starts,
+    which would silently overwrite valid entries rather than error. Call this
+    outside jit (the generate loop does) before writing ``n_new`` tokens."""
+    offset = int(cache.offset)
+    if offset + n_new > cache.max_seq:
+        raise ValueError(
+            f"KV cache overflow: offset {offset} + {n_new} new tokens exceeds "
+            f"capacity {cache.max_seq}. Allocate a larger max_seq."
+        )
+
+
+def reset(cache: KVCache) -> KVCache:
+    """Equivalent of the reference's ResetCache RPC (shard/server/server.py:59-71):
+    invalidate without reallocating."""
+    return cache._replace(offset=jnp.zeros((), jnp.int32))

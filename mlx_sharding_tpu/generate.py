@@ -1,0 +1,219 @@
+"""Autoregressive generation driver.
+
+Replaces the reference's decode loops (``generate_step`` ref: generate.py:52-88
+and ``create_generate_step_with_grpc`` ref: shard/utils.py:111-188) with a
+TPU-shaped design:
+
+- **Two compiled shapes, ever.** Prefill runs in fixed-size chunks (right-
+  padded final chunk) and decode at T=1, so nothing recompiles on prompt
+  length. Pad-position K/V entries are always overwritten before any valid
+  query can attend them (writes are contiguous and each step writes before it
+  reads), so padding needs no masking beyond the causal rule.
+- **Sampling is fused into the decode program** (temperature / top-p /
+  repetition-penalty as dynamic scalars) so the only host transfer per token
+  is the sampled id — the reference instead pays Python serde per stage per
+  token (SURVEY §3.5).
+- **One-token lookahead**: step N+1 is dispatched before step N's token is
+  read on host, the same overlap the reference gets from ``mx.async_eval``
+  (ref: shard/utils.py:180-186) — with JAX's async dispatch it falls out
+  naturally.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_sharding_tpu.cache import KVCache, check_capacity, reset
+from mlx_sharding_tpu.sample import (
+    SamplerParams,
+    init_recent_tokens,
+    make_sampler_params,
+    sample_token,
+    update_recent_tokens,
+)
+
+DEFAULT_PREFILL_CHUNK = 256
+REPETITION_WINDOW = 20  # reference default repetition_context_size (openai_api.py)
+
+
+@dataclass
+class StreamChunk:
+    text: str = ""
+    token: Optional[int] = None
+    logprobs: Optional[np.ndarray] = None
+    finish_reason: Optional[str] = None
+    # set on the final chunk, matching the reference's instrumentation
+    # (generate.py:97-122): prompt/gen tok/s and TTFT
+    prompt_tokens: int = 0
+    generation_tokens: int = 0
+    prompt_tps: float = 0.0
+    generation_tps: float = 0.0
+    ttft: float = 0.0
+
+
+class Generator:
+    """Owns the jitted step programs for one (model, params) pair.
+
+    The same object serves many requests (the API server holds one, like the
+    reference's ModelProvider, ref: shard/openai_api.py:70-127); per-request
+    state (cache, recent-token window, PRNG key) is created per call.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_seq: int = 4096,
+        batch: int = 1,
+        cache_dtype=jnp.bfloat16,
+        prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
+    ):
+        self.model = model
+        self.params = params
+        self.max_seq = max_seq
+        self.batch = batch
+        self.cache_dtype = cache_dtype
+        self.prefill_chunk = prefill_chunk
+
+        def prefill_fn(params, tokens, cache, n_valid):
+            out, cache = model(params, tokens, cache, n_valid=n_valid)
+            last = jax.lax.dynamic_index_in_dim(out, n_valid - 1, axis=1)
+            return last[:, 0], cache  # (B, V) logits (or hidden mid-pipeline)
+
+        def decode_fn(params, token, cache, recent, key, sp):
+            logits, cache = model(params, token, cache)
+            key, sub = jax.random.split(key)
+            tok, logprobs = sample_token(sub, logits[:, -1], sp, recent)
+            recent = update_recent_tokens(recent, tok)
+            return tok, logprobs, cache, recent, key
+
+        def sample_fn(logits, recent, key, sp):
+            key, sub = jax.random.split(key)
+            tok, logprobs = sample_token(sub, logits, sp, recent)
+            recent = update_recent_tokens(recent, tok)
+            return tok, logprobs, recent, key
+
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
+        self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
+        self._sample = jax.jit(sample_fn, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    def generate_step(
+        self,
+        prompt_tokens: list[int] | np.ndarray,
+        *,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        repetition_penalty: Optional[float] = None,
+        repetition_context_size: int = REPETITION_WINDOW,
+        logit_bias: Optional[dict[int, float]] = None,
+        seed: Optional[int] = None,
+        max_tokens: int = 256,
+    ) -> Iterator[tuple[int, jax.Array]]:
+        """Yields ``(token, logprobs)`` per generated token — the contract of
+        the reference's generate_step closures (shard/utils.py:152-186)."""
+        sp = make_sampler_params(temperature, top_p, repetition_penalty, logit_bias)
+        key = jax.random.PRNGKey(int(time.time_ns()) & 0x7FFFFFFF if seed is None else seed)
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(self.batch, -1)
+        n_prompt = prompt.shape[1]
+        if n_prompt + max_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({n_prompt}) + max_tokens ({max_tokens}) exceeds KV "
+                f"capacity {self.max_seq}"
+            )
+
+        cache = self.model.make_cache(self.batch, self.max_seq, self.cache_dtype)
+        recent = init_recent_tokens(self.batch, repetition_context_size)
+
+        # chunked prefill (ref does whole-prompt single shot, shard/utils.py:158;
+        # chunking bounds activation memory and fixes compile shapes)
+        c = self.prefill_chunk
+        last_logits = None
+        for start in range(0, n_prompt, c):
+            chunk = prompt[:, start : start + c]
+            n_valid = chunk.shape[1]
+            if n_valid < c:
+                chunk = np.pad(chunk, ((0, 0), (0, c - n_valid)))
+            check_capacity(cache, n_valid)
+            last_logits, cache = self._prefill(
+                self.params, jnp.asarray(chunk), cache, jnp.asarray(n_valid, jnp.int32)
+            )
+
+        tok, logprobs, recent, key = self._sample(last_logits, recent, key, sp)
+
+        # decode with one-token lookahead
+        n = 0
+        while True:
+            next_tok, next_logprobs, cache, recent, key = self._decode(
+                self.params, tok[:, None], cache, recent, key, sp
+            )
+            yield int(tok[0]), logprobs
+            n += 1
+            if n >= max_tokens:
+                break
+            tok, logprobs = next_tok, next_logprobs
+
+
+def stream_generate(
+    generator: Generator,
+    tokenizer,
+    prompt_tokens: list[int],
+    *,
+    max_tokens: int = 256,
+    stop_id_sequences: Optional[list[list[int]]] = None,
+    eos_token_ids: Optional[list[int]] = None,
+    **sampler_kwargs,
+) -> Iterator[StreamChunk]:
+    """Detokenized streaming with stop handling + tok/s instrumentation
+    (semantics of ref generate.py:90-122 stream_generate)."""
+    from mlx_sharding_tpu.tokenizer_utils import StreamingDetokenizer, stopping_criteria
+
+    stop_id_sequences = stop_id_sequences or []
+    if eos_token_ids is None:
+        eos = getattr(tokenizer, "eos_token_id", None)
+        eos_token_ids = [eos] if eos is not None else []
+    detok = StreamingDetokenizer(tokenizer)
+    tokens: list[int] = []
+
+    start = time.perf_counter()
+    first_token_time = None
+    finish_reason = "length"
+    for token, logprobs in generator.generate_step(
+        prompt_tokens, max_tokens=max_tokens, **sampler_kwargs
+    ):
+        if first_token_time is None:
+            first_token_time = time.perf_counter()
+        tokens.append(token)
+        if token in eos_token_ids:
+            finish_reason = "stop"
+            break
+        stop = stopping_criteria(tokens, stop_id_sequences, None)
+        if stop.stop_met:
+            finish_reason = "stop"
+            break
+        detok.add_token(token)
+        if detok.last_segment:
+            yield StreamChunk(text=detok.last_segment, token=token)
+    detok.finalize()
+    end = time.perf_counter()
+
+    n_prompt = len(prompt_tokens)
+    ttft = (first_token_time or end) - start
+    gen_time = max(end - (first_token_time or end), 1e-9)
+    yield StreamChunk(
+        text=detok.last_segment if detok.last_segment else "",
+        finish_reason=finish_reason,
+        prompt_tokens=n_prompt,
+        generation_tokens=len(tokens),
+        prompt_tps=n_prompt / max(ttft, 1e-9),
+        generation_tps=max(len(tokens) - 1, 0) / gen_time,
+        ttft=ttft,
+    )

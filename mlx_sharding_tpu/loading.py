@@ -1,0 +1,181 @@
+"""Checkpoint loading.
+
+TPU-native counterpart of the reference's loader (ref: shard/utils.py:33-68):
+resolve a local path or HF repo, read ``config.json``, inject the pipeline
+bounds ``start_layer``/``end_layer`` (ref: shard/utils.py:36-39), read every
+``*.safetensors``, drop out-of-stage weights (the reference's per-model
+``sanitize``, ref: shard/server/model/llama.py:92-107), dequantize MLX
+grouped-quant triples when ``config.quantization`` is present
+(ref: shard/utils.py:54-65), and hand the result to the model's weight mapper
+which transposes/stacks into the scan-ready pytree.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_sharding_tpu.models import build_model
+from mlx_sharding_tpu.ops.quant import dequantize
+
+LAYER_RE = re.compile(r"(?:model\.)?layers\.(\d+)\.")
+
+
+def get_model_path(path_or_repo: str, revision: Optional[str] = None) -> Path:
+    """Local directory, else HF hub snapshot (ref: mlx_lm.get_model_path used
+    at shard/utils.py:34)."""
+    p = Path(path_or_repo)
+    if p.exists():
+        return p
+    from huggingface_hub import snapshot_download
+
+    return Path(
+        snapshot_download(
+            repo_id=path_or_repo,
+            revision=revision,
+            allow_patterns=["*.json", "*.safetensors", "*.model", "tokenizer*"],
+        )
+    )
+
+
+def load_config(
+    model_path: Path,
+    start_layer: Optional[int] = None,
+    end_layer: Optional[int] = None,
+) -> dict:
+    with open(model_path / "config.json") as f:
+        config = json.load(f)
+    # Dynamic sharding: bounds from the CLI override whatever the checkpoint
+    # baked in (ref: shard/utils.py:36-39).
+    if start_layer is not None:
+        config["start_layer"] = start_layer
+    if end_layer is not None:
+        config["end_layer"] = end_layer
+    return config
+
+
+def load_raw_weights(model_path: Path) -> dict[str, jnp.ndarray]:
+    """Read every *.safetensors in the directory (ref: shard/utils.py:40-45).
+    framework="flax" so bf16 tensors load without a numpy detour."""
+    from safetensors import safe_open
+
+    files = sorted(model_path.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"No safetensors found in {model_path}")
+    weights: dict[str, jnp.ndarray] = {}
+    for file in files:
+        with safe_open(file, framework="flax") as f:
+            for k in f.keys():
+                weights[k] = f.get_tensor(k)
+    return weights
+
+
+def dequantize_weights(
+    weights: dict[str, jnp.ndarray], quantization: dict, dtype=jnp.bfloat16
+) -> dict[str, jnp.ndarray]:
+    """Collapse every MLX ``{weight, scales, biases}`` triple into a dense
+    weight. Mirrors the predicate the reference feeds nn.quantize — a param is
+    quantized iff its ``.scales`` sibling exists (shard/utils.py:58-63)."""
+    group_size = int(quantization.get("group_size", 64))
+    bits = int(quantization.get("bits", 4))
+    out: dict[str, jnp.ndarray] = {}
+    for name, value in weights.items():
+        base, _, leaf = name.rpartition(".")
+        if leaf in ("scales", "biases"):
+            continue  # consumed alongside their .weight
+        if leaf == "weight" and f"{base}.scales" in weights:
+            value = dequantize(
+                value,
+                weights[f"{base}.scales"],
+                weights[f"{base}.biases"],
+                group_size,
+                bits,
+                dtype,
+            )
+        out[name] = value
+    return out
+
+
+def filter_stage_weights(
+    weights: dict[str, jnp.ndarray], config
+) -> dict[str, jnp.ndarray]:
+    """Sanitize-by-range (ref: shard/server/model/llama.py:92-107 and
+    sharding_weight.py:16-24): keep layers in [start, end); embedding only
+    where the stage needs it; final norm + head only on the last stage.
+    Rotary inv_freq buffers are always dropped."""
+    kept: dict[str, jnp.ndarray] = {}
+    for name, value in weights.items():
+        if "rotary_emb.inv_freq" in name:
+            continue
+        m = LAYER_RE.search(name)
+        if m:
+            if config.start_layer <= int(m.group(1)) < config.end_layer:
+                kept[name] = value
+            continue
+        if "embed_tokens" in name:
+            if config.needs_embed:
+                kept[name] = value
+            continue
+        if name.startswith(("model.norm", "norm.")) or "lm_head" in name:
+            if config.needs_head:
+                kept[name] = value
+            continue
+        kept[name] = value
+    return kept
+
+
+def load_model(
+    path_or_repo: str,
+    start_layer: Optional[int] = None,
+    end_layer: Optional[int] = None,
+    dtype=jnp.bfloat16,
+):
+    """Full load path (ref: shard/utils.py:33-68). Returns (model, params)."""
+    model_path = get_model_path(path_or_repo)
+    config_dict = load_config(model_path, start_layer, end_layer)
+    model, config = build_model(config_dict)
+    weights = load_raw_weights(model_path)
+    if config.quantization is not None:
+        weights = dequantize_weights(weights, config.quantization, dtype)
+    weights = filter_stage_weights(weights, config)
+    params = model.map_weights(weights, dtype)
+    return model, params
+
+
+# ---------------------------------------------------------------------------
+# Helpers for the per-model weight mappers
+
+
+def collect_layer_stack(
+    weights: dict[str, jnp.ndarray],
+    config,
+    per_layer_names: dict[str, tuple[str, bool]],
+    dtype,
+) -> dict[str, jnp.ndarray]:
+    """{hf_suffix → (our_name, transpose)} applied across the stage's layer
+    range and stacked on a leading axis (global HF indices
+    start_layer..end_layer map to stack rows 0..L). Projection weights arrive
+    (out, in) and are transposed to (in, out) for ``x @ W``."""
+    stacked: dict[str, list] = {our: [] for our, _ in per_layer_names.values()}
+    for i in range(config.start_layer, config.end_layer):
+        for hf_suffix, (our_name, transpose) in per_layer_names.items():
+            key = f"model.layers.{i}.{hf_suffix}"
+            if key not in weights:
+                key = f"layers.{i}.{hf_suffix}"
+            w = jnp.asarray(weights[key], dtype)
+            if transpose:
+                w = w.T
+            stacked[our_name].append(w)
+    return {k: jnp.stack(v) for k, v in stacked.items()}
+
+
+def first_key(weights: dict, *candidates: str):
+    for c in candidates:
+        if c in weights:
+            return weights[c]
+    raise KeyError(f"none of {candidates} present in checkpoint")

@@ -1,0 +1,78 @@
+"""Shared model infrastructure.
+
+The reference builds per-arch ``nn.Module`` trees with ``IdentityBlock``
+placeholders for non-local layers so weight indices line up
+(ref: shard/server/model/base.py:6-8, llama.py:28-33). On TPU that trick is
+unnecessary and harmful: materializing per-layer Python modules defeats
+``lax.scan``. Instead a stage's parameters are a pytree of arrays **stacked
+over its local layers** (leading axis = layer), the forward pass is one scan,
+and layer-index bookkeeping lives only in the checkpoint loader (which maps
+global HF layer indices ``start_layer..end_layer`` onto stack positions
+``0..L``) — the same sanitize-by-range semantics as
+shard/server/model/llama.py:92-107, applied at load time.
+
+Models here are *functional*: a model object holds only the (static) config;
+parameters and KV cache are explicit pytree arguments. That is what makes
+them jit/pjit/shard_map-transparent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_sharding_tpu.cache import KVCache, init_cache
+
+
+def dense_init(key, in_dim: int, out_dim: int, dtype, scale: float | None = None):
+    """Random (in, out) weight for x @ W. Used by tests/bench only —
+    real weights come from checkpoints."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(in_dim)
+    return (jax.random.normal(key, (in_dim, out_dim), jnp.float32) * scale).astype(dtype)
+
+
+def stack_layers(per_layer: list[dict]) -> dict:
+    """[{name: (…)}, …] → {name: (L, …)} for lax.scan consumption."""
+    out = {}
+    for name in per_layer[0]:
+        out[name] = jnp.stack([p[name] for p in per_layer])
+    return out
+
+
+class BaseModel:
+    """Common surface every architecture implements.
+
+    ``__call__(params, x, cache)`` where ``x`` is int32 tokens (B, T) on the
+    first stage or hidden states (B, T, H) downstream, returning logits on
+    the last stage or hidden states otherwise — mirroring the reference's
+    stage models (shard/server/model/llama.py:39-62).
+    """
+
+    def __init__(self, config):
+        self.config = config
+
+    # -- cache ------------------------------------------------------------
+    def make_cache(self, batch: int, max_seq: int, dtype=jnp.bfloat16) -> KVCache:
+        """Stage-local cache (the reference's make_cache / per-layer KVCache
+        construction, shard/utils.py:142-150)."""
+        cfg = self.config
+        return init_cache(
+            cfg.num_local_layers, batch, max_seq, cfg.num_key_value_heads,
+            self.cache_head_dim(), dtype,
+        )
+
+    def cache_head_dim(self):
+        """Int or (k_dim, v_dim) tuple (MLA, ref deepseek_v2.py:120-125)."""
+        return self.config.head_dim
+
+    # -- forward ----------------------------------------------------------
+    def __call__(self, params, x, cache: KVCache):
+        raise NotImplementedError
+
+    def init_params(self, key, dtype=jnp.bfloat16):
+        raise NotImplementedError
+
+    def embed_tokens(self, params, tokens):
+        return jnp.take(params["embed"]["weight"], tokens, axis=0)

@@ -1,0 +1,147 @@
+"""Llama-family decoder (also serves Mistral/Qwen2 via MODEL_REMAPPING,
+as in the reference: shard/utils.py:14-17).
+
+Capability parity target: shard/server/model/llama.py — pipeline-aware
+stage model with embed on first stage, norm + head (or tied embedding) on
+last (llama.py:26-36,74-89), causal masking with cache offset (llama.py:48-53),
+out-of-range weight dropping (sanitize, llama.py:92-107 — done in our loader).
+
+TPU-native design: the stage's layers run as one ``lax.scan`` over stacked
+parameters; the KV cache rides through the scan as xs/ys so XLA keeps all
+per-layer state in HBM with in-place dynamic-update-slice writes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mlx_sharding_tpu.cache import KVCache, advance, write_layer_kv
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.models.base import BaseModel, dense_init
+from mlx_sharding_tpu.ops import apply_rope, causal_attention, rms_norm, rope_frequencies
+
+
+class LlamaModel(BaseModel):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(config)
+        self.inv_freq = jnp.asarray(
+            rope_frequencies(config.head_dim, config.rope_theta, config.rope_scaling)
+        )
+        self.scale = config.head_dim ** -0.5
+
+    # ------------------------------------------------------------------
+    def _layer(self, h, p, k_buf, v_buf, offset):
+        cfg = self.config
+        b, t, _ = h.shape
+        hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+        r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
+        q = (r @ p["q_proj"]).reshape(b, t, hq, d)
+        k = (r @ p["k_proj"]).reshape(b, t, hkv, d)
+        v = (r @ p["v_proj"]).reshape(b, t, hkv, d)
+        q = apply_rope(q, self.inv_freq, offset)
+        k = apply_rope(k, self.inv_freq, offset)
+        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
+        attn = causal_attention(q, k_buf, v_buf, offset, self.scale)
+        h = h + attn.reshape(b, t, -1) @ p["o_proj"]
+
+        r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
+        ff = (jax.nn.silu(r @ p["gate_proj"]) * (r @ p["up_proj"])) @ p["down_proj"]
+        return h + ff, k_buf, v_buf
+
+    def __call__(self, params, x, cache: KVCache, n_valid=None):
+        """``n_valid`` (traced scalar) advances the cache by fewer positions
+        than T when the input is a right-padded prefill chunk; pad-position
+        K/V writes are overwritten by later contiguous writes before any
+        valid query can attend them (see generate.py docstring)."""
+        cfg = self.config
+        if cfg.is_first_stage:
+            h = self.embed_tokens(params, x)
+        else:
+            h = x
+        offset = cache.offset
+
+        def body(h, xs):
+            p, k_buf, v_buf = xs
+            h, k_buf, v_buf = self._layer(h, p, k_buf, v_buf, offset)
+            return h, (k_buf, v_buf)
+
+        h, (k, v) = jax.lax.scan(body, h, (params["layers"], cache.k, cache.v))
+        cache = KVCache(k=k, v=v, offset=offset)
+        cache = advance(cache, x.shape[1] if n_valid is None else n_valid)
+
+        if cfg.is_last_stage:
+            h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
+            if cfg.tie_word_embeddings:
+                logits = h @ params["embed"]["weight"].T
+            else:
+                logits = h @ params["lm_head"]["weight"]
+            return logits, cache
+        return h, cache
+
+    # ------------------------------------------------------------------
+    HF_LAYER_MAP = {
+        "input_layernorm.weight": ("input_norm", False),
+        "post_attention_layernorm.weight": ("post_norm", False),
+        "self_attn.q_proj.weight": ("q_proj", True),
+        "self_attn.k_proj.weight": ("k_proj", True),
+        "self_attn.v_proj.weight": ("v_proj", True),
+        "self_attn.o_proj.weight": ("o_proj", True),
+        "mlp.gate_proj.weight": ("gate_proj", True),
+        "mlp.up_proj.weight": ("up_proj", True),
+        "mlp.down_proj.weight": ("down_proj", True),
+    }
+
+    def map_weights(self, weights: dict, dtype=jnp.bfloat16) -> dict:
+        """HF-named (already stage-filtered, dequantized) tensors → the
+        scan-ready stacked pytree. Plays the role of the reference models'
+        sanitize + load_weights (shard/server/model/llama.py:92-107,
+        shard/utils.py:66-67)."""
+        from mlx_sharding_tpu.loading import collect_layer_stack, first_key
+
+        cfg = self.config
+        params = {"layers": collect_layer_stack(weights, cfg, self.HF_LAYER_MAP, dtype)}
+        if cfg.needs_embed:
+            embed = first_key(weights, "model.embed_tokens.weight", "embed_tokens.weight")
+            params["embed"] = {"weight": jnp.asarray(embed, dtype)}
+        if cfg.needs_head:
+            norm = first_key(weights, "model.norm.weight", "norm.weight")
+            params["final_norm"] = {"weight": jnp.asarray(norm, dtype)}
+            if not cfg.tie_word_embeddings:
+                head = first_key(weights, "lm_head.weight")
+                params["lm_head"] = {"weight": jnp.asarray(head, dtype).T}
+        return params
+
+    def init_params(self, key, dtype=jnp.bfloat16):
+        """Random params for this stage — tests and benchmarks only."""
+        cfg = self.config
+        hd, hq, hkv, d = cfg.hidden_size, cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        inter, nl = cfg.intermediate_size, cfg.num_local_layers
+        keys = iter(jax.random.split(key, 8 * nl + 4))
+
+        def layer():
+            return {
+                "input_norm": jnp.ones((hd,), dtype),
+                "post_norm": jnp.ones((hd,), dtype),
+                "q_proj": dense_init(next(keys), hd, hq * d, dtype),
+                "k_proj": dense_init(next(keys), hd, hkv * d, dtype),
+                "v_proj": dense_init(next(keys), hd, hkv * d, dtype),
+                "o_proj": dense_init(next(keys), hq * d, hd, dtype),
+                "gate_proj": dense_init(next(keys), hd, inter, dtype),
+                "up_proj": dense_init(next(keys), hd, inter, dtype),
+                "down_proj": dense_init(next(keys), inter, hd, dtype),
+            }
+
+        from mlx_sharding_tpu.models.base import stack_layers
+
+        params = {"layers": stack_layers([layer() for _ in range(nl)])}
+        if cfg.needs_embed:
+            params["embed"] = {
+                "weight": dense_init(next(keys), cfg.vocab_size, hd, dtype, scale=0.02)
+            }
+        if cfg.needs_head:
+            params["final_norm"] = {"weight": jnp.ones((hd,), dtype)}
+            if not cfg.tie_word_embeddings:
+                params["lm_head"] = {"weight": dense_init(next(keys), hd, cfg.vocab_size, dtype)}
+        return params

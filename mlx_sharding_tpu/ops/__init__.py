@@ -1,0 +1,5 @@
+from mlx_sharding_tpu.ops.norms import rms_norm
+from mlx_sharding_tpu.ops.rope import apply_rope, rope_frequencies
+from mlx_sharding_tpu.ops.attention import causal_attention
+
+__all__ = ["rms_norm", "apply_rope", "rope_frequencies", "causal_attention"]

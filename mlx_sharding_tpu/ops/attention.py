@@ -1,0 +1,66 @@
+"""Causal attention over a fixed-capacity KV cache.
+
+Replaces two reference pieces at once:
+- the dense additive causal mask the reference materializes per prefill
+  (ref: shard/server/model/llama.py:48-53, gemma2.py:48-51) — here masking is
+  computed inline from broadcasted iotas and fused by XLA, never stored;
+- mlx's scaled_dot_product_attention inside the borrowed decoder blocks
+  (SURVEY §2.2).
+
+Inputs are the *full-capacity* cache buffers; validity is derived from the
+cache offset, so the same compiled program serves prefill (T=prompt) and
+decode (T=1) without recompiling on sequence position. Scores accumulate in
+float32 on the MXU; GQA is handled by grouping query heads over KV heads
+rather than repeating K/V (no HBM duplication).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_attention(
+    q: jax.Array,  # (B, T, Hq, Dk)
+    k: jax.Array,  # (B, S, Hkv, Dk) — full cache buffer
+    v: jax.Array,  # (B, S, Hkv, Dv)
+    offset: jax.Array,  # scalar: first new position (query i sits at offset+i)
+    scale: float,
+    *,
+    logit_softcap: Optional[float] = None,  # gemma2.py attn softcapping
+    sliding_window: Optional[int] = None,  # gemma-2 local layers
+    sinks: Optional[jax.Array] = None,  # reserved for attention-sink variants
+) -> jax.Array:
+    """Returns (B, T, Hq, Dv). Keys at positions > query position (or outside
+    the sliding window, or beyond the valid prefix) contribute nothing."""
+    b, t, hq, dk = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    groups = hq // hkv
+
+    qg = q.reshape(b, t, hkv, groups, dk)
+    # (B, Hkv, G, T, S) — operands stay in their (bf16) dtype so the MXU runs
+    # at native throughput; accumulation is fp32 via preferred_element_type.
+    scores = jnp.einsum(
+        "bthgd,bshd->bhgts", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale
+    if logit_softcap is not None:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+
+    q_pos = offset + jnp.arange(t)[:, None]  # (T, 1)
+    k_pos = jnp.arange(s)[None, :]  # (1, S)
+    allowed = k_pos <= q_pos
+    if sliding_window is not None:
+        allowed &= k_pos > q_pos - sliding_window
+    scores = jnp.where(allowed[None, None, None], scores, -jnp.inf)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhgts,bshd->bthgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, t, hq, -1).astype(q.dtype)

@@ -1,0 +1,68 @@
+"""MLX grouped-affine quantization compatibility.
+
+The published ``*-4bit-mlx`` checkpoints the reference loads store each linear
+as a triple ``{weight, scales, biases}`` (ref: shard/utils.py:54-65 applies
+``nn.quantize`` when config.json carries a ``quantization`` dict, with the
+``"{path}.scales" in weights`` predicate). Layout (mlx.core.quantize):
+
+- ``weight``: uint32, shape (out, in * bits / 32); each uint32 packs
+  ``32/bits`` consecutive input-dim elements, least-significant bits first.
+- ``scales``/``biases``: (out, in / group_size); element value is
+  ``q * scale + bias`` per group.
+
+SURVEY §7 hard-part (a): this must be decoded bit-exactly or outputs diverge.
+Round 1 dequantizes on load to bf16 (weights then live in HBM dense); a
+Pallas fused dequant-matmul is the follow-up optimization path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dequantize(
+    w_q: jax.Array | np.ndarray,
+    scales: jax.Array | np.ndarray,
+    biases: jax.Array | np.ndarray,
+    group_size: int = 64,
+    bits: int = 4,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """(out, in*bits/32) packed uint32 → (out, in) dense."""
+    w_q = jnp.asarray(w_q)
+    if w_q.dtype != jnp.uint32:
+        raise ValueError(f"packed weight must be uint32, got {w_q.dtype}")
+    out_dim = w_q.shape[0]
+    per_word = 32 // bits
+    shifts = jnp.arange(per_word, dtype=jnp.uint32) * bits
+    # (out, in/per_word, per_word) → (out, in)
+    vals = (w_q[..., None] >> shifts) & ((1 << bits) - 1)
+    vals = vals.reshape(out_dim, -1).astype(jnp.float32)
+    in_dim = vals.shape[1]
+    scales = jnp.asarray(scales, jnp.float32).reshape(out_dim, in_dim // group_size, 1)
+    biases = jnp.asarray(biases, jnp.float32).reshape(out_dim, in_dim // group_size, 1)
+    grouped = vals.reshape(out_dim, in_dim // group_size, group_size)
+    return (grouped * scales + biases).reshape(out_dim, in_dim).astype(dtype)
+
+
+def quantize(w: np.ndarray, group_size: int = 64, bits: int = 4):
+    """Inverse of :func:`dequantize` — mlx-compatible packer. Used by the
+    shard-writer tool and round-trip tests; numpy (host, offline)."""
+    w = np.asarray(w, np.float32)
+    out_dim, in_dim = w.shape
+    if in_dim % group_size:
+        raise ValueError(f"in_dim {in_dim} not divisible by group_size {group_size}")
+    grouped = w.reshape(out_dim, in_dim // group_size, group_size)
+    w_max = grouped.max(axis=-1, keepdims=True)
+    w_min = grouped.min(axis=-1, keepdims=True)
+    n_levels = (1 << bits) - 1
+    scale = np.maximum((w_max - w_min) / n_levels, 1e-8)
+    q = np.clip(np.round((grouped - w_min) / scale), 0, n_levels).astype(np.uint32)
+    q = q.reshape(out_dim, in_dim)
+    per_word = 32 // bits
+    packed = np.zeros((out_dim, in_dim // per_word), np.uint32)
+    for j in range(per_word):
+        packed |= q[:, j::per_word] << np.uint32(j * bits)
+    return packed, scale[..., 0].astype(np.float16), w_min[..., 0].astype(np.float16)

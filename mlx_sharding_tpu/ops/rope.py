@@ -1,0 +1,82 @@
+"""Rotary position embeddings.
+
+The reference inherits RoPE from mlx_lm's decoder blocks (SURVEY §2.2). Here
+it is explicit: frequencies are precomputed once (host-side, static), and
+application is a pure jnp function over (B, T, H, D) tensors with a
+position offset coming from the KV-cache counter — so decode steps at T=1
+jit to a single fused kernel with no recompilation per position.
+
+Conventions follow HF ``transformers`` (split-half rotation), which is what
+the safetensors checkpoints we load assume.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    rope_scaling: dict | None = None,
+) -> np.ndarray:
+    """Per-pair inverse frequencies (head_dim // 2,), float32.
+
+    Supports HF ``rope_scaling`` variants ``linear`` and ``llama3``.
+    """
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if rope_scaling:
+        rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+        if rope_type == "linear":
+            inv_freq = inv_freq / float(rope_scaling["factor"])
+        elif rope_type == "llama3":
+            factor = float(rope_scaling["factor"])
+            low = float(rope_scaling.get("low_freq_factor", 1.0))
+            high = float(rope_scaling.get("high_freq_factor", 4.0))
+            orig_max = float(
+                rope_scaling.get("original_max_position_embeddings", 8192)
+            )
+            wavelen = 2 * math.pi / inv_freq
+            # Low-frequency (long-wavelength) components get fully rescaled,
+            # high-frequency ones are untouched, with a smooth ramp between.
+            smooth = (orig_max / wavelen - low) / (high - low)
+            smooth = np.clip(smooth, 0.0, 1.0)
+            scaled = inv_freq / factor
+            inv_freq = np.where(
+                wavelen > orig_max / low,
+                scaled,
+                np.where(
+                    wavelen < orig_max / high,
+                    inv_freq,
+                    (1 - smooth) * scaled + smooth * inv_freq,
+                ),
+            )
+        elif rope_type in ("default", None):
+            pass
+        else:
+            raise ValueError(f"Unsupported rope_scaling type: {rope_type!r}")
+    return inv_freq.astype(np.float32)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jax.Array, inv_freq: jax.Array, offset) -> jax.Array:
+    """Rotate ``x`` of shape (B, T, H, D) for absolute positions
+    ``offset .. offset+T``. float32 trig, result in x.dtype."""
+    t = x.shape[1]
+    positions = jnp.asarray(offset, jnp.float32) + jnp.arange(t, dtype=jnp.float32)
+    angles = positions[:, None] * inv_freq[None, :]  # (T, D/2)
+    angles = jnp.concatenate([angles, angles], axis=-1)  # (T, D)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    out = x32 * cos + _rotate_half(x32) * sin
+    return out.astype(x.dtype)

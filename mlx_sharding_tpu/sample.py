@@ -1,0 +1,132 @@
+"""Token sampling — fully on-device, branchless, jit-fused into the decode step.
+
+Semantic parity with the reference's sampler closure
+(ref: shard/utils.py:126-139 — logit bias, argmax at temperature 0, top-p
+else categorical) and its repetition penalty over a sliding token window
+(ref: shard/utils.py:166-177). The TPU-native difference: everything here is
+traced into the same XLA program as the model forward, with temperature /
+top-p / penalty as *dynamic* scalars, so changing sampler settings never
+recompiles and the only per-token host transfer is the sampled token id.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplerParams(NamedTuple):
+    """Dynamic sampler state — one pytree so it jits as leaves."""
+
+    temperature: jax.Array  # scalar f32; 0 → greedy
+    top_p: jax.Array  # scalar f32; 1 → full distribution
+    repetition_penalty: jax.Array  # scalar f32; 1 → off
+    bias_indices: jax.Array  # (K,) int32, pad with 0
+    bias_values: jax.Array  # (K,) f32, pad with 0 (no-op)
+
+
+def make_sampler_params(
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+    repetition_penalty: Optional[float] = None,
+    logit_bias: Optional[dict[int, float]] = None,
+    max_bias: int = 16,
+) -> SamplerParams:
+    bias_idx = jnp.zeros((max_bias,), jnp.int32)
+    bias_val = jnp.zeros((max_bias,), jnp.float32)
+    if logit_bias:
+        items = list(logit_bias.items())[:max_bias]
+        bias_idx = bias_idx.at[: len(items)].set(
+            jnp.asarray([int(k) for k, _ in items], jnp.int32)
+        )
+        bias_val = bias_val.at[: len(items)].set(
+            jnp.asarray([float(v) for _, v in items], jnp.float32)
+        )
+    return SamplerParams(
+        temperature=jnp.asarray(temperature, jnp.float32),
+        top_p=jnp.asarray(top_p, jnp.float32),
+        repetition_penalty=jnp.asarray(
+            1.0 if repetition_penalty is None else repetition_penalty, jnp.float32
+        ),
+        bias_indices=bias_idx,
+        bias_values=bias_val,
+    )
+
+
+def apply_logit_bias(logits: jax.Array, indices: jax.Array, values: jax.Array):
+    """Scatter-add biases. Padding entries have value 0 → no-op whatever the
+    index (matches ref logit_bias semantics, shard/utils.py:128-131)."""
+    return logits.at[..., indices].add(values)
+
+
+def apply_repetition_penalty(
+    logits: jax.Array, recent_tokens: jax.Array, penalty: jax.Array
+) -> jax.Array:
+    """Penalize tokens in ``recent_tokens`` (B, W), -1 = empty slot.
+
+    Positive scores are divided by ``penalty``, negative multiplied — the
+    standard CTRL-style rule the reference applies over its sliding window
+    (shard/utils.py:166-177, via mlx_lm.apply_repetition_penalty)."""
+
+    def one(logits_row, tokens_row):
+        valid = tokens_row >= 0
+        gather_idx = jnp.where(valid, tokens_row, 0)
+        scores = logits_row[gather_idx]
+        penalized = jnp.where(scores > 0, scores / penalty, scores * penalty)
+        # Route empty slots out of bounds and drop them, so a padding slot can
+        # never clobber a real token's penalized value (duplicate-index
+        # scatter is last-write-wins).
+        scatter_idx = jnp.where(valid, tokens_row, logits_row.shape[0])
+        return logits_row.at[scatter_idx].set(penalized, mode="drop")
+
+    return jax.vmap(one)(logits, recent_tokens)
+
+
+def top_p_filter(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Mask logits outside the top-p nucleus (ref: mlx_lm top_p_sampling used
+    at shard/utils.py:136). Keeps the smallest prefix of the sorted
+    distribution whose mass reaches ``top_p``; top_p >= 1 keeps everything."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p  # token kept iff mass before it < top_p
+    min_kept = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= min_kept, logits, -jnp.inf)
+
+
+def sample_token(
+    key: jax.Array,
+    logits: jax.Array,  # (B, V) f32
+    params: SamplerParams,
+    recent_tokens: Optional[jax.Array] = None,  # (B, W) int32, -1 padded
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (token (B,), logprobs (B, V)). Branchless: greedy and sampled
+    paths both computed, selected by ``temperature > 0`` — so one compiled
+    program covers every request's sampler settings."""
+    logits = logits.astype(jnp.float32)
+    logits = apply_logit_bias(logits, params.bias_indices, params.bias_values)
+    if recent_tokens is not None:
+        logits = apply_repetition_penalty(logits, recent_tokens, params.repetition_penalty)
+
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_temp = jnp.maximum(params.temperature, 1e-6)
+    filtered = top_p_filter(logits, params.top_p)
+    sampled = jax.random.categorical(key, filtered / safe_temp, axis=-1)
+    token = jnp.where(params.temperature > 0, sampled, greedy)
+    return token.astype(jnp.int32), logprobs
+
+
+def update_recent_tokens(recent: jax.Array, token: jax.Array) -> jax.Array:
+    """Shift the (B, W) window left and append the new token — the device-side
+    version of the reference's ``repetition_context`` deque trim
+    (shard/utils.py:171-177)."""
+    return jnp.concatenate([recent[:, 1:], token[:, None]], axis=1)
+
+
+def init_recent_tokens(batch: int, window: int) -> jax.Array:
+    return jnp.full((batch, window), -1, jnp.int32)

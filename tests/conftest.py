@@ -1,0 +1,24 @@
+"""Tests run on CPU with 8 virtual devices so the full multi-stage mesh
+machinery is exercised without TPU hardware (SURVEY §4 implication (b)).
+
+Environment wrinkle: this container's sitecustomize imports jax and registers
+the ``axon`` TPU-tunnel plugin before pytest starts, with JAX_PLATFORMS=axon
+in the env. Setting env vars here is therefore too late for jax's config —
+but backends initialize lazily, so ``jax.config.update`` still redirects to
+CPU (and avoids a hard deadlock: the axon C-API client hangs at init when
+torch is loaded in the same process)."""
+
+import os
+
+# For any subprocesses tests may spawn.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_default_matmul_precision", "highest")

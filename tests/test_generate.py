@@ -1,0 +1,91 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.generate import Generator, stream_generate
+from mlx_sharding_tpu.models.llama import LlamaModel
+
+TINY = dict(
+    vocab_size=300,  # > 256 so the ByteTokenizer ids fit
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+
+@pytest.fixture(scope="module")
+def gen():
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    return Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+
+
+def test_greedy_deterministic(gen):
+    a = [t for t, _ in gen.generate_step([1, 2, 3], max_tokens=10)]
+    b = [t for t, _ in gen.generate_step([1, 2, 3], max_tokens=10)]
+    assert a == b
+    assert len(a) == 10
+
+
+def test_chunked_prefill_matches_unchunked(gen):
+    """Prompt longer than the prefill chunk (8) must give the same greedy
+    continuation as a generator with a chunk large enough to take it whole."""
+    prompt = list(range(1, 20))  # 19 tokens -> chunks 8+8+3(padded)
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    big = Generator(model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=32)
+    a = [t for t, _ in gen.generate_step(prompt, max_tokens=5)]
+    b = [t for t, _ in big.generate_step(prompt, max_tokens=5)]
+    assert a == b
+
+
+def test_seeded_sampling_deterministic(gen):
+    a = [t for t, _ in gen.generate_step([1], temperature=1.0, seed=7, max_tokens=8)]
+    b = [t for t, _ in gen.generate_step([1], temperature=1.0, seed=7, max_tokens=8)]
+    assert a == b
+
+
+def test_capacity_guard(gen):
+    with pytest.raises(ValueError, match="exceeds KV"):
+        list(gen.generate_step(list(range(60)), max_tokens=10))
+
+
+def test_stream_generate_stops_and_reports(gen):
+    from tests.test_tokenizer_utils import ByteTokenizer
+
+    tok = ByteTokenizer()
+    chunks = list(
+        stream_generate(gen, tok, tok.encode("hi"), max_tokens=12, eos_token_ids=[])
+    )
+    final = chunks[-1]
+    assert final.finish_reason == "length"
+    assert final.generation_tokens == 12
+    assert final.prompt_tokens == 2
+    assert final.generation_tps > 0
+    assert final.ttft > 0
+
+
+def test_stream_generate_stop_sequence(gen):
+    from tests.test_tokenizer_utils import ByteTokenizer
+
+    tok = ByteTokenizer()
+    # find what greedy decode produces, then use its 3rd token as a stop token
+    toks = [t for t, _ in gen.generate_step(tok.encode("hi"), max_tokens=5)]
+    stop = [[toks[2]]]
+    chunks = list(
+        stream_generate(
+            gen, tok, tok.encode("hi"), max_tokens=12,
+            stop_id_sequences=stop, eos_token_ids=[],
+        )
+    )
+    assert chunks[-1].finish_reason == "stop"
+    # stops at the *first* occurrence of the stop token
+    assert chunks[-1].generation_tokens == toks.index(toks[2]) + 1

@@ -1,0 +1,119 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.ops import apply_rope, causal_attention, rms_norm, rope_frequencies
+
+
+def test_rms_norm_matches_numpy():
+    x = np.random.default_rng(0).normal(size=(2, 5, 16)).astype(np.float32)
+    w = np.random.default_rng(1).normal(size=(16,)).astype(np.float32)
+    got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-5)
+    ref = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_gemma_offset():
+    x = np.random.default_rng(0).normal(size=(1, 3, 8)).astype(np.float32)
+    w = np.zeros((8,), np.float32)
+    got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-6, offset=1.0)
+    ref = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rope_offset_consistency():
+    """Rotating positions [0..8) in one call == two calls split at 3."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 2, 16))
+    inv = jnp.asarray(rope_frequencies(16, 10000.0))
+    full = apply_rope(x, inv, 0)
+    a = apply_rope(x[:, :3], inv, 0)
+    b = apply_rope(x[:, 3:], inv, 3)
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(jnp.concatenate([a, b], axis=1)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_rope_matches_hf_rotate_half():
+    """Against the HF transformers convention computed by hand in numpy."""
+    d = 8
+    x = np.random.default_rng(2).normal(size=(1, 4, 1, d)).astype(np.float32)
+    inv = rope_frequencies(d, 10000.0)
+    pos = np.arange(4)
+    ang = pos[:, None] * inv[None, :]
+    cos = np.cos(np.concatenate([ang, ang], -1))[None, :, None, :]
+    sin = np.sin(np.concatenate([ang, ang], -1))[None, :, None, :]
+    rot = np.concatenate([-x[..., d // 2:], x[..., : d // 2]], -1)
+    ref = x * cos + rot * sin
+    got = apply_rope(jnp.asarray(x), jnp.asarray(inv), 0)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-5)
+
+
+def _naive_attention(q, k, v, scale, causal_from=0, window=None):
+    """Dense reference: q (B,T,H,D) vs k/v (B,S,H,D), queries at causal_from."""
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    scores = np.einsum("bthd,bshd->bhts", q, k) * scale
+    qpos = causal_from + np.arange(t)[:, None]
+    kpos = np.arange(s)[None, :]
+    mask = kpos <= qpos
+    if window is not None:
+        mask &= kpos > qpos - window
+    scores = np.where(mask[None, None], scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhts,bshd->bthd", p, v)
+
+
+def test_causal_attention_matches_naive():
+    rng = np.random.default_rng(3)
+    b, t, h, d, s = 2, 5, 4, 8, 5
+    q = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    got = causal_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(0), 1 / np.sqrt(d)
+    )
+    ref = _naive_attention(q, k, v, 1 / np.sqrt(d))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_causal_attention_gqa_and_offset():
+    rng = np.random.default_rng(4)
+    b, t, hq, hkv, d, s = 1, 1, 8, 2, 4, 10
+    offset = 6  # decode step at position 6; cache has 7 valid slots after write
+    q = rng.normal(size=(b, t, hq, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, hkv, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, hkv, d)).astype(np.float32)
+    got = causal_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(offset), 0.5
+    )
+    # repeat kv to full heads for the naive path
+    k_r = np.repeat(k, hq // hkv, axis=2)
+    v_r = np.repeat(v, hq // hkv, axis=2)
+    ref = _naive_attention(q, k_r, v_r, 0.5, causal_from=offset)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sliding_window_attention():
+    rng = np.random.default_rng(5)
+    b, t, h, d = 1, 6, 2, 4
+    q = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    v = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    got = causal_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(0), 0.5,
+        sliding_window=3,
+    )
+    ref = _naive_attention(q, k, v, 0.5, window=3)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_softcap_changes_scores():
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.normal(size=(1, 2, 2, 4)).astype(np.float32)) * 10
+    k = jnp.asarray(rng.normal(size=(1, 2, 2, 4)).astype(np.float32)) * 10
+    v = jnp.asarray(rng.normal(size=(1, 2, 2, 4)).astype(np.float32))
+    plain = causal_attention(q, k, v, jnp.asarray(0), 1.0)
+    capped = causal_attention(q, k, v, jnp.asarray(0), 1.0, logit_softcap=5.0)
+    assert not np.allclose(np.asarray(plain), np.asarray(capped))

@@ -1,0 +1,84 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_sharding_tpu.sample import (
+    apply_logit_bias,
+    apply_repetition_penalty,
+    init_recent_tokens,
+    make_sampler_params,
+    sample_token,
+    top_p_filter,
+    update_recent_tokens,
+)
+
+
+def test_greedy_at_zero_temperature():
+    logits = jnp.asarray([[0.1, 5.0, -1.0, 2.0]])
+    sp = make_sampler_params(temperature=0.0)
+    tok, logprobs = sample_token(jax.random.PRNGKey(0), logits, sp)
+    assert int(tok[0]) == 1
+    np.testing.assert_allclose(
+        np.asarray(logprobs), np.asarray(jax.nn.log_softmax(logits)), rtol=1e-5
+    )
+
+
+def test_categorical_respects_distribution():
+    logits = jnp.asarray([[0.0, 10.0, 0.0, 0.0]])
+    sp = make_sampler_params(temperature=1.0)
+    toks = [
+        int(sample_token(jax.random.PRNGKey(i), logits, sp)[0][0]) for i in range(20)
+    ]
+    assert toks.count(1) >= 18  # overwhelming mass on token 1
+
+
+def test_top_p_filter_masks_tail():
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    filtered = top_p_filter(logits, jnp.asarray(0.7))
+    # 0.5 kept (0 mass before); 0.3 kept (0.5 < 0.7); 0.15 dropped (0.8 >= 0.7)
+    f = np.asarray(filtered[0])
+    assert np.isfinite(f[0]) and np.isfinite(f[1])
+    assert np.isinf(f[2]) and np.isinf(f[3])
+
+
+def test_top_p_one_keeps_all():
+    logits = jnp.asarray([[1.0, 2.0, 3.0]])
+    filtered = top_p_filter(logits, jnp.asarray(1.0))
+    assert np.isfinite(np.asarray(filtered)).all()
+
+
+def test_logit_bias():
+    logits = jnp.zeros((1, 8))
+    sp = make_sampler_params(temperature=0.0, logit_bias={5: 100.0})
+    tok, _ = sample_token(jax.random.PRNGKey(0), logits, sp)
+    assert int(tok[0]) == 5
+
+
+def test_logit_bias_padding_is_noop():
+    logits = jnp.asarray([[3.0, 1.0, 2.0]])
+    biased = apply_logit_bias(
+        logits, jnp.zeros((4,), jnp.int32), jnp.zeros((4,), jnp.float32)
+    )
+    np.testing.assert_array_equal(np.asarray(biased), np.asarray(logits))
+
+
+def test_repetition_penalty_matches_reference_rule():
+    logits = jnp.asarray([[2.0, -2.0, 1.0, 0.5]])
+    recent = jnp.asarray([[0, 1, -1, -1]])  # tokens 0 and 1 seen; -1 = empty
+    out = np.asarray(apply_repetition_penalty(logits, recent, jnp.asarray(2.0)))[0]
+    np.testing.assert_allclose(out, [1.0, -4.0, 1.0, 0.5])  # pos/2, neg*2, rest same
+
+
+def test_repetition_penalty_via_sampler_changes_choice():
+    logits = jnp.asarray([[5.0, 4.9, 0.0]])
+    sp = make_sampler_params(temperature=0.0, repetition_penalty=2.0)
+    recent = update_recent_tokens(init_recent_tokens(1, 4), jnp.asarray([0]))
+    tok, _ = sample_token(jax.random.PRNGKey(0), logits, sp, recent)
+    assert int(tok[0]) == 1  # token 0 penalized 5.0 -> 2.5
+
+
+def test_recent_tokens_window_slides():
+    r = init_recent_tokens(1, 3)
+    for t in [7, 8, 9, 10]:
+        r = update_recent_tokens(r, jnp.asarray([t]))
+    np.testing.assert_array_equal(np.asarray(r), [[8, 9, 10]])

@@ -1,0 +1,85 @@
+"""Detokenizer + stop machinery, driven with a fake byte-level tokenizer so
+no network/tokenizer downloads are needed."""
+
+import pytest
+
+from mlx_sharding_tpu.tokenizer_utils import (
+    StreamingDetokenizer,
+    sequence_overlap,
+    stopping_criteria,
+)
+
+
+class ByteTokenizer:
+    """Token id == one UTF-8 byte. Exercises the mid-codepoint edge case
+    (multi-byte chars split across tokens) that real byte-level BPEs hit."""
+
+    eos_token_id = 256
+
+    def decode(self, ids):
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+    def encode(self, text):
+        return list(text.encode("utf-8"))
+
+
+def test_detokenizer_ascii_stream():
+    d = StreamingDetokenizer(ByteTokenizer())
+    out = []
+    for t in ByteTokenizer().encode("hello world"):
+        d.add_token(t)
+        out.append(d.last_segment)
+    assert "".join(out) == "hello world"
+    assert d.text == "hello world"
+
+
+def test_detokenizer_multibyte_held_until_complete():
+    tok = ByteTokenizer()
+    d = StreamingDetokenizer(tok)
+    emoji_bytes = "🎉".encode("utf-8")  # 4 bytes
+    segments = []
+    for b in emoji_bytes:
+        d.add_token(b)
+        segments.append(d.last_segment)
+    assert segments[:3] == ["", "", ""]  # nothing emitted mid-codepoint
+    assert segments[3] == "🎉"
+
+
+def test_detokenizer_newline_region_reset():
+    tok = ByteTokenizer()
+    d = StreamingDetokenizer(tok)
+    text = "a\nbb\nccc"
+    for t in tok.encode(text):
+        d.add_token(t)
+    d.finalize()
+    assert d.text == text
+
+
+def test_detokenizer_finalize_drops_dangling_bytes():
+    tok = ByteTokenizer()
+    d = StreamingDetokenizer(tok)
+    d.add_token("é".encode("utf-8")[0])  # first half of a 2-byte char
+    d.finalize()
+    assert d.text == ""
+
+
+def test_stopping_criteria_eos():
+    s = stopping_criteria([1, 2, 3], [], eos_token_id=3)
+    assert s.stop_met and s.trim_length == 0
+
+
+def test_stopping_criteria_sequence_trims():
+    s = stopping_criteria([5, 6, 7, 8], [[7, 8]], eos_token_id=None)
+    assert s.stop_met and s.trim_length == 2
+
+
+def test_stopping_criteria_no_match():
+    s = stopping_criteria([5, 6, 7], [[9, 9]], eos_token_id=0)
+    assert not s.stop_met
+
+
+def test_sequence_overlap():
+    assert sequence_overlap("hello wo", "world")  # "wo" is a prefix of "world"
+    assert not sequence_overlap("hello", "xyz")
+    assert sequence_overlap([1, 2], [2, 3])
+    assert not sequence_overlap([], [1])
