@@ -50,34 +50,46 @@ class LlamaModel(BaseModel):
         ff = (jax.nn.silu(r @ p["gate_proj"]) * (r @ p["up_proj"])) @ p["down_proj"]
         return h + ff, k_buf, v_buf
 
-    def __call__(self, params, x, cache: KVCache, n_valid=None):
-        """``n_valid`` (traced scalar) advances the cache by fewer positions
-        than T when the input is a right-padded prefill chunk; pad-position
-        K/V writes are overwritten by later contiguous writes before any
-        valid query can attend them (see generate.py docstring)."""
-        cfg = self.config
-        if cfg.is_first_stage:
-            h = self.embed_tokens(params, x)
-        else:
-            h = x
-        offset = cache.offset
+    def run_layers(self, layer_params, h, k, v, offset):
+        """The stage body: scan the (local) stacked layers, threading the
+        full-capacity K/V buffers (L, B, S, H, D) through as scan xs/ys.
+        This is the piece the SPMD pipeline executes per tick; ``__call__``
+        wraps it with embed/head for the single-program path."""
 
         def body(h, xs):
             p, k_buf, v_buf = xs
             h, k_buf, v_buf = self._layer(h, p, k_buf, v_buf, offset)
             return h, (k_buf, v_buf)
 
-        h, (k, v) = jax.lax.scan(body, h, (params["layers"], cache.k, cache.v))
+        h, (k, v) = jax.lax.scan(body, h, (layer_params, k, v))
+        return h, k, v
+
+    def embed(self, params, tokens):
+        return self.embed_tokens(params, tokens)
+
+    def apply_head(self, params, h):
+        """Final norm + LM head (tied-embedding aware — ref llama.py:74-77,
+        84-89)."""
+        cfg = self.config
+        h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
+        if cfg.tie_word_embeddings:
+            return h @ params["embed"]["weight"].T
+        return h @ params["lm_head"]["weight"]
+
+    def __call__(self, params, x, cache: KVCache, n_valid=None):
+        """``n_valid`` (traced scalar) advances the cache by fewer positions
+        than T when the input is a right-padded prefill chunk; pad-position
+        K/V writes are overwritten by later contiguous writes before any
+        valid query can attend them (see generate.py docstring)."""
+        cfg = self.config
+        h = self.embed(params, x) if cfg.is_first_stage else x
+        offset = cache.offset
+        h, k, v = self.run_layers(params["layers"], h, cache.k, cache.v, offset)
         cache = KVCache(k=k, v=v, offset=offset)
         cache = advance(cache, x.shape[1] if n_valid is None else n_valid)
 
         if cfg.is_last_stage:
-            h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
-            if cfg.tie_word_embeddings:
-                logits = h @ params["embed"]["weight"].T
-            else:
-                logits = h @ params["lm_head"]["weight"]
-            return logits, cache
+            return self.apply_head(params, h), cache
         return h, cache
 
     # ------------------------------------------------------------------

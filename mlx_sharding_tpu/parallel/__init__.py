@@ -1,0 +1,3 @@
+from mlx_sharding_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP, make_mesh
+
+__all__ = ["make_mesh", "AXIS_PP", "AXIS_TP", "AXIS_DP", "AXIS_SP"]

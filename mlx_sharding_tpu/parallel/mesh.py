@@ -1,0 +1,54 @@
+"""Device-mesh construction.
+
+This is the framework's replacement for the reference's process topology —
+where the reference identifies a "shard" with a gRPC server process at an IP
+(ref: generate.py:17, shard/openai_api.py:621-627), here a stage is a slice
+of a ``jax.sharding.Mesh`` and topology is declared once, not dialed.
+
+Axis conventions (the names the rest of the codebase shards against):
+  dp — data / batch replication
+  pp — pipeline stages (the reference's only axis, §2.3)
+  sp — sequence/context parallelism (ring attention)
+  tp — tensor parallelism within a stage
+  ep — expert parallelism rides on tp for MoE layers
+
+Multi-host: callers run ``jax.distributed.initialize()`` first (DCN), then
+``make_mesh`` over ``jax.devices()`` spans hosts; mesh-axis order puts tp/sp
+innermost so their collectives ride ICI, pp/dp outermost so stage hops and
+gradient syncs cross DCN only when they must (scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_PP = "pp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+
+# outermost → innermost; innermost axes get the fastest interconnect links
+MESH_AXIS_ORDER = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
+
+
+def make_mesh(
+    dp: int = 1, pp: int = 1, sp: int = 1, tp: int = 1, devices=None
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = dp * pp * sp * tp
+    if n > len(devices):
+        raise ValueError(
+            f"mesh dp={dp} pp={pp} sp={sp} tp={tp} needs {n} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:n]).reshape(dp, pp, sp, tp)
+    return Mesh(grid, MESH_AXIS_ORDER)
+
+
+def pipeline_mesh(num_stages: int, devices=None) -> Mesh:
+    """1-D pipeline mesh — the parity topology (reference §2.3: PP is the
+    only strategy)."""
+    return make_mesh(pp=num_stages, devices=devices)

@@ -1,0 +1,75 @@
+"""Offline shard writer: placement rules + end-to-end reload parity."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.loading import load_model, load_raw_weights
+from mlx_sharding_tpu.shard_tool import even_partition, shard_all_stages, save_sharded_weights
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from tests.test_checkpoint import TINY_HF  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("src_llama")
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(transformers.LlamaConfig(**TINY_HF))
+    model.eval()
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model
+
+
+def test_even_partition():
+    assert even_partition(27, 2) == [(0, 14), (14, 27)]  # BASELINE config split
+    assert even_partition(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+
+
+def test_placement_rules(hf_checkpoint, tmp_path):
+    path, _ = hf_checkpoint
+    save_sharded_weights(path, tmp_path / "s0", 0, 2)
+    save_sharded_weights(path, tmp_path / "s1", 2, 3)
+
+    w0 = load_raw_weights(tmp_path / "s0")
+    w1 = load_raw_weights(tmp_path / "s1")
+    assert any("embed_tokens" in k for k in w0)
+    assert not any("embed_tokens" in k for k in w1)
+    assert not any("lm_head" in k or k == "model.norm.weight" for k in w0)
+    assert any("lm_head" in k for k in w1)
+    assert any(".layers.1." in k for k in w0) and not any(".layers.2." in k for k in w0)
+    assert any(".layers.2." in k for k in w1) and not any(".layers.1." in k for k in w1)
+
+    cfg0 = json.loads((tmp_path / "s0" / "config.json").read_text())
+    assert cfg0["start_layer"] == 0 and cfg0["end_layer"] == 2
+    idx = json.loads((tmp_path / "s0" / "model.safetensors.index.json").read_text())
+    assert set(idx["weight_map"].values()) == {"model-00000-00002.safetensors"}
+
+
+def test_sharded_reload_matches_full(hf_checkpoint, tmp_path):
+    """Stages written by the tool, loaded back WITHOUT dynamic bounds (they
+    self-describe via baked config), chain to the full model's logits."""
+    path, hf_model = hf_checkpoint
+    dirs = shard_all_stages(path, tmp_path, num_stages=2)
+    tokens = [[4, 8, 15, 16]]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+
+    s0, p0 = load_model(str(dirs[0]), dtype=jnp.float32)
+    s1, p1 = load_model(str(dirs[1]), dtype=jnp.float32)
+    assert s0.config.start_layer == 0 and s1.config.is_last_stage
+    h, _ = s0(p0, jnp.asarray(tokens, jnp.int32), s0.make_cache(1, 16, jnp.float32))
+    got, _ = s1(p1, h, s1.make_cache(1, 16, jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_aux_files_copied(hf_checkpoint, tmp_path):
+    path, _ = hf_checkpoint
+    (path / "tokenizer_config.json").write_text("{}")
+    out = save_sharded_weights(path, tmp_path / "aux", 0, 3)
+    assert (out / "tokenizer_config.json").exists()
+    assert (out / "generation_config.json").exists()  # written by save_pretrained
