@@ -1,0 +1,99 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+The reference has no long-context story at all: prefill materializes a dense
+T×T mask and pushes the whole prompt through every stage in one call
+(SURVEY §5 "Long-context"). Here long sequences shard over ``sp``: each
+device keeps its Q block resident and the K/V blocks rotate around the ring
+via ``lax.ppermute`` (one ICI hop per step) while a streaming flash-style
+softmax (running max / normalizer / output, all fp32) accumulates the exact
+attention result — memory per device is O(T/S), communication overlaps with
+the block matmuls, and no T×T anything ever exists.
+
+Causality is enforced with *global* positions: query block ``s`` holds
+positions ``s*T_local + i``; at ring step ``j`` it sees K/V block
+``(s - j) mod S``. Blocks strictly in the future contribute nothing and
+their masked scores vanish in the streaming update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mlx_sharding_tpu.parallel.mesh import AXIS_SP
+
+
+def _block_update(scores, v_blk, o, m, l):
+    """One streaming-softmax step. scores (B,Hkv,G,T,Tk) fp32 (may contain
+    -inf), v_blk (B,Tk,Hkv,Dv). Returns updated (o, m, l)."""
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - m_safe[..., None])  # -inf rows -> 0
+    corr = jnp.exp(m - m_safe)
+    corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+    l = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhgtk,bkhd->bhgtd", p, v_blk.astype(jnp.float32))
+    o = o * corr[..., None] + pv
+    return o, m_new, l
+
+
+def ring_attention_local(q, k, v, scale: float, axis_name: str = AXIS_SP):
+    """shard_map-level kernel: q/k/v are this device's (B, T_local, H, D)
+    blocks of a sequence sharded over ``axis_name``. Causal, GQA-aware.
+    Returns (B, T_local, Hq, Dv)."""
+    b, t, hq, dk = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    qg = q.reshape(b, t, hkv, groups, dk)
+    q_pos = idx * t + jnp.arange(t)  # global positions of local queries
+
+    o = jnp.zeros((b, hkv, groups, t, v.shape[-1]), jnp.float32)
+    m = jnp.full((b, hkv, groups, t), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, hkv, groups, t), jnp.float32)
+
+    def step(carry, j):
+        o, m, l, k_blk, v_blk = carry
+        blk = (idx - j) % size
+        k_pos = blk * t + jnp.arange(t)
+        scores = jnp.einsum(
+            "bthgd,bkhd->bhgtk", qg, k_blk, preferred_element_type=jnp.float32
+        ) * scale
+        allowed = k_pos[None, :] <= q_pos[:, None]  # (T, Tk) global causal
+        scores = jnp.where(allowed[None, None, None], scores, -jnp.inf)
+        o, m, l = _block_update(scores, v_blk, o, m, l)
+        k_next = jax.lax.ppermute(
+            k_blk, axis_name, [(i, (i + 1) % size) for i in range(size)]
+        )
+        v_next = jax.lax.ppermute(
+            v_blk, axis_name, [(i, (i + 1) % size) for i in range(size)]
+        )
+        return (o, m, l, k_next, v_next), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(step, (o, m, l, k, v), jnp.arange(size))
+    o = o / jnp.maximum(l[..., None], 1e-30)
+    # (B, Hkv, G, T, Dv) -> (B, T, Hq, Dv)
+    return o.transpose(0, 3, 1, 2, 4).reshape(b, t, hq, -1).astype(q.dtype)
+
+
+def ring_attention(q, k, v, scale: float, mesh: Mesh, axis_name: str = AXIS_SP):
+    """Driver-level entry: q/k/v (B, T, H, D) get sharded over ``axis_name``
+    on their sequence dim and attended exactly. T must divide by the axis
+    size."""
+    spec = P(None, axis_name)
+    f = jax.shard_map(
+        lambda q, k, v: ring_attention_local(q, k, v, scale, axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, spec)
+    return f(
+        jax.device_put(q, sharding),
+        jax.device_put(k, sharding),
+        jax.device_put(v, sharding),
+    )

@@ -1,0 +1,51 @@
+"""Tensor-parallel sharding specs.
+
+The reference splits weights only by layer index (sharding_weight.py:17-20);
+intra-stage tensor parallelism doesn't exist there (SURVEY §2.3 "TP: NO").
+On TPU it's nearly free to offer: annotate the stacked parameter pytree with
+PartitionSpecs over the ``tp`` axis and let GSPMD insert the all-reduces —
+column-parallel Q/K/V/gate/up (output dim sharded), row-parallel O/down
+(contracting dim sharded), so each decoder block needs exactly one psum per
+attention and one per MLP, riding ICI.
+
+These specs compose with the other axes: the leading stacked-layer axis can
+carry ``pp`` (layer ranges per stage), batch carries ``dp``, sequence ``sp``.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+
+def llama_param_specs(tp: str | None = "tp", layers: str | None = None) -> dict:
+    """PartitionSpec pytree matching LlamaModel.init_params/map_weights.
+    ``layers`` optionally shards the stacked-layer axis (pipeline-style
+    weight placement for the GSPMD training path)."""
+    col = P(layers, None, tp)  # (L, in, out) — split output dim
+    row = P(layers, tp, None)  # (L, in, out) — split contracting dim
+    norm = P(layers, None)
+    return {
+        "layers": {
+            "input_norm": norm,
+            "post_norm": norm,
+            "q_proj": col,
+            "k_proj": col,
+            "v_proj": col,
+            "o_proj": row,
+            "gate_proj": col,
+            "up_proj": col,
+            "down_proj": row,
+        },
+        "embed": {"weight": P(None, None)},
+        "final_norm": {"weight": P(None)},
+        "lm_head": {"weight": P(None, tp)},
+    }
+
+
+def prune_specs(specs: dict, params: dict) -> dict:
+    """Drop spec entries for params the stage doesn't have (no embed on
+    non-first stages, etc.)."""
+    return {
+        k: (prune_specs(specs[k], v) if isinstance(v, dict) else specs[k])
+        for k, v in params.items()
+    }

@@ -1,0 +1,73 @@
+"""Training step (next-token LM objective) with multi-axis sharding.
+
+The reference is inference-only (SURVEY §1: "no training"); this module
+exists because a TPU framework's mesh story must cover the update path too:
+parameters carry tensor-parallel specs (column/row split, parallel/tp.py)
+with the stacked-layer axis placed on ``pp``, the batch on ``dp`` and the
+sequence on ``sp`` — all as GSPMD sharding constraints on one jitted
+value_and_grad + optax step, letting XLA place the collectives (psum for TP
+partials and DP gradient reduction) on ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mlx_sharding_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP
+from mlx_sharding_tpu.parallel.tp import llama_param_specs, prune_specs
+
+
+def lm_loss(model, params, tokens):
+    """Mean next-token cross-entropy. Runs the same stage body as inference
+    (a throwaway full-length cache keeps one code path)."""
+    b, t = tokens.shape
+    cache = model.make_cache(b, t, jnp.float32)
+    logits, _ = model(params, tokens, cache)
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+class TrainState(NamedTuple):
+    params: dict
+    opt_state: optax.OptState
+    step: jax.Array
+
+
+def make_train_step(model, optimizer, mesh: Mesh, param_specs=None):
+    """Returns (init_fn, step_fn), both jitted with NamedShardings so every
+    tensor lives where its spec says — params split over (pp, tp), data over
+    (dp, sp)."""
+    if param_specs is None:
+        param_specs = llama_param_specs(tp=AXIS_TP, layers=AXIS_PP)
+
+    def init(params):
+        specs = prune_specs(param_specs, params)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        params = jax.device_put(params, shardings)
+        opt_state = optimizer.init(params)
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    data_sharding = NamedSharding(mesh, P(AXIS_DP, AXIS_SP))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, tokens):
+        tokens = jax.lax.with_sharding_constraint(tokens, data_sharding)
+        loss, grads = jax.value_and_grad(partial(lm_loss, model))(
+            state.params, tokens
+        )
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return init, step
