@@ -1,0 +1,96 @@
+"""CLI text generation — the reference's ``generate.py`` driver re-imagined.
+
+Same operator surface (model path, prompt, sampling knobs, chat-template
+application, streamed output, prompt/generation tok/s report —
+ref: generate.py:12-20, 25-29, 90-122) but the execution underneath is the
+TPU stack: single-chip jitted decode or the SPMD pipeline via
+``--num-stages`` (which replaces the reference's ``--server-address`` list of
+gRPC shard endpoints, ref generate.py:17 — stages are mesh slices here, not
+remote processes). TTFT is reported explicitly, which the reference only
+measures implicitly (SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Generate text with mlx_sharding_tpu")
+    parser.add_argument("--model", required=True, help="model path or HF repo")
+    parser.add_argument("--prompt", default="hello")
+    parser.add_argument("--max-tokens", type=int, default=100)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-p", type=float, default=1.0)
+    parser.add_argument("--repetition-penalty", type=float, default=None)
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--max-seq", type=int, default=4096)
+    parser.add_argument("--prefill-chunk", type=int, default=256)
+    parser.add_argument("--start-layer", type=int, default=None)
+    parser.add_argument("--end-layer", type=int, default=None)
+    parser.add_argument("--num-stages", type=int, default=None,
+                        help="run the model as an N-stage pipeline on the local mesh")
+    parser.add_argument("--no-chat-template", action="store_true")
+    args = parser.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from mlx_sharding_tpu.generate import Generator, stream_generate
+    from mlx_sharding_tpu.loading import get_model_path, load_model
+
+    model, params = load_model(args.model, args.start_layer, args.end_layer)
+    if args.num_stages and args.num_stages > 1:
+        from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+        from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+        generator = PipelineEngine(
+            model, params, pipeline_mesh(args.num_stages),
+            max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
+        )
+    else:
+        generator = Generator(
+            model, params, max_seq=args.max_seq, prefill_chunk=args.prefill_chunk
+        )
+
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(str(get_model_path(args.model)))
+    if getattr(tokenizer, "chat_template", None) and not args.no_chat_template:
+        prompt_ids = tokenizer.apply_chat_template(
+            [{"role": "user", "content": args.prompt}],
+            tokenize=True, add_generation_prompt=True,
+        )
+    else:
+        prompt_ids = tokenizer.encode(args.prompt)
+
+    stats = None
+    for chunk in stream_generate(
+        generator, tokenizer, list(prompt_ids),
+        max_tokens=args.max_tokens,
+        temperature=args.temperature,
+        top_p=args.top_p,
+        repetition_penalty=args.repetition_penalty,
+        seed=args.seed,
+    ):
+        if chunk.text:
+            print(chunk.text, end="", flush=True)
+        if chunk.finish_reason is not None:
+            stats = chunk
+    print()
+    # same instrumentation the reference prints (ref generate.py:115-122)
+    print("=" * 10, file=sys.stderr)
+    print(
+        f"Prompt: {stats.prompt_tokens} tokens, {stats.prompt_tps:.3f} tokens-per-sec",
+        file=sys.stderr,
+    )
+    print(
+        f"Generation: {stats.generation_tokens} tokens, "
+        f"{stats.generation_tps:.3f} tokens-per-sec",
+        file=sys.stderr,
+    )
+    print(f"TTFT: {stats.ttft * 1000:.1f} ms", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

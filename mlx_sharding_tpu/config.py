@@ -172,7 +172,8 @@ def resolve_model_type(model_type: str) -> str:
 
 
 def config_from_dict(d: dict[str, Any]):
-    model_type = resolve_model_type(d.get("model_type", "llama"))
+    original_type = d.get("model_type", "llama")
+    model_type = resolve_model_type(original_type)
     if model_type not in CONFIG_REGISTRY:
         raise ValueError(
             f"Model type {model_type!r} not supported. "
@@ -181,4 +182,8 @@ def config_from_dict(d: dict[str, Any]):
     cls = CONFIG_REGISTRY[model_type]
     d = dict(d)
     d["model_type"] = model_type
+    if original_type == "qwen2":
+        # Qwen2 uses QKV biases unconditionally and its HF config carries no
+        # attention_bias field.
+        d.setdefault("attention_bias", True)
     return cls.from_dict(d)

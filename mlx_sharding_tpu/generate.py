@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mlx_sharding_tpu.cache import KVCache, check_capacity, reset
+from mlx_sharding_tpu.cache import KVCache, reset
 from mlx_sharding_tpu.sample import (
     SamplerParams,
     init_recent_tokens,
@@ -78,7 +78,10 @@ class Generator:
     ):
         self.model = model
         self.params = params
-        self.max_seq = max_seq
+        # Round capacity up to a chunk multiple: every (possibly padded)
+        # prefill chunk then writes entirely inside the buffer, so padded
+        # writes can never clamp-and-corrupt valid entries.
+        self.max_seq = -(-max_seq // prefill_chunk) * prefill_chunk
         self.batch = batch
         self.cache_dtype = cache_dtype
         self.prefill_chunk = prefill_chunk
@@ -131,10 +134,11 @@ class Generator:
             )
 
         cache = self.model.make_cache(self.batch, self.max_seq, self.cache_dtype)
-        recent = init_recent_tokens(self.batch, repetition_context_size)
+        recent = init_recent_tokens(self.batch, repetition_context_size, prompt)
 
         # chunked prefill (ref does whole-prompt single shot, shard/utils.py:158;
-        # chunking bounds activation memory and fixes compile shapes)
+        # chunking bounds activation memory and fixes compile shapes). Capacity
+        # was verified above with host arithmetic — no per-chunk device sync.
         c = self.prefill_chunk
         last_logits = None
         for start in range(0, n_prompt, c):
@@ -142,7 +146,6 @@ class Generator:
             n_valid = chunk.shape[1]
             if n_valid < c:
                 chunk = np.pad(chunk, ((0, 0), (0, c - n_valid)))
-            check_capacity(cache, n_valid)
             last_logits, cache = self._prefill(
                 self.params, jnp.asarray(chunk), cache, jnp.asarray(n_valid, jnp.int32)
             )
@@ -174,7 +177,11 @@ def stream_generate(
 ) -> Iterator[StreamChunk]:
     """Detokenized streaming with stop handling + tok/s instrumentation
     (semantics of ref generate.py:90-122 stream_generate)."""
-    from mlx_sharding_tpu.tokenizer_utils import StreamingDetokenizer, stopping_criteria
+    from mlx_sharding_tpu.tokenizer_utils import (
+        StreamingDetokenizer,
+        sequence_overlap,
+        stopping_criteria,
+    )
 
     stop_id_sequences = stop_id_sequences or []
     if eos_token_ids is None:
@@ -182,6 +189,7 @@ def stream_generate(
         eos_token_ids = [eos] if eos is not None else []
     detok = StreamingDetokenizer(tokenizer)
     tokens: list[int] = []
+    in_flight: list[int] = []  # withheld: could still grow into a stop sequence
 
     start = time.perf_counter()
     first_token_time = None
@@ -194,14 +202,31 @@ def stream_generate(
         tokens.append(token)
         if token in eos_token_ids:
             finish_reason = "stop"
+            in_flight.clear()
             break
         stop = stopping_criteria(tokens, stop_id_sequences, None)
         if stop.stop_met:
+            # the matched stop sequence itself is trimmed, never emitted
+            # (ref shard/openai_api.py:465-474 trim semantics)
             finish_reason = "stop"
+            tokens = tokens[: len(tokens) - stop.trim_length]
+            in_flight.clear()
             break
+        if stop_id_sequences and any(
+            sequence_overlap(tokens, s) for s in stop_id_sequences
+        ):
+            in_flight.append(token)
+            continue
+        for t in in_flight:
+            detok.add_token(t)
+        in_flight.clear()
         detok.add_token(token)
         if detok.last_segment:
             yield StreamChunk(text=detok.last_segment, token=token)
+    # a run that ended on length while buffering emits the buffered tokens —
+    # they were never part of a completed stop sequence
+    for t in in_flight:
+        detok.add_token(t)
     detok.finalize()
     end = time.perf_counter()
 

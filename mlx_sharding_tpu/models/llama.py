@@ -37,9 +37,16 @@ class LlamaModel(BaseModel):
         hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
-        q = (r @ p["q_proj"]).reshape(b, t, hq, d)
-        k = (r @ p["k_proj"]).reshape(b, t, hkv, d)
-        v = (r @ p["v_proj"]).reshape(b, t, hkv, d)
+        q = r @ p["q_proj"]
+        k = r @ p["k_proj"]
+        v = r @ p["v_proj"]
+        if cfg.attention_bias:  # Qwen2-style QKV biases
+            q = q + p["q_bias"]
+            k = k + p["k_bias"]
+            v = v + p["v_bias"]
+        q = q.reshape(b, t, hq, d)
+        k = k.reshape(b, t, hkv, d)
+        v = v.reshape(b, t, hkv, d)
         q = apply_rope(q, self.inv_freq, offset)
         k = apply_rope(k, self.inv_freq, offset)
         k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
@@ -113,7 +120,16 @@ class LlamaModel(BaseModel):
         from mlx_sharding_tpu.loading import collect_layer_stack, first_key
 
         cfg = self.config
-        params = {"layers": collect_layer_stack(weights, cfg, self.HF_LAYER_MAP, dtype)}
+        layer_map = dict(self.HF_LAYER_MAP)
+        if cfg.attention_bias:  # Qwen2 checkpoints carry QKV biases
+            layer_map.update(
+                {
+                    "self_attn.q_proj.bias": ("q_bias", False),
+                    "self_attn.k_proj.bias": ("k_bias", False),
+                    "self_attn.v_proj.bias": ("v_bias", False),
+                }
+            )
+        params = {"layers": collect_layer_stack(weights, cfg, layer_map, dtype)}
         if cfg.needs_embed:
             embed = first_key(weights, "model.embed_tokens.weight", "embed_tokens.weight")
             params["embed"] = {"weight": jnp.asarray(embed, dtype)}
@@ -133,7 +149,7 @@ class LlamaModel(BaseModel):
         keys = iter(jax.random.split(key, 8 * nl + 4))
 
         def layer():
-            return {
+            out = {
                 "input_norm": jnp.ones((hd,), dtype),
                 "post_norm": jnp.ones((hd,), dtype),
                 "q_proj": dense_init(next(keys), hd, hq * d, dtype),
@@ -144,6 +160,11 @@ class LlamaModel(BaseModel):
                 "up_proj": dense_init(next(keys), hd, inter, dtype),
                 "down_proj": dense_init(next(keys), inter, hd, dtype),
             }
+            if cfg.attention_bias:
+                out["q_bias"] = jnp.zeros((hq * d,), dtype)
+                out["k_bias"] = jnp.zeros((hkv * d,), dtype)
+                out["v_bias"] = jnp.zeros((hkv * d,), dtype)
+            return out
 
         from mlx_sharding_tpu.models.base import stack_layers
 

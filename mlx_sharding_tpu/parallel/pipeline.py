@@ -103,7 +103,8 @@ class PipelineEngine:
         self.num_stages = mesh.shape[AXIS_PP]
         self.microbatches = microbatches
         self.batch = batch
-        self.max_seq = max_seq
+        # chunk-multiple capacity: padded prefill writes stay in bounds
+        self.max_seq = -(-max_seq // prefill_chunk) * prefill_chunk
         self.cache_dtype = cache_dtype
         self.prefill_chunk = prefill_chunk
 
@@ -275,7 +276,9 @@ class PipelineEngine:
             )
 
         cache = self.init_cache()
-        recent = init_recent_tokens(M * B, repetition_context_size)
+        recent = init_recent_tokens(
+            M * B, repetition_context_size, prompt.reshape(M * B, -1)
+        )
 
         c = self.prefill_chunk
         logits = None

@@ -32,12 +32,17 @@ def make_sampler_params(
     top_p: float = 1.0,
     repetition_penalty: Optional[float] = None,
     logit_bias: Optional[dict[int, float]] = None,
-    max_bias: int = 16,
+    min_bias_slots: int = 16,
 ) -> SamplerParams:
-    bias_idx = jnp.zeros((max_bias,), jnp.int32)
-    bias_val = jnp.zeros((max_bias,), jnp.float32)
+    # Buffer sized to the request (rounded to a power of two so distinct bias
+    # counts reuse a handful of compiled programs); every entry is applied —
+    # the reference applies all of them too (shard/utils.py:128-131).
+    n = len(logit_bias) if logit_bias else 0
+    slots = max(min_bias_slots, 1 << (n - 1).bit_length() if n else 0)
+    bias_idx = jnp.zeros((slots,), jnp.int32)
+    bias_val = jnp.zeros((slots,), jnp.float32)
     if logit_bias:
-        items = list(logit_bias.items())[:max_bias]
+        items = list(logit_bias.items())
         bias_idx = bias_idx.at[: len(items)].set(
             jnp.asarray([int(k) for k, _ in items], jnp.int32)
         )
@@ -115,8 +120,11 @@ def sample_token(
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
     safe_temp = jnp.maximum(params.temperature, 1e-6)
-    filtered = top_p_filter(logits, params.top_p)
-    sampled = jax.random.categorical(key, filtered / safe_temp, axis=-1)
+    # Temperature first, THEN the nucleus cut — the kept set must be computed
+    # on the tempered distribution (matches mlx_lm top_p_sampling semantics
+    # used at ref shard/utils.py:136).
+    filtered = top_p_filter(logits / safe_temp, params.top_p)
+    sampled = jax.random.categorical(key, filtered, axis=-1)
     token = jnp.where(params.temperature > 0, sampled, greedy)
     return token.astype(jnp.int32), logprobs
 
@@ -128,5 +136,14 @@ def update_recent_tokens(recent: jax.Array, token: jax.Array) -> jax.Array:
     return jnp.concatenate([recent[:, 1:], token[:, None]], axis=1)
 
 
-def init_recent_tokens(batch: int, window: int) -> jax.Array:
-    return jnp.full((batch, window), -1, jnp.int32)
+def init_recent_tokens(batch: int, window: int, prompt=None) -> jax.Array:
+    """Start the window from the prompt tail so the penalty applies to prompt
+    content immediately (ref seeds repetition_context from the prompt,
+    shard/utils.py:152-155). ``prompt``: optional (B, T) array-like."""
+    recent = jnp.full((batch, window), -1, jnp.int32)
+    if prompt is not None:
+        import numpy as _np
+
+        tail = _np.asarray(prompt, _np.int32)[:, -window:]
+        recent = recent.at[:, window - tail.shape[1] :].set(jnp.asarray(tail))
+    return recent

@@ -1,0 +1,573 @@
+"""OpenAI-compatible HTTP server with SSE streaming.
+
+Behavior-parity target is the reference's API front end
+(ref: shard/openai_api.py): ``POST /v1/completions`` and
+``POST /v1/chat/completions`` (routing ref :182-186), CORS headers
+(ref :137-141), static web-UI serving on GET (ref :157-176), request
+parameter validation (ref :252-294), chat-template prompt building with a
+plain role-mapped fallback (ref convert_chat :46-67), non-streaming
+responses with usage + token logprobs (ref :357-434), SSE streaming that
+buffers partial stop-sequences so a half-emitted stop word never reaches the
+client (ref :436-505), and a model provider that caches the loaded model and
+can hot-swap on request (ref ModelProvider :70-127).
+
+The execution engine underneath is the TPU stack: one resident
+``Generator``/``PipelineEngine`` whose compiled step programs are reused
+across requests — a request costs zero compiles. Generation is serialized by
+a lock (the honest version of the reference's single-threaded-HTTP-server
+concurrency story, SURVEY §5 "race detection"; here it is explicit instead
+of accidental).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from mlx_sharding_tpu.tokenizer_utils import (
+    StreamingDetokenizer,
+    sequence_overlap,
+    stopping_criteria,
+)
+
+logger = logging.getLogger(__name__)
+
+STATIC_DIR = Path(__file__).parent / "static"
+CONTENT_TYPES = {
+    ".html": "text/html",
+    ".js": "application/javascript",
+    ".css": "text/css",
+    ".json": "application/json",
+}
+
+
+def _encode_plain(tokenizer, text: str) -> list[int]:
+    """Encode without special tokens (stop sequences must match raw ids)."""
+    try:
+        return list(tokenizer.encode(text, add_special_tokens=False))
+    except TypeError:
+        return list(tokenizer.encode(text))
+
+
+def convert_chat(messages: list, role_mapping: Optional[dict] = None) -> str:
+    """Plain-text fallback prompt when the tokenizer has no chat template
+    (semantics of ref shard/openai_api.py:46-67)."""
+    default = {
+        "system_prompt": "A chat between a curious user and an artificial "
+        "intelligence assistant. The assistant follows the given rules no "
+        "matter what.",
+        "system": "ASSISTANT's RULE: ",
+        "user": "USER: ",
+        "assistant": "ASSISTANT: ",
+        "stop": "\n",
+    }
+    role_mapping = role_mapping or default
+    prompt = role_mapping.get("system_prompt", "")
+    for m in messages:
+        role = m["role"]
+        prefix = role_mapping.get(role, "")
+        stop = role_mapping.get("stop", "")
+        prompt += f"{prefix}{m['content']}{stop}"
+    prompt += role_mapping.get("assistant", "")
+    return prompt.rstrip()
+
+
+class ModelProvider:
+    """Loads and caches one model+tokenizer, swapping when a request names a
+    different one (ref shard/openai_api.py:70-127). Paths are validated to
+    stay under the working directory, as the reference does."""
+
+    def __init__(
+        self,
+        default_model: Optional[str] = None,
+        *,
+        start_layer: Optional[int] = None,
+        end_layer: Optional[int] = None,
+        num_stages: Optional[int] = None,
+        max_seq: int = 4096,
+        prefill_chunk: int = 256,
+        cache_dtype=None,
+        trust_remote_paths: bool = False,
+    ):
+        self.default_model = default_model
+        self.start_layer = start_layer
+        self.end_layer = end_layer
+        self.num_stages = num_stages
+        self.max_seq = max_seq
+        self.prefill_chunk = prefill_chunk
+        self.cache_dtype = cache_dtype
+        self.trust_remote_paths = trust_remote_paths
+        self._key: Optional[str] = None
+        self.generator = None
+        self.tokenizer = None
+        if default_model:
+            self.load("default_model")
+
+    def _validate(self, name: str) -> str:
+        if name == "default_model":
+            if not self.default_model:
+                raise ValueError(
+                    "no default model configured; request must name a model"
+                )
+            return self.default_model
+        # Only allow local paths inside CWD unless explicitly trusted
+        # (ref shard/openai_api.py:96-104 cwd-relative validation).
+        p = Path(name)
+        if not self.trust_remote_paths:
+            resolved = p.resolve()
+            if not str(resolved).startswith(str(Path.cwd().resolve())):
+                raise ValueError(f"model path {name!r} escapes the working directory")
+        return name
+
+    def load(self, name: str):
+        target = self._validate(name)
+        if self._key == target:
+            return self.generator, self.tokenizer
+        logger.info("loading model %s", target)
+        import jax.numpy as jnp
+
+        from mlx_sharding_tpu.generate import Generator
+        from mlx_sharding_tpu.loading import get_model_path, load_model
+
+        model, params = load_model(
+            target, self.start_layer, self.end_layer,
+            dtype=self.cache_dtype or jnp.bfloat16,
+        )
+        cache_dtype = self.cache_dtype or jnp.bfloat16
+        if self.num_stages and self.num_stages > 1:
+            from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+            from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+            generator = PipelineEngine(
+                model, params, pipeline_mesh(self.num_stages),
+                max_seq=self.max_seq, cache_dtype=cache_dtype,
+                prefill_chunk=self.prefill_chunk,
+            )
+        else:
+            generator = Generator(
+                model, params, max_seq=self.max_seq, cache_dtype=cache_dtype,
+                prefill_chunk=self.prefill_chunk,
+            )
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(str(get_model_path(target)))
+        self._set(target, generator, tokenizer)
+        return self.generator, self.tokenizer
+
+    def _set(self, key, generator, tokenizer):
+        self._key = key
+        self.generator = generator
+        self.tokenizer = tokenizer
+
+
+class APIHandler(BaseHTTPRequestHandler):
+    """One handler class per server instance, bound to its provider via a
+    factory (class attributes), as stdlib requires."""
+
+    provider: ModelProvider = None
+    gen_lock: threading.Lock = None
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------- helpers
+    def log_message(self, fmt, *args):
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    def _cors(self):
+        # ref shard/openai_api.py:137-141
+        self.send_header("Access-Control-Allow-Origin", "*")
+        self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
+        self.send_header("Access-Control-Allow-Headers", "Content-Type, Authorization")
+
+    def _json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self._cors()
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str):
+        self._json(code, {"error": {"message": message, "type": "invalid_request_error"}})
+
+    # ------------------------------------------------------------- routing
+    def do_OPTIONS(self):
+        self.send_response(204)
+        self._cors()
+        self.end_headers()
+
+    def do_GET(self):
+        # static web UI (ref shard/openai_api.py:157-176)
+        path = self.path.split("?")[0]
+        if path in ("/", "/index.html"):
+            path = "/index.html"
+        elif path == "/health":
+            return self._json(200, {"status": "ok"})
+        target = (STATIC_DIR / path.lstrip("/")).resolve()
+        if not str(target).startswith(str(STATIC_DIR.resolve())) or not target.is_file():
+            return self._error(404, f"not found: {self.path}")
+        body = target.read_bytes()
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPES.get(target.suffix, "application/octet-stream"))
+        self.send_header("Content-Length", str(len(body)))
+        self._cors()
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        route = self.path.split("?")[0]
+        handlers = {
+            "/v1/completions": self._handle_text_completion,
+            "/v1/chat/completions": self._handle_chat_completion,
+        }
+        if route not in handlers:
+            return self._error(404, f"unknown route {route}")
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            return self._error(400, "invalid JSON body")
+        try:
+            params = self._validate_params(body)
+        except ValueError as e:
+            return self._error(400, str(e))
+        try:
+            generator, tokenizer = self.provider.load(body.get("model", "default_model"))
+        except ValueError as e:
+            return self._error(400, str(e))
+        try:
+            handlers[route](body, params, generator, tokenizer)
+        except BrokenPipeError:
+            pass
+        except ValueError as e:  # bad request discovered late (e.g. KV capacity)
+            try:
+                self._error(400, str(e))
+            except Exception:
+                pass
+        except Exception as e:  # return a structured error, don't kill the conn
+            logger.exception("request failed")
+            try:
+                self._error(500, f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+    # ---------------------------------------------------------- validation
+    def _validate_params(self, body: dict) -> dict:
+        """Parameter extraction + validation (ref shard/openai_api.py:206-294,
+        same bounds)."""
+        p = {}
+        p["stream"] = bool(body.get("stream", False))
+        p["max_tokens"] = body.get("max_tokens", 100)
+        if not isinstance(p["max_tokens"], int) or p["max_tokens"] < 0:
+            raise ValueError("max_tokens must be a non-negative integer")
+        p["temperature"] = body.get("temperature", 0.0)
+        if not isinstance(p["temperature"], (int, float)) or p["temperature"] < 0:
+            raise ValueError("temperature must be a non-negative float")
+        p["top_p"] = body.get("top_p", 1.0)
+        if not isinstance(p["top_p"], (int, float)) or not 0 < p["top_p"] <= 1:
+            raise ValueError("top_p must be in (0, 1]")
+        rp = body.get("repetition_penalty")
+        if rp is not None and (not isinstance(rp, (int, float)) or rp <= 0):
+            raise ValueError("repetition_penalty must be a positive float")
+        p["repetition_penalty"] = rp
+        rcs = body.get("repetition_context_size", 20)
+        if not isinstance(rcs, int) or rcs < 1:
+            raise ValueError("repetition_context_size must be a positive integer")
+        p["repetition_context_size"] = rcs
+        logprobs = body.get("logprobs", -1)
+        if logprobs != -1 and not (0 < logprobs <= 10):
+            raise ValueError("logprobs must be between 1 and 10")
+        p["logprobs"] = logprobs
+        bias = body.get("logit_bias")
+        if bias is not None:
+            if not isinstance(bias, dict):
+                raise ValueError("logit_bias must be a token_id -> bias map")
+            try:
+                bias = {int(k): float(v) for k, v in bias.items()}
+            except (ValueError, TypeError):
+                raise ValueError("logit_bias keys must be token ids")
+        p["logit_bias"] = bias
+        stop = body.get("stop", [])
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list) or not all(isinstance(s, str) for s in stop):
+            raise ValueError("stop must be a string or list of strings")
+        p["stop_words"] = stop
+        p["seed"] = body.get("seed")
+        return p
+
+    # ------------------------------------------------------------- prompts
+    def _chat_prompt(self, body: dict, tokenizer) -> list[int]:
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise ValueError("messages must be a non-empty list")
+        if getattr(tokenizer, "chat_template", None):
+            return tokenizer.apply_chat_template(
+                messages, tokenize=True, add_generation_prompt=True
+            )
+        return tokenizer.encode(convert_chat(messages, body.get("role_mapping")))
+
+    # ----------------------------------------------------------- responses
+    @staticmethod
+    def _response_id() -> str:
+        return f"cmpl-{uuid.uuid4().hex[:24]}"
+
+    def _make_response(
+        self, *, rid, object_type, model, text=None, delta=None,
+        finish_reason=None, usage=None, logprobs=None,
+    ) -> dict:
+        # OpenAI schema builder (ref generate_response shard/openai_api.py:296-355)
+        choice = {"index": 0, "finish_reason": finish_reason, "logprobs": logprobs}
+        if object_type.startswith("chat"):
+            if delta is not None:
+                choice["delta"] = delta
+            else:
+                choice["message"] = {"role": "assistant", "content": text}
+        else:
+            choice["text"] = text if text is not None else ""
+        resp = {
+            "id": rid,
+            "object": object_type,
+            "created": int(time.time()),
+            "model": model,
+            "system_fingerprint": f"fp_{uuid.uuid4().hex[:10]}",
+            "choices": [choice],
+        }
+        if usage:
+            resp["usage"] = usage
+        return resp
+
+    # ----------------------------------------------------------- execution
+    def _run(self, body, params, generator, tokenizer, prompt_ids, chat: bool):
+        rid = self._response_id()
+        model_name = body.get("model", "default_model")
+        stop_id_sequences = [_encode_plain(tokenizer, s) for s in params["stop_words"]]
+        eos = getattr(tokenizer, "eos_token_id", None)
+        obj = "chat.completion" if chat else "text_completion"
+
+        gen_kwargs = dict(
+            temperature=params["temperature"],
+            top_p=params["top_p"],
+            repetition_penalty=params["repetition_penalty"],
+            repetition_context_size=params["repetition_context_size"],
+            logit_bias=params["logit_bias"],
+            seed=params["seed"],
+            max_tokens=params["max_tokens"],
+        )
+
+        with self.gen_lock:
+            if params["stream"]:
+                self._stream(
+                    rid, obj + ".chunk", model_name, generator, tokenizer,
+                    prompt_ids, stop_id_sequences, eos, chat, gen_kwargs,
+                )
+            else:
+                self._complete(
+                    rid, obj, model_name, generator, tokenizer, prompt_ids,
+                    stop_id_sequences, eos, chat, params["logprobs"], gen_kwargs,
+                )
+
+    def _complete(
+        self, rid, obj, model_name, generator, tokenizer, prompt_ids,
+        stop_id_sequences, eos, chat, want_logprobs, gen_kwargs,
+    ):
+        # non-streaming path (ref handle_completion shard/openai_api.py:357-434)
+        tokens: list[int] = []
+        token_logprobs: list[float] = []
+        top_logprobs: list[dict] = []
+        finish_reason = "length"
+        for token, logprobs in generator.generate_step(prompt_ids, **gen_kwargs):
+            if eos is not None and token == eos:
+                finish_reason = "stop"
+                break
+            tokens.append(token)
+            if want_logprobs > 0:
+                row = np.asarray(logprobs[0])
+                token_logprobs.append(float(row[token]))
+                top_idx = np.argsort(row)[::-1][:want_logprobs]
+                top_logprobs.append({int(i): float(row[i]) for i in top_idx})
+            stop = stopping_criteria(tokens, stop_id_sequences, None)
+            if stop.stop_met:
+                if stop.trim_length:
+                    tokens = tokens[: -stop.trim_length]
+                    if want_logprobs > 0:
+                        token_logprobs = token_logprobs[: -stop.trim_length]
+                        top_logprobs = top_logprobs[: -stop.trim_length]
+                finish_reason = "stop"
+                break
+        text = tokenizer.decode(tokens)
+        logprobs_payload = None
+        if want_logprobs > 0:
+            logprobs_payload = {
+                "token_logprobs": token_logprobs,
+                "top_logprobs": top_logprobs,
+                "tokens": tokens,
+            }
+        usage = {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": len(tokens),
+            "total_tokens": len(prompt_ids) + len(tokens),
+        }
+        self._json(
+            200,
+            self._make_response(
+                rid=rid, object_type=obj, model=model_name, text=text,
+                finish_reason=finish_reason, usage=usage, logprobs=logprobs_payload,
+            ),
+        )
+
+    def _stream(
+        self, rid, obj, model_name, generator, tokenizer, prompt_ids,
+        stop_id_sequences, eos, chat, gen_kwargs,
+    ):
+        # SSE with partial-stop-word buffering (ref handle_stream
+        # shard/openai_api.py:436-505): if the current token tail could still
+        # grow into a stop sequence, hold the text back.
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        # SSE has no Content-Length; end-of-stream is signalled by closing
+        # the connection after [DONE].
+        self.send_header("Connection", "close")
+        self._cors()
+        self.end_headers()
+
+        def emit(payload: dict):
+            self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
+            self.wfile.flush()
+
+        if chat:
+            emit(
+                self._make_response(
+                    rid=rid, object_type=obj, model=model_name,
+                    delta={"role": "assistant", "content": ""},
+                )
+            )
+
+        detok = StreamingDetokenizer(tokenizer)
+        tokens: list[int] = []
+        in_flight: list[int] = []  # tokens withheld due to stop-prefix overlap
+        finish_reason = "length"
+        for token, _ in generator.generate_step(prompt_ids, **gen_kwargs):
+            if eos is not None and token == eos:
+                finish_reason = "stop"
+                break
+            tokens.append(token)
+            stop = stopping_criteria(tokens, stop_id_sequences, None)
+            if stop.stop_met:
+                finish_reason = "stop"
+                in_flight.clear()
+                break
+            if any(sequence_overlap(tokens, s) for s in stop_id_sequences):
+                in_flight.append(token)
+                continue
+            for t in in_flight:
+                detok.add_token(t)
+            in_flight.clear()
+            detok.add_token(token)
+            if detok.last_segment:
+                delta = {"content": detok.last_segment}
+                emit(
+                    self._make_response(
+                        rid=rid, object_type=obj, model=model_name,
+                        **({"delta": delta} if chat else {"text": detok.last_segment}),
+                    )
+                )
+        # a length-finished run that was still buffering emits the buffered
+        # tokens — they never completed a stop sequence
+        for t in in_flight:
+            detok.add_token(t)
+        detok.finalize()
+        if detok.last_segment:
+            emit(
+                self._make_response(
+                    rid=rid, object_type=obj, model=model_name,
+                    **(
+                        {"delta": {"content": detok.last_segment}}
+                        if chat
+                        else {"text": detok.last_segment}
+                    ),
+                )
+            )
+        emit(
+            self._make_response(
+                rid=rid, object_type=obj, model=model_name,
+                **({"delta": {}} if chat else {"text": ""}),
+                finish_reason=finish_reason,
+            )
+        )
+        self.wfile.write(b"data: [DONE]\n\n")
+        self.wfile.flush()
+        self.close_connection = True
+
+    # ------------------------------------------------------------ handlers
+    def _handle_chat_completion(self, body, params, generator, tokenizer):
+        prompt_ids = self._chat_prompt(body, tokenizer)
+        self._run(body, params, generator, tokenizer, list(prompt_ids), chat=True)
+
+    def _handle_text_completion(self, body, params, generator, tokenizer):
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            return self._error(400, "prompt must be a non-empty string")
+        prompt_ids = tokenizer.encode(prompt)
+        self._run(body, params, generator, tokenizer, list(prompt_ids), chat=False)
+
+
+def make_server(provider: ModelProvider, host: str = "127.0.0.1", port: int = 8080):
+    handler = type(
+        "BoundAPIHandler",
+        (APIHandler,),
+        {"provider": provider, "gen_lock": threading.Lock()},
+    )
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description="OpenAI-compatible API server")
+    parser.add_argument("--model", default=None, help="default model path/repo")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--start-layer", type=int, default=None)
+    parser.add_argument("--end-layer", type=int, default=None)
+    parser.add_argument("--num-stages", type=int, default=None,
+                        help="pipeline stages on the local mesh")
+    parser.add_argument("--max-seq", type=int, default=4096)
+    parser.add_argument("--prefill-chunk", type=int, default=256)
+    parser.add_argument("--log-level", default="INFO")
+    # multi-host (DCN) bring-up — the jax.distributed control plane
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port of jax.distributed coordinator")
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--num-processes", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=args.log_level.upper())
+    if args.coordinator:
+        import jax
+
+        jax.distributed.initialize(
+            args.coordinator, num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    provider = ModelProvider(
+        args.model, start_layer=args.start_layer, end_layer=args.end_layer,
+        num_stages=args.num_stages, max_seq=args.max_seq,
+        prefill_chunk=args.prefill_chunk,
+    )
+    server = make_server(provider, args.host, args.port)
+    logger.info("serving on http://%s:%d", args.host, args.port)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

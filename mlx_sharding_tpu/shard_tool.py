@@ -24,20 +24,13 @@ import shutil
 from pathlib import Path
 
 from mlx_sharding_tpu.config import config_from_dict
-from mlx_sharding_tpu.loading import filter_stage_weights, get_model_path
+from mlx_sharding_tpu.loading import (
+    filter_stage_weights,
+    get_model_path,
+    load_raw_weights,
+)
 
 _AUX_SKIP_SUFFIXES = (".safetensors", ".safetensors.index.json")
-
-
-def _load_all_tensors(model_path: Path):
-    from safetensors import safe_open
-
-    tensors = {}
-    for file in sorted(model_path.glob("*.safetensors")):
-        with safe_open(file, framework="flax") as f:
-            for k in f.keys():
-                tensors[k] = f.get_tensor(k)
-    return tensors
 
 
 def save_sharded_weights(
@@ -60,7 +53,7 @@ def save_sharded_weights(
     config_dict["end_layer"] = end_layer
     config = config_from_dict(dict(config_dict))
 
-    weights = _load_all_tensors(model_path)
+    weights = load_raw_weights(model_path)
     kept = filter_stage_weights(weights, config)
 
     from safetensors.flax import save_file

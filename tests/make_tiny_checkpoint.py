@@ -1,0 +1,63 @@
+"""Create a fully self-contained tiny Llama checkpoint + byte-level BPE
+tokenizer on disk (no network): the fixture that lets the CLI / server /
+loader run the exact end-user path offline.
+
+Usage: python tests/make_tiny_checkpoint.py [outdir]
+"""
+
+import json
+import sys
+from pathlib import Path
+
+
+def make_tiny_checkpoint(outdir: str | Path, vocab_size: int = 384) -> Path:
+    outdir = Path(outdir)
+    outdir.mkdir(parents=True, exist_ok=True)
+
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    corpus = [
+        "the quick brown fox jumps over the lazy dog. ",
+        "hello world, this is a tiny corpus for a tiny tokenizer. ",
+        "pipelines run on meshes; stages pass activations over rings. ",
+        "0123456789 !?,.:;()[]{}<>+-*/=\n",
+    ] * 50
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["<eos>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(str(outdir / "tokenizer.json"))
+    (outdir / "tokenizer_config.json").write_text(
+        json.dumps(
+            {"tokenizer_class": "PreTrainedTokenizerFast", "eos_token": "<eos>"}
+        )
+    )
+
+    import torch
+    import transformers
+
+    torch.manual_seed(7)
+    cfg = transformers.LlamaConfig(
+        vocab_size=tok.get_vocab_size(),
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=1024,
+        eos_token_id=0,
+    )
+    model = transformers.LlamaForCausalLM(cfg)
+    model.save_pretrained(outdir, safe_serialization=True)
+    return outdir
+
+
+if __name__ == "__main__":
+    out = make_tiny_checkpoint(sys.argv[1] if len(sys.argv) > 1 else "/tmp/tiny_llama_ckpt")
+    print(out)

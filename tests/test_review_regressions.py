@@ -1,0 +1,127 @@
+"""Regression tests for code-review findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.generate import Generator, stream_generate
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.sample import make_sampler_params, sample_token, init_recent_tokens
+
+TINY = dict(
+    vocab_size=300,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+
+def _gen(**kw):
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    return Generator(model, params, cache_dtype=jnp.float32, **kw)
+
+
+def test_non_multiple_max_seq_rounds_up_and_stays_correct():
+    """max_seq=20 with chunk=8 rounds to 24; a 19-token prompt + decode must
+    match a generator whose chunk swallows the prompt whole."""
+    g1 = _gen(max_seq=20, prefill_chunk=8)
+    assert g1.max_seq == 24
+    g2 = _gen(max_seq=24, prefill_chunk=32)
+    prompt = list(range(1, 20))
+    a = [t for t, _ in g1.generate_step(prompt, max_tokens=4)]
+    b = [t for t, _ in g2.generate_step(prompt, max_tokens=4)]
+    assert a == b
+
+
+def test_repetition_penalty_sees_prompt():
+    """The window is seeded with the prompt tail: a token prominent in the
+    prompt gets penalized on the very first generated token."""
+    recent = init_recent_tokens(1, 8, np.asarray([[7, 7, 7]], np.int32))
+    np.testing.assert_array_equal(np.asarray(recent)[0, -3:], [7, 7, 7])
+    logits = jnp.zeros((1, 16)).at[0, 7].set(1.0).at[0, 3].set(0.9)
+    sp = make_sampler_params(temperature=0.0, repetition_penalty=3.0)
+    tok, _ = sample_token(jax.random.PRNGKey(0), logits, sp, recent)
+    assert int(tok[0]) == 3  # 7 would win without the prompt-seeded penalty
+
+
+def test_top_p_applies_after_temperature():
+    """At high temperature the tempered distribution is flatter, so more
+    tokens stay inside the nucleus than at temp≈0+."""
+    logits = jnp.log(jnp.asarray([[0.70, 0.20, 0.06, 0.04]]))
+    sp_hot = make_sampler_params(temperature=4.0, top_p=0.8)
+    # sample many times at hot temperature; token 2 (outside the temp=1
+    # nucleus {0,1}: 0.9 >= 0.8) must appear because tempering flattens mass
+    toks = {
+        int(sample_token(jax.random.PRNGKey(i), logits, sp_hot)[0][0])
+        for i in range(64)
+    }
+    assert 2 in toks
+
+
+def test_logit_bias_beyond_16_entries():
+    bias = {i: -100.0 for i in range(24)}  # ban tokens 0..23
+    bias[25] = 50.0
+    sp = make_sampler_params(temperature=0.0, logit_bias=bias)
+    logits = jnp.zeros((1, 32)).at[0, 23].set(10.0)  # would win if bias dropped
+    tok, _ = sample_token(jax.random.PRNGKey(0), logits, sp)
+    assert int(tok[0]) == 25
+
+
+def test_stream_stop_prefix_never_leaks():
+    """A multi-token stop sequence's prefix must not be emitted."""
+    from tests.test_tokenizer_utils import ByteTokenizer
+
+    g = _gen(max_seq=64, prefill_chunk=8)
+    tok = ByteTokenizer()
+    prompt = tok.encode("m")
+    ref = [t for t, _ in g.generate_step(prompt, max_tokens=8)]
+    # stop on tokens 2..3 of the greedy continuation
+    stop = [ref[2], ref[3]]
+    chunks = list(
+        stream_generate(
+            g, tok, prompt, max_tokens=8,
+            stop_id_sequences=[stop], eos_token_ids=[],
+        )
+    )
+    streamed = "".join(c.text for c in chunks)
+    stop_text = tok.decode(stop)
+    if stop_text.strip():  # only meaningful when stop decodes to visible text
+        assert stop_text not in streamed
+    assert chunks[-1].finish_reason == "stop"
+
+
+def test_qwen2_bias_parity():
+    """Qwen2 (attention_bias=True) checkpoints load their QKV biases and
+    match HF logits."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    import tempfile
+
+    from mlx_sharding_tpu.loading import load_model
+
+    with tempfile.TemporaryDirectory() as td:
+        torch.manual_seed(3)
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, rope_theta=10000.0,
+            tie_word_embeddings=False,
+        )
+        hf = transformers.Qwen2ForCausalLM(cfg)
+        hf.eval()
+        hf.save_pretrained(td, safe_serialization=True)
+
+        tokens = [[5, 77, 23, 9]]
+        with torch.no_grad():
+            ref = hf(torch.tensor(tokens)).logits.numpy()
+        model, params = load_model(td, dtype=jnp.float32)
+        assert "q_bias" in params["layers"]
+        got, _ = model(
+            params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 16, jnp.float32)
+        )
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-3, atol=2e-3)
