@@ -1,0 +1,166 @@
+"""Mixtral (sparse MoE Llama) decoder.
+
+BASELINE.json config #4 names Mixtral-8x7B with "expert routing inside
+stage" — experts stay stage-local exactly as the reference treats MoE
+(SURVEY §2.3 "EP": fused and replicated within the owning stage; the
+reference itself only ships DeepSeek-V2's MoE, deepseek_v2.py:101-112).
+Attention/norm structure is Llama's; the MLP is a top-2 router over 8 SwiGLU
+experts (HF semantics: softmax over all logits → top-k → renormalize).
+Expert weights are stacked (L, E, H, I) so the layer scan + expert
+scan/gather dispatch (ops/moe.py) run with static shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mlx_sharding_tpu.cache import KVCache, advance, write_layer_kv
+from mlx_sharding_tpu.config import MixtralConfig
+from mlx_sharding_tpu.models.base import BaseModel, dense_init, stack_layers
+from mlx_sharding_tpu.ops import apply_rope, causal_attention, rms_norm, rope_frequencies
+from mlx_sharding_tpu.ops.moe import apply_experts, mixtral_routing
+
+
+class MixtralModel(BaseModel):
+    def __init__(self, config: MixtralConfig):
+        super().__init__(config)
+        self.inv_freq = jnp.asarray(
+            rope_frequencies(config.head_dim, config.rope_theta, config.rope_scaling)
+        )
+        self.scale = config.head_dim**-0.5
+
+    # ------------------------------------------------------------------
+    def _layer(self, h, p, k_buf, v_buf, offset):
+        cfg = self.config
+        b, t, hidden = h.shape
+        hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+        r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
+        q = (r @ p["q_proj"]).reshape(b, t, hq, d)
+        k = (r @ p["k_proj"]).reshape(b, t, hkv, d)
+        v = (r @ p["v_proj"]).reshape(b, t, hkv, d)
+        q = apply_rope(q, self.inv_freq, offset)
+        k = apply_rope(k, self.inv_freq, offset)
+        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
+        attn = causal_attention(
+            q, k_buf, v_buf, offset, self.scale,
+            sliding_window=cfg.sliding_window,
+        )
+        h = h + attn.reshape(b, t, -1) @ p["o_proj"]
+
+        r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
+        flat = r.reshape(b * t, hidden)
+        weights, idx = mixtral_routing(flat, p["router"], cfg.num_experts_per_tok)
+        moe = apply_experts(flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"])
+        return h + moe.reshape(b, t, hidden), k_buf, v_buf
+
+    def run_layers(self, layer_params, h, k, v, offset):
+        def body(h, xs):
+            p, k_buf, v_buf = xs
+            h, k_buf, v_buf = self._layer(h, p, k_buf, v_buf, offset)
+            return h, (k_buf, v_buf)
+
+        h, (k, v) = jax.lax.scan(body, h, (layer_params, k, v))
+        return h, k, v
+
+    def apply_head(self, params, h):
+        cfg = self.config
+        h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
+        if cfg.tie_word_embeddings:
+            return h @ params["embed"]["weight"].T
+        return h @ params["lm_head"]["weight"]
+
+    def __call__(self, params, x, cache: KVCache, n_valid=None):
+        cfg = self.config
+        h = self.embed(params, x) if cfg.is_first_stage else x
+        offset = cache.offset
+        h, k, v = self.run_layers(params["layers"], h, cache.k, cache.v, offset)
+        cache = KVCache(k=k, v=v, offset=offset)
+        cache = advance(cache, x.shape[1] if n_valid is None else n_valid)
+        if cfg.is_last_stage:
+            return self.apply_head(params, h), cache
+        return h, cache
+
+    def embed(self, params, tokens):
+        return self.embed_tokens(params, tokens)
+
+    # ------------------------------------------------------------------
+    HF_LAYER_MAP = {
+        "input_layernorm.weight": ("input_norm", False),
+        "post_attention_layernorm.weight": ("post_norm", False),
+        "self_attn.q_proj.weight": ("q_proj", True),
+        "self_attn.k_proj.weight": ("k_proj", True),
+        "self_attn.v_proj.weight": ("v_proj", True),
+        "self_attn.o_proj.weight": ("o_proj", True),
+        "block_sparse_moe.gate.weight": ("router", True),
+    }
+
+    def map_weights(self, weights: dict, dtype=jnp.bfloat16) -> dict:
+        """Per-expert w1/w2/w3 tensors are stacked into fused (L, E, …)
+        switch tensors — the same fusion the reference performs in sanitize
+        (deepseek_v2.py:101-112), applied at load time."""
+        from mlx_sharding_tpu.loading import collect_layer_stack, first_key
+
+        cfg = self.config
+        layers = collect_layer_stack(weights, cfg, self.HF_LAYER_MAP, dtype)
+
+        def expert_stack(which: str):
+            per_layer = []
+            for i in range(cfg.start_layer, cfg.end_layer):
+                per_expert = [
+                    jnp.asarray(
+                        weights[
+                            f"model.layers.{i}.block_sparse_moe.experts.{e}.{which}.weight"
+                        ],
+                        dtype,
+                    ).T
+                    for e in range(cfg.num_local_experts)
+                ]
+                per_layer.append(jnp.stack(per_expert))
+            return jnp.stack(per_layer)  # (L, E, in, out)
+
+        layers["w_gate"] = expert_stack("w1")
+        layers["w_up"] = expert_stack("w3")
+        layers["w_down"] = expert_stack("w2")
+        params = {"layers": layers}
+        if cfg.needs_embed:
+            embed = first_key(weights, "model.embed_tokens.weight", "embed_tokens.weight")
+            params["embed"] = {"weight": jnp.asarray(embed, dtype)}
+        if cfg.needs_head:
+            norm = first_key(weights, "model.norm.weight", "norm.weight")
+            params["final_norm"] = {"weight": jnp.asarray(norm, dtype)}
+            if not cfg.tie_word_embeddings:
+                params["lm_head"] = {"weight": jnp.asarray(weights["lm_head.weight"], dtype).T}
+        return params
+
+    def init_params(self, key, dtype=jnp.bfloat16):
+        cfg = self.config
+        hd, hq, hkv, d = cfg.hidden_size, cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        inter, nl, ne = cfg.intermediate_size, cfg.num_local_layers, cfg.num_local_experts
+        keys = iter(jax.random.split(key, (8 + 3 * ne) * nl + 4))
+
+        def layer():
+            return {
+                "input_norm": jnp.ones((hd,), dtype),
+                "post_norm": jnp.ones((hd,), dtype),
+                "q_proj": dense_init(next(keys), hd, hq * d, dtype),
+                "k_proj": dense_init(next(keys), hd, hkv * d, dtype),
+                "v_proj": dense_init(next(keys), hd, hkv * d, dtype),
+                "o_proj": dense_init(next(keys), hq * d, hd, dtype),
+                "router": dense_init(next(keys), hd, ne, dtype),
+                "w_gate": jnp.stack([dense_init(next(keys), hd, inter, dtype) for _ in range(ne)]),
+                "w_up": jnp.stack([dense_init(next(keys), hd, inter, dtype) for _ in range(ne)]),
+                "w_down": jnp.stack([dense_init(next(keys), inter, hd, dtype) for _ in range(ne)]),
+            }
+
+        params = {"layers": stack_layers([layer() for _ in range(nl)])}
+        if cfg.needs_embed:
+            params["embed"] = {
+                "weight": dense_init(next(keys), cfg.vocab_size, hd, dtype, scale=0.02)
+            }
+        if cfg.needs_head:
+            params["final_norm"] = {"weight": jnp.ones((hd,), dtype)}
+            if not cfg.tie_word_embeddings:
+                params["lm_head"] = {"weight": dense_init(next(keys), hd, cfg.vocab_size, dtype)}
+        return params

@@ -30,7 +30,7 @@ def causal_attention(
     scale: float,
     *,
     logit_softcap: Optional[float] = None,  # gemma2.py attn softcapping
-    sliding_window: Optional[int] = None,  # gemma-2 local layers
+    sliding_window=None,  # int or traced scalar — gemma-2 alternating layers
     sinks: Optional[jax.Array] = None,  # reserved for attention-sink variants
 ) -> jax.Array:
     """Returns (B, T, Hq, Dv). Keys at positions > query position (or outside

@@ -1,0 +1,85 @@
+"""Mixture-of-experts dispatch.
+
+The reference keeps experts fused and stage-local — per-expert weights are
+stacked into one ``switch_mlp`` tensor at load time
+(ref: shard/server/model/deepseek_v2.py:101-112) and routing happens inside
+the owning pipeline stage (SURVEY §2.3 "EP"). Same policy here, with two
+TPU execution paths chosen by token count at trace time:
+
+- **decode (few tokens)**: gather the top-k experts' weights per token and
+  batch the tiny matmuls — HBM traffic is k/E of the expert weights, which
+  is what decode is bound by;
+- **prefill (many tokens)**: ``lax.scan`` over experts with masked
+  accumulation — every matmul is a full-width MXU op with static shapes, no
+  sorting, no capacity overflow. (A Pallas ragged-dispatch kernel is the
+  planned upgrade for very large E.)
+
+Routing is parameterized so Mixtral (softmax→topk→renorm) and DeepSeek-V2
+(softmax scoring→greedy topk, optional renorm + scaling factor) share the
+dispatch machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+GATHER_PATH_MAX_TOKENS = 16
+
+
+def mixtral_routing(x, router_w, k: int):
+    """HF Mixtral semantics: softmax over ALL expert logits, take top-k,
+    renormalize the kept mass. Returns (weights (N,K) f32, idx (N,K))."""
+    logits = (x @ router_w).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topv = topv / topv.sum(axis=-1, keepdims=True)
+    return topv, topi
+
+
+def deepseek_routing(
+    x, router_w, k: int, *, norm_topk_prob: bool, routed_scaling_factor: float
+):
+    """DeepSeek-V2 'greedy' top-k over softmax scores (no renorm unless
+    norm_topk_prob), scaled by routed_scaling_factor."""
+    logits = (x @ router_w).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)
+    if norm_topk_prob:
+        topv = topv / (topv.sum(axis=-1, keepdims=True) + 1e-20)
+    return topv * routed_scaling_factor, topi
+
+
+def apply_experts(x, weights, idx, w_gate, w_up, w_down):
+    """SwiGLU expert application. x (N, H); w_* stacked (E, H, I)/(E, I, H);
+    weights/idx (N, K). Returns (N, H)."""
+    n = x.shape[0]
+    if n <= GATHER_PATH_MAX_TOKENS:
+        return _apply_gather(x, weights, idx, w_gate, w_up, w_down)
+    return _apply_scan(x, weights, idx, w_gate, w_up, w_down)
+
+
+def _apply_gather(x, weights, idx, w_gate, w_up, w_down):
+    wg = w_gate[idx]  # (N, K, H, I)
+    wu = w_up[idx]
+    wd = w_down[idx]  # (N, K, I, H)
+    g = jnp.einsum("nh,nkhi->nki", x, wg)
+    u = jnp.einsum("nh,nkhi->nki", x, wu)
+    y = jnp.einsum("nki,nkih->nkh", jax.nn.silu(g) * u, wd)
+    return (y * weights[..., None].astype(y.dtype)).sum(axis=1).astype(x.dtype)
+
+
+def _apply_scan(x, weights, idx, w_gate, w_up, w_down):
+    num_experts = w_gate.shape[0]
+
+    def body(acc, xs):
+        wg, wu, wd, e = xs
+        coef = ((idx == e) * weights).sum(axis=-1)  # (N,) routing mass for e
+        y = (jax.nn.silu(x @ wg) * (x @ wu)) @ wd
+        return acc + coef[:, None].astype(y.dtype) * y, None
+
+    acc0 = jnp.zeros_like(x)
+    acc, _ = jax.lax.scan(
+        body, acc0, (w_gate, w_up, w_down, jnp.arange(num_experts))
+    )
+    return acc

@@ -1,0 +1,78 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.config import Gemma2Config
+from mlx_sharding_tpu.loading import load_model
+from mlx_sharding_tpu.models.gemma2 import Gemma2Model
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+TINY_HF = dict(
+    vocab_size=160,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=4,  # covers both sliding (even) and global (odd) layers
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    max_position_embeddings=128,
+    rms_norm_eps=1e-6,
+    query_pre_attn_scalar=16,
+    sliding_window=8,  # small so the window actually bites in tests
+    attn_logit_softcapping=50.0,
+    final_logit_softcapping=30.0,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tiny_gemma2")
+    torch.manual_seed(5)
+    model = transformers.Gemma2ForCausalLM(transformers.Gemma2Config(**TINY_HF))
+    model.eval()
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model
+
+
+def test_logits_parity_full(hf_checkpoint):
+    path, hf_model = hf_checkpoint
+    tokens = [[2, 45, 99, 3, 27, 81, 5, 9, 101, 33, 72, 4]]  # > sliding_window
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    model, params = load_model(str(path), dtype=jnp.float32)
+    got, _ = model(
+        params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 32, jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
+
+
+def test_two_stage_parity_tied_embed_on_last(hf_checkpoint):
+    """Gemma-2's tied head means the LAST stage needs the embedding too
+    (ref gemma2.py:23-24)."""
+    path, hf_model = hf_checkpoint
+    tokens = [[7, 8, 9, 10]]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    s0, p0 = load_model(str(path), start_layer=0, end_layer=2, dtype=jnp.float32)
+    s1, p1 = load_model(str(path), start_layer=2, end_layer=4, dtype=jnp.float32)
+    assert "embed" in p0 and "embed" in p1  # both stages carry it
+    h, _ = s0(p0, jnp.asarray(tokens, jnp.int32), s0.make_cache(1, 16, jnp.float32))
+    got, _ = s1(p1, h, s1.make_cache(1, 16, jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
+
+
+def test_prefill_equals_decode(hf_checkpoint):
+    path, _ = hf_checkpoint
+    model, params = load_model(str(path), dtype=jnp.float32)
+    tokens = jnp.asarray([[2, 17, 42, 9, 77, 23, 55, 12, 90, 31]], jnp.int32)
+    full, _ = model(params, tokens, model.make_cache(1, 16, jnp.float32))
+    cache = model.make_cache(1, 16, jnp.float32)
+    outs = []
+    for i in range(tokens.shape[1]):
+        logits, cache = model(params, tokens[:, i : i + 1], cache)
+        outs.append(logits[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(got), rtol=2e-3, atol=2e-3)

@@ -1,0 +1,96 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.loading import load_model
+from mlx_sharding_tpu.ops.moe import _apply_gather, _apply_scan, mixtral_routing
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+TINY_HF = dict(
+    vocab_size=160,
+    hidden_size=64,
+    intermediate_size=96,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    num_local_experts=4,
+    num_experts_per_tok=2,
+    max_position_embeddings=128,
+    rms_norm_eps=1e-5,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tiny_mixtral")
+    torch.manual_seed(11)
+    model = transformers.MixtralForCausalLM(transformers.MixtralConfig(**TINY_HF))
+    model.eval()
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model
+
+
+def test_moe_gather_matches_scan():
+    rng = np.random.default_rng(0)
+    n, h, i, e, k = 4, 8, 16, 4, 2
+    x = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
+    router = jnp.asarray(rng.normal(size=(h, e)), jnp.float32)
+    wg = jnp.asarray(rng.normal(size=(e, h, i)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.normal(size=(e, h, i)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.normal(size=(e, i, h)), jnp.float32) * 0.1
+    weights, idx = mixtral_routing(x, router, k)
+    a = _apply_gather(x, weights, idx, wg, wu, wd)
+    b = _apply_scan(x, weights, idx, wg, wu, wd)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_routing_normalizes_topk():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(3, 8)), jnp.float32)
+    router = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+    weights, idx = mixtral_routing(x, router, 2)
+    np.testing.assert_allclose(np.asarray(weights.sum(-1)), np.ones(3), rtol=1e-5)
+    assert np.asarray(idx).max() < 4
+
+
+def test_logits_parity_full(hf_checkpoint):
+    path, hf_model = hf_checkpoint
+    tokens = [[2, 45, 99, 3, 27, 81, 5]]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    model, params = load_model(str(path), dtype=jnp.float32)
+    got, _ = model(
+        params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 16, jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
+
+
+def test_decode_path_matches_prefill_path(hf_checkpoint):
+    """The gather (decode) and scan (prefill) MoE paths must agree through
+    the full model: feeding tokens one-by-one == one prefill call."""
+    path, _ = hf_checkpoint
+    model, params = load_model(str(path), dtype=jnp.float32)
+    tokens = jnp.asarray([list(range(2, 2 + 20))], jnp.int32)  # 20 > gather cap
+    full, _ = model(params, tokens, model.make_cache(1, 32, jnp.float32))
+    cache = model.make_cache(1, 32, jnp.float32)
+    outs = []
+    for i in range(tokens.shape[1]):
+        logits, cache = model(params, tokens[:, i : i + 1], cache)
+        outs.append(logits[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(got), rtol=2e-3, atol=2e-3)
+
+
+def test_two_stage_parity(hf_checkpoint):
+    path, hf_model = hf_checkpoint
+    tokens = [[5, 9, 2, 7]]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    s0, p0 = load_model(str(path), start_layer=0, end_layer=2, dtype=jnp.float32)
+    s1, p1 = load_model(str(path), start_layer=2, end_layer=3, dtype=jnp.float32)
+    h, _ = s0(p0, jnp.asarray(tokens, jnp.int32), s0.make_cache(1, 16, jnp.float32))
+    got, _ = s1(p1, h, s1.make_cache(1, 16, jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
