@@ -128,11 +128,14 @@ class DeepseekV2Config(BaseConfig):
     qk_nope_head_dim: int = 128
     v_head_dim: int = 128
     topk_method: str = "greedy"
+    n_group: int = 1
+    topk_group: int = 1
     scoring_func: str = "softmax"
     norm_topk_prob: bool = False
     num_experts_per_tok: int = 6
     moe_layer_freq: int = 1
     first_k_dense_replace: int = 1
+    attention_bias: bool = False
     max_position_embeddings: int = 163840
     rope_theta: float = 10000.0
 

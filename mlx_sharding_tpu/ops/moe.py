@@ -38,13 +38,36 @@ def mixtral_routing(x, router_w, k: int):
 
 
 def deepseek_routing(
-    x, router_w, k: int, *, norm_topk_prob: bool, routed_scaling_factor: float
+    x,
+    router_w,
+    k: int,
+    *,
+    norm_topk_prob: bool,
+    routed_scaling_factor: float,
+    topk_method: str = "greedy",
+    n_group: int = 1,
+    topk_group: int = 1,
 ):
-    """DeepSeek-V2 'greedy' top-k over softmax scores (no renorm unless
-    norm_topk_prob), scaled by routed_scaling_factor."""
-    logits = (x @ router_w).astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    topv, topi = jax.lax.top_k(probs, k)
+    """DeepSeek-V2 gate: softmax scores in fp32, then 'greedy' top-k
+    (V2-Lite) or 'group_limited_greedy' (V2/V2-Chat: keep only the
+    topk_group expert groups with the highest per-group max score, then
+    top-k within them), scaled by routed_scaling_factor."""
+    logits = jnp.einsum(
+        "nh,he->ne", x.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    scores = jax.nn.softmax(logits, axis=-1)
+    if topk_method == "group_limited_greedy":
+        n, e = scores.shape
+        group_scores = scores.reshape(n, n_group, e // n_group).max(axis=-1)
+        _, group_idx = jax.lax.top_k(group_scores, topk_group)  # (N, topk_group)
+        group_mask = jnp.zeros_like(group_scores).at[
+            jnp.arange(n)[:, None], group_idx
+        ].set(1.0)
+        score_mask = jnp.repeat(group_mask, e // n_group, axis=-1)
+        scores = scores * score_mask
+    elif topk_method != "greedy":
+        raise ValueError(f"unknown topk_method {topk_method!r}")
+    topv, topi = jax.lax.top_k(scores, k)
     if norm_topk_prob:
         topv = topv / (topv.sum(axis=-1, keepdims=True) + 1e-20)
     return topv * routed_scaling_factor, topi

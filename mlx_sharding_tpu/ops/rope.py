@@ -62,6 +62,63 @@ def rope_frequencies(
     return inv_freq.astype(np.float32)
 
 
+def yarn_frequencies(
+    head_dim: int,
+    theta: float,
+    rope_scaling: dict,
+    max_position_embeddings: int,
+) -> tuple[np.ndarray, float]:
+    """YaRN (NTK-by-parts) frequencies + attention scaling factor, following
+    the published YaRN recipe (paper 2309.00071) with DeepSeek's
+    mscale/mscale_all_dim attention-factor variant. Used by DeepSeek-V2
+    checkpoints (rope_scaling.type == "yarn")."""
+    dim = head_dim
+    factor = float(rope_scaling["factor"])
+    attention_factor = rope_scaling.get("attention_factor")
+    mscale = rope_scaling.get("mscale")
+    mscale_all_dim = rope_scaling.get("mscale_all_dim")
+    orig_max = float(
+        rope_scaling.get("original_max_position_embeddings")
+        or max_position_embeddings
+    )
+    beta_fast = float(rope_scaling.get("beta_fast") or 32)
+    beta_slow = float(rope_scaling.get("beta_slow") or 1)
+
+    def get_mscale(scale, m=1.0):
+        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+    if attention_factor is None:
+        if mscale and mscale_all_dim:
+            attention_factor = get_mscale(factor, mscale) / get_mscale(
+                factor, mscale_all_dim
+            )
+        else:
+            attention_factor = get_mscale(factor)
+
+    def correction_dim(num_rotations):
+        return (dim * math.log(orig_max / (num_rotations * 2 * math.pi))) / (
+            2 * math.log(theta)
+        )
+
+    low = max(math.floor(correction_dim(beta_fast)), 0)
+    high = min(math.ceil(correction_dim(beta_slow)), dim - 1)
+    if low == high:
+        high += 0.001
+
+    pos_freqs = theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    extrapolation = 1.0 / pos_freqs
+    interpolation = 1.0 / (factor * pos_freqs)
+    ramp = np.clip(
+        (np.arange(dim // 2, dtype=np.float64) - low) / (high - low), 0.0, 1.0
+    )
+    extrapolation_factor = 1.0 - ramp
+    inv_freq = (
+        interpolation * (1 - extrapolation_factor)
+        + extrapolation * extrapolation_factor
+    )
+    return inv_freq.astype(np.float32), float(attention_factor)
+
+
 def _rotate_half(x):
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
@@ -70,7 +127,8 @@ def _rotate_half(x):
 
 def apply_rope(x: jax.Array, inv_freq: jax.Array, offset) -> jax.Array:
     """Rotate ``x`` of shape (B, T, H, D) for absolute positions
-    ``offset .. offset+T``. float32 trig, result in x.dtype."""
+    ``offset .. offset+T``. float32 trig, result in x.dtype. Split-half
+    (HF rotate_half) convention."""
     t = x.shape[1]
     positions = jnp.asarray(offset, jnp.float32) + jnp.arange(t, dtype=jnp.float32)
     angles = positions[:, None] * inv_freq[None, :]  # (T, D/2)
@@ -80,3 +138,22 @@ def apply_rope(x: jax.Array, inv_freq: jax.Array, offset) -> jax.Array:
     x32 = x.astype(jnp.float32)
     out = x32 * cos + _rotate_half(x32) * sin
     return out.astype(x.dtype)
+
+
+def apply_rope_interleaved(
+    x: jax.Array, inv_freq: jax.Array, offset, scaling: float = 1.0
+) -> jax.Array:
+    """Complex-pair rotation: adjacent element pairs (2i, 2i+1) rotate
+    together — DeepSeek-V2's convention (HF view_as_complex path), with the
+    YaRN attention factor folded into the magnitude like HF's
+    ``freqs_cis * attention_scaling``."""
+    t = x.shape[1]
+    positions = jnp.asarray(offset, jnp.float32) + jnp.arange(t, dtype=jnp.float32)
+    angles = positions[:, None] * inv_freq[None, :]  # (T, D/2)
+    cos = (jnp.cos(angles) * scaling)[None, :, None, :]
+    sin = (jnp.sin(angles) * scaling)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x1 * sin + x2 * cos
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)

@@ -1,0 +1,156 @@
+"""DeepSeek-V2 (MLA + MoE) parity vs HF transformers — the BASELINE.json
+primary config's architecture."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.loading import load_model
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+TINY_HF = dict(
+    vocab_size=160,
+    hidden_size=64,
+    intermediate_size=128,
+    moe_intermediate_size=32,
+    num_hidden_layers=4,
+    num_attention_heads=4,
+    num_key_value_heads=4,
+    kv_lora_rank=16,
+    q_lora_rank=None,
+    qk_rope_head_dim=8,
+    qk_nope_head_dim=16,
+    v_head_dim=12,
+    n_routed_experts=8,
+    n_shared_experts=2,
+    num_experts_per_tok=3,
+    first_k_dense_replace=1,
+    moe_layer_freq=1,
+    routed_scaling_factor=1.0,
+    norm_topk_prob=False,
+    topk_method="greedy",
+    n_group=1,
+    topk_group=1,
+    max_position_embeddings=256,
+    rms_norm_eps=1e-6,
+    rope_theta=10000.0,
+    tie_word_embeddings=False,
+    aux_loss_alpha=0.0,
+)
+
+
+def _make_checkpoint(tmp_path, **overrides):
+    torch.manual_seed(13)
+    cfg = transformers.DeepseekV2Config(**{**TINY_HF, **overrides})
+    model = transformers.DeepseekV2ForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tiny_dsv2")
+    model = _make_checkpoint(path)
+    return path, model
+
+
+def test_logits_parity_full(hf_checkpoint):
+    path, hf_model = hf_checkpoint
+    tokens = [[2, 45, 99, 3, 27, 81, 5, 150]]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    model, params = load_model(str(path), dtype=jnp.float32)
+    got, _ = model(
+        params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 16, jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
+
+
+def test_cache_tuple_head_dims(hf_checkpoint):
+    path, _ = hf_checkpoint
+    model, _ = load_model(str(path), dtype=jnp.float32)
+    cache = model.make_cache(1, 8, jnp.float32)
+    assert cache.k.shape[-1] == 16 + 8  # qk_nope + qk_rope
+    assert cache.v.shape[-1] == 12  # v_head_dim
+
+
+def test_prefill_equals_decode(hf_checkpoint):
+    path, _ = hf_checkpoint
+    model, params = load_model(str(path), dtype=jnp.float32)
+    tokens = jnp.asarray([[2, 17, 42, 9, 77, 23, 55, 12]], jnp.int32)
+    full, _ = model(params, tokens, model.make_cache(1, 16, jnp.float32))
+    cache = model.make_cache(1, 16, jnp.float32)
+    outs = []
+    for i in range(tokens.shape[1]):
+        logits, cache = model(params, tokens[:, i : i + 1], cache)
+        outs.append(logits[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(got), rtol=2e-3, atol=2e-3)
+
+
+def test_two_stage_parity_baseline_split(hf_checkpoint):
+    """The BASELINE.json primary config splits DeepSeek at a layer boundary;
+    here 4 layers split 0-2/2-4 (stage 0 holds the dense layer + 1 MoE)."""
+    path, hf_model = hf_checkpoint
+    tokens = [[5, 9, 2, 7, 33]]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    s0, p0 = load_model(str(path), start_layer=0, end_layer=2, dtype=jnp.float32)
+    s1, p1 = load_model(str(path), start_layer=2, end_layer=4, dtype=jnp.float32)
+    assert "dense" in p0["layers"] and "moe" in p0["layers"]
+    assert "dense" not in p1["layers"]  # stage 1 is all-MoE
+    h, _ = s0(p0, jnp.asarray(tokens, jnp.int32), s0.make_cache(1, 16, jnp.float32))
+    got, _ = s1(p1, h, s1.make_cache(1, 16, jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
+
+
+def test_q_lora_variant(tmp_path):
+    """Full-size DeepSeek-V2 factors queries through a LoRA bottleneck."""
+    hf = _make_checkpoint(tmp_path, q_lora_rank=24)
+    tokens = [[4, 9, 2]]
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    model, params = load_model(str(tmp_path), dtype=jnp.float32)
+    assert "q_a_proj" in params["layers"]["moe"]
+    got, _ = model(
+        params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 8, jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
+
+
+def test_group_limited_routing(tmp_path):
+    hf = _make_checkpoint(
+        tmp_path, topk_method="group_limited_greedy", n_group=4, topk_group=2
+    )
+    tokens = [[8, 3, 91, 14]]
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    model, params = load_model(str(tmp_path), dtype=jnp.float32)
+    got, _ = model(
+        params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 8, jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
+
+
+def test_yarn_rope(tmp_path):
+    """DeepSeek-Coder-V2-Lite ships yarn rope scaling."""
+    hf = _make_checkpoint(
+        tmp_path,
+        rope_scaling=dict(
+            type="yarn", factor=4.0, original_max_position_embeddings=64,
+            beta_fast=32, beta_slow=1, mscale=0.707, mscale_all_dim=0.707,
+        ),
+        max_position_embeddings=256,
+    )
+    tokens = [[2, 45, 99, 3, 27, 81, 5, 150, 7, 9]]
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    model, params = load_model(str(tmp_path), dtype=jnp.float32)
+    got, _ = model(
+        params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 16, jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
