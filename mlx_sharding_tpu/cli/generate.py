@@ -30,7 +30,10 @@ def main(argv=None):
     parser.add_argument("--start-layer", type=int, default=None)
     parser.add_argument("--end-layer", type=int, default=None)
     parser.add_argument("--num-stages", type=int, default=None,
-                        help="run the model as an N-stage pipeline on the local mesh")
+                        help="run the model as an N-stage fused SPMD pipeline on the local mesh")
+    parser.add_argument("--stage-bounds", default=None,
+                        help="chained-pipeline stage bounds, e.g. '0-14,14-27' "
+                        "(uneven splits and MoE/dense mixes allowed)")
     parser.add_argument("--no-chat-template", action="store_true")
     args = parser.parse_args(argv)
 
@@ -39,16 +42,28 @@ def main(argv=None):
     from mlx_sharding_tpu.generate import Generator, stream_generate
     from mlx_sharding_tpu.loading import get_model_path, load_model
 
-    model, params = load_model(args.model, args.start_layer, args.end_layer)
-    if args.num_stages and args.num_stages > 1:
+    if args.stage_bounds:
+        from mlx_sharding_tpu.parallel.chained import load_chained_pipeline
+
+        bounds = [
+            tuple(int(x) for x in part.split("-"))
+            for part in args.stage_bounds.split(",")
+        ]
+        generator = load_chained_pipeline(
+            args.model, bounds, max_seq=args.max_seq,
+            prefill_chunk=args.prefill_chunk,
+        )
+    elif args.num_stages and args.num_stages > 1:
         from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
         from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
+        model, params = load_model(args.model, args.start_layer, args.end_layer)
         generator = PipelineEngine(
             model, params, pipeline_mesh(args.num_stages),
             max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         )
     else:
+        model, params = load_model(args.model, args.start_layer, args.end_layer)
         generator = Generator(
             model, params, max_seq=args.max_seq, prefill_chunk=args.prefill_chunk
         )

@@ -1,0 +1,190 @@
+"""Chained pipeline — per-stage compiled programs with device-resident
+parameters and device-to-device activation hand-off.
+
+This is the second pipeline mode, complementing the fused SPMD engine
+(parallel/pipeline.py). It reproduces the reference's topology most directly
+— a driver that owns the loop and pushes activations through stages in order
+(ref: shard/utils.py:156-178, generate.py:52-88) — but where the reference
+pays serialize → TCP → Python-deserialize per stage per token
+(SURVEY §3.5), here each stage is a jitted program compiled against
+parameters committed to its own device, and the hand-off is an async
+device-to-device transfer (ICI on real TPU hardware; the host only enqueues).
+
+Why it exists alongside the SPMD engine: it places no structural constraints
+on stages. Uneven layer splits and heterogeneous layer stacks (DeepSeek-V2's
+dense-prefix + MoE mix) work unchanged, because every stage is its own
+program — exactly the flexibility the reference's ``[start, end)`` sharding
+offers (BASELINE config #1: DeepSeek split 0-14 / 14-27).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_sharding_tpu.sample import (
+    init_recent_tokens,
+    make_sampler_params,
+    sample_token,
+    update_recent_tokens,
+)
+
+
+class ChainedPipeline:
+    """Drives a list of stage (model, params) pairs, one per device.
+
+    Stage 0 must be a first-stage config (embeds tokens), the last stage a
+    last-stage config (produces logits); bounds may be uneven.
+    """
+
+    def __init__(
+        self,
+        stage_models: Sequence,
+        stage_params: Sequence[dict],
+        *,
+        devices: Optional[Sequence] = None,
+        max_seq: int = 4096,
+        batch: int = 1,
+        cache_dtype=jnp.bfloat16,
+        prefill_chunk: int = 256,
+    ):
+        if len(stage_models) != len(stage_params):
+            raise ValueError("one params pytree per stage model")
+        if not stage_models[0].config.is_first_stage:
+            raise ValueError("stage 0 must start at layer 0")
+        if not stage_models[-1].config.is_last_stage:
+            raise ValueError("last stage must end at num_hidden_layers")
+        self.models = list(stage_models)
+        self.num_stages = len(self.models)
+        if devices is None:
+            devices = jax.devices()[: self.num_stages]
+        if len(devices) < self.num_stages:
+            raise ValueError(
+                f"{self.num_stages} stages need {self.num_stages} devices, "
+                f"have {len(devices)}"
+            )
+        self.devices = list(devices[: self.num_stages])
+        self.params = [
+            jax.device_put(p, d) for p, d in zip(stage_params, self.devices)
+        ]
+        self.max_seq = -(-max_seq // prefill_chunk) * prefill_chunk
+        self.batch = batch
+        self.cache_dtype = cache_dtype
+        self.prefill_chunk = prefill_chunk
+
+        # one compiled stage program per stage; compilation happens against
+        # the stage's committed device, so execution is placed there
+        self._stage_fns = []
+        for model in self.models:
+            def fn(params, x, cache, n_valid, model=model):
+                return model(params, x, cache, n_valid=n_valid)
+
+            self._stage_fns.append(jax.jit(fn, donate_argnums=(2,)))
+
+        def sample_fn(logits, n_valid, recent, key, sp):
+            last = jax.lax.dynamic_index_in_dim(logits, n_valid - 1, 1, keepdims=False)
+            key, sub = jax.random.split(key)
+            tok, logprobs = sample_token(sub, last, sp, recent)
+            recent = update_recent_tokens(recent, tok)
+            return tok, logprobs, recent, key
+
+        self._sample = jax.jit(sample_fn, donate_argnums=(2,))
+
+    # ------------------------------------------------------------------
+    def _make_caches(self):
+        return [
+            jax.device_put(
+                m.make_cache(self.batch, self.max_seq, self.cache_dtype), d
+            )
+            for m, d in zip(self.models, self.devices)
+        ]
+
+    def _forward(self, x, caches, n_valid):
+        """Run one token-step through every stage. The loop only enqueues:
+        transfers and stage programs are dispatched asynchronously."""
+        h = x
+        for i, (fn, params) in enumerate(zip(self._stage_fns, self.params)):
+            # D2D hop (ICI on TPU); for i==0 this also moves the previously
+            # sampled token from the last device back to stage 0. No-op when
+            # already resident.
+            h = jax.device_put(h, self.devices[i])
+            h, caches[i] = fn(params, h, caches[i], n_valid)
+        return h, caches
+
+    def generate_step(
+        self,
+        prompt_tokens,
+        *,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        repetition_penalty: Optional[float] = None,
+        repetition_context_size: int = 20,
+        logit_bias: Optional[dict[int, float]] = None,
+        seed: Optional[int] = None,
+        max_tokens: int = 256,
+    ):
+        """Same contract as generate.Generator.generate_step."""
+        sp = make_sampler_params(temperature, top_p, repetition_penalty, logit_bias)
+        key = jax.random.PRNGKey(
+            int(time.time_ns()) & 0x7FFFFFFF if seed is None else seed
+        )
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(self.batch, -1)
+        n_prompt = prompt.shape[1]
+        if n_prompt + max_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({n_prompt}) + max_tokens ({max_tokens}) exceeds KV "
+                f"capacity {self.max_seq}"
+            )
+
+        caches = self._make_caches()
+        recent = init_recent_tokens(self.batch, repetition_context_size, prompt)
+
+        c = self.prefill_chunk
+        logits = None
+        n_valid = None
+        for start in range(0, n_prompt, c):
+            chunk = prompt[:, start : start + c]
+            n_valid = jnp.asarray(chunk.shape[1], jnp.int32)
+            if chunk.shape[1] < c:
+                chunk = np.pad(chunk, ((0, 0), (0, c - chunk.shape[1])))
+            logits, caches = self._forward(jnp.asarray(chunk), caches, n_valid)
+
+        tok, logprobs, recent, key = self._sample(logits, n_valid, recent, key, sp)
+
+        one = jnp.asarray(1, jnp.int32)
+        n = 0
+        while True:
+            next_logits, caches = self._forward(tok[:, None], caches, one)
+            next_tok, next_logprobs, recent, key = self._sample(
+                next_logits, one, recent, key, sp
+            )
+            yield int(tok[0]), logprobs
+            n += 1
+            if n >= max_tokens:
+                break
+            tok, logprobs = next_tok, next_logprobs
+
+
+def load_chained_pipeline(
+    model_path: str,
+    stage_bounds: Sequence[tuple[int, int]],
+    *,
+    dtype=jnp.bfloat16,
+    **kwargs,
+) -> ChainedPipeline:
+    """Dynamic sharding into a chained pipeline: every stage loads from the
+    same full checkpoint with injected bounds (ref: shard/utils.py:36-39),
+    e.g. ``stage_bounds=[(0, 14), (14, 27)]`` for the BASELINE DeepSeek
+    split."""
+    from mlx_sharding_tpu.loading import load_model
+
+    models, params = [], []
+    for start, end in stage_bounds:
+        m, p = load_model(model_path, start, end, dtype=dtype)
+        models.append(m)
+        params.append(p)
+    return ChainedPipeline(models, params, **kwargs)

@@ -92,6 +92,7 @@ class ModelProvider:
         start_layer: Optional[int] = None,
         end_layer: Optional[int] = None,
         num_stages: Optional[int] = None,
+        stage_bounds: Optional[list[tuple[int, int]]] = None,
         max_seq: int = 4096,
         prefill_chunk: int = 256,
         cache_dtype=None,
@@ -101,6 +102,7 @@ class ModelProvider:
         self.start_layer = start_layer
         self.end_layer = end_layer
         self.num_stages = num_stages
+        self.stage_bounds = stage_bounds
         self.max_seq = max_seq
         self.prefill_chunk = prefill_chunk
         self.cache_dtype = cache_dtype
@@ -137,11 +139,24 @@ class ModelProvider:
         from mlx_sharding_tpu.generate import Generator
         from mlx_sharding_tpu.loading import get_model_path, load_model
 
+        cache_dtype = self.cache_dtype or jnp.bfloat16
+        if self.stage_bounds:
+            from mlx_sharding_tpu.parallel.chained import load_chained_pipeline
+
+            generator = load_chained_pipeline(
+                target, self.stage_bounds, dtype=cache_dtype,
+                max_seq=self.max_seq, cache_dtype=cache_dtype,
+                prefill_chunk=self.prefill_chunk,
+            )
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(str(get_model_path(target)))
+            self._set(target, generator, tokenizer)
+            return self.generator, self.tokenizer
         model, params = load_model(
             target, self.start_layer, self.end_layer,
             dtype=self.cache_dtype or jnp.bfloat16,
         )
-        cache_dtype = self.cache_dtype or jnp.bfloat16
         if self.num_stages and self.num_stages > 1:
             from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
             from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
@@ -540,7 +555,9 @@ def main(argv=None):
     parser.add_argument("--start-layer", type=int, default=None)
     parser.add_argument("--end-layer", type=int, default=None)
     parser.add_argument("--num-stages", type=int, default=None,
-                        help="pipeline stages on the local mesh")
+                        help="pipeline stages on the local mesh (fused SPMD engine)")
+    parser.add_argument("--stage-bounds", default=None,
+                        help="chained-pipeline bounds, e.g. '0-14,14-27'")
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
     parser.add_argument("--log-level", default="INFO")
@@ -559,10 +576,16 @@ def main(argv=None):
             args.coordinator, num_processes=args.num_processes,
             process_id=args.process_id,
         )
+    stage_bounds = None
+    if args.stage_bounds:
+        stage_bounds = [
+            tuple(int(x) for x in part.split("-"))
+            for part in args.stage_bounds.split(",")
+        ]
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
-        num_stages=args.num_stages, max_seq=args.max_seq,
-        prefill_chunk=args.prefill_chunk,
+        num_stages=args.num_stages, stage_bounds=stage_bounds,
+        max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
     )
     server = make_server(provider, args.host, args.port)
     logger.info("serving on http://%s:%d", args.host, args.port)
