@@ -37,6 +37,7 @@ from mlx_sharding_tpu.tokenizer_utils import (
     sequence_overlap,
     stopping_criteria,
 )
+from mlx_sharding_tpu.utils.observability import ServingMetrics, profile_trace
 
 logger = logging.getLogger(__name__)
 
@@ -189,6 +190,8 @@ class APIHandler(BaseHTTPRequestHandler):
 
     provider: ModelProvider = None
     gen_lock: threading.Lock = None
+    metrics: ServingMetrics = None
+    profile_dir: Optional[str] = None
     protocol_version = "HTTP/1.1"
 
     # ------------------------------------------------------------- helpers
@@ -226,6 +229,15 @@ class APIHandler(BaseHTTPRequestHandler):
             path = "/index.html"
         elif path == "/health":
             return self._json(200, {"status": "ok"})
+        elif path == "/metrics":
+            body = self.metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self._cors()
+            self.end_headers()
+            self.wfile.write(body)
+            return
         target = (STATIC_DIR / path.lstrip("/")).resolve()
         if not str(target).startswith(str(STATIC_DIR.resolve())) or not target.is_file():
             return self._error(404, f"not found: {self.path}")
@@ -399,7 +411,11 @@ class APIHandler(BaseHTTPRequestHandler):
         token_logprobs: list[float] = []
         top_logprobs: list[dict] = []
         finish_reason = "length"
-        for token, logprobs in generator.generate_step(prompt_ids, **gen_kwargs):
+        t_start = time.perf_counter()
+        t_first = None
+        for token, logprobs in self._generate(generator, prompt_ids, gen_kwargs):
+            if t_first is None:
+                t_first = time.perf_counter()
             if eos is not None and token == eos:
                 finish_reason = "stop"
                 break
@@ -418,6 +434,7 @@ class APIHandler(BaseHTTPRequestHandler):
                         top_logprobs = top_logprobs[: -stop.trim_length]
                 finish_reason = "stop"
                 break
+        self._record(len(prompt_ids), len(tokens), t_start, t_first)
         text = tokenizer.decode(tokens)
         logprobs_payload = None
         if want_logprobs > 0:
@@ -471,7 +488,11 @@ class APIHandler(BaseHTTPRequestHandler):
         tokens: list[int] = []
         in_flight: list[int] = []  # tokens withheld due to stop-prefix overlap
         finish_reason = "length"
-        for token, _ in generator.generate_step(prompt_ids, **gen_kwargs):
+        t_start = time.perf_counter()
+        t_first = None
+        for token, _ in self._generate(generator, prompt_ids, gen_kwargs):
+            if t_first is None:
+                t_first = time.perf_counter()
             if eos is not None and token == eos:
                 finish_reason = "stop"
                 break
@@ -496,6 +517,7 @@ class APIHandler(BaseHTTPRequestHandler):
                         **({"delta": delta} if chat else {"text": detok.last_segment}),
                     )
                 )
+        self._record(len(prompt_ids), len(tokens), t_start, t_first)
         # a length-finished run that was still buffering emits the buffered
         # tokens — they never completed a stop sequence
         for t in in_flight:
@@ -523,6 +545,24 @@ class APIHandler(BaseHTTPRequestHandler):
         self.wfile.flush()
         self.close_connection = True
 
+    # -------------------------------------------------------- observability
+    def _generate(self, generator, prompt_ids, gen_kwargs):
+        """Generation wrapped in a JAX profiler trace when --profile-dir is
+        set (SURVEY §5: the profiling layer the reference lacks)."""
+        with profile_trace(self.profile_dir):
+            yield from generator.generate_step(prompt_ids, **gen_kwargs)
+
+    def _record(self, n_prompt, n_gen, t_start, t_first):
+        end = time.perf_counter()
+        ttft = (t_first - t_start) if t_first else 0.0
+        decode_time = (end - t_first) if t_first else 0.0
+        self.metrics.record_request(
+            prompt_tokens=n_prompt,
+            generation_tokens=n_gen,
+            ttft_s=ttft,
+            decode_tps=(max(n_gen - 1, 0) / decode_time) if decode_time > 0 else 0.0,
+        )
+
     # ------------------------------------------------------------ handlers
     def _handle_chat_completion(self, body, params, generator, tokenizer):
         prompt_ids = self._chat_prompt(body, tokenizer)
@@ -536,11 +576,21 @@ class APIHandler(BaseHTTPRequestHandler):
         self._run(body, params, generator, tokenizer, list(prompt_ids), chat=False)
 
 
-def make_server(provider: ModelProvider, host: str = "127.0.0.1", port: int = 8080):
+def make_server(
+    provider: ModelProvider,
+    host: str = "127.0.0.1",
+    port: int = 8080,
+    profile_dir: Optional[str] = None,
+):
     handler = type(
         "BoundAPIHandler",
         (APIHandler,),
-        {"provider": provider, "gen_lock": threading.Lock()},
+        {
+            "provider": provider,
+            "gen_lock": threading.Lock(),
+            "metrics": ServingMetrics(),
+            "profile_dir": profile_dir,
+        },
     )
     return ThreadingHTTPServer((host, port), handler)
 
@@ -561,6 +611,8 @@ def main(argv=None):
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument("--profile-dir", default=None,
+                        help="write JAX profiler traces per request here")
     # multi-host (DCN) bring-up — the jax.distributed control plane
     parser.add_argument("--coordinator", default=None,
                         help="host:port of jax.distributed coordinator")
@@ -587,7 +639,7 @@ def main(argv=None):
         num_stages=args.num_stages, stage_bounds=stage_bounds,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
     )
-    server = make_server(provider, args.host, args.port)
+    server = make_server(provider, args.host, args.port, profile_dir=args.profile_dir)
     logger.info("serving on http://%s:%d", args.host, args.port)
     server.serve_forever()
 

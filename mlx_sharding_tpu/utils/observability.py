@@ -1,0 +1,114 @@
+"""Tracing / profiling / metrics.
+
+The reference has none of this — ad-hoc prints on the shard server and a
+tok/s printout in the CLI are its entire observability story (SURVEY §5
+"Tracing/profiling: None"). Here:
+
+- :func:`profile_trace` wraps the JAX profiler (TensorBoard-loadable traces
+  of XLA execution, including per-op TPU timing) around any generation call;
+- :class:`ServingMetrics` is a lock-guarded counter set the API server
+  exposes at ``/metrics`` — request counts, token throughput, TTFT and
+  decode-rate summaries (p50/p95 from a bounded reservoir).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None):
+    """JAX profiler trace context; no-op when log_dir is falsy."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+class _Reservoir:
+    """Bounded uniform sample for percentile summaries."""
+
+    def __init__(self, capacity: int = 512, seed: int = 0):
+        self.capacity = capacity
+        self.values: list[float] = []
+        self.count = 0
+        self._rng = random.Random(seed)
+
+    def add(self, value: float):
+        self.count += 1
+        if len(self.values) < self.capacity:
+            self.values.append(value)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.capacity:
+                self.values[j] = value
+
+    def percentile(self, p: float) -> float:
+        if not self.values:
+            return 0.0
+        s = sorted(self.values)
+        idx = min(len(s) - 1, max(0, round(p / 100 * (len(s) - 1))))
+        return s[idx]
+
+
+@dataclass
+class ServingMetrics:
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    requests_total: int = 0
+    requests_failed: int = 0
+    prompt_tokens_total: int = 0
+    generation_tokens_total: int = 0
+    ttft_s: _Reservoir = field(default_factory=_Reservoir)
+    decode_tps: _Reservoir = field(default_factory=_Reservoir)
+
+    def record_request(
+        self,
+        *,
+        prompt_tokens: int,
+        generation_tokens: int,
+        ttft_s: float,
+        decode_tps: float,
+        failed: bool = False,
+    ):
+        with self.lock:
+            self.requests_total += 1
+            if failed:
+                self.requests_failed += 1
+            self.prompt_tokens_total += prompt_tokens
+            self.generation_tokens_total += generation_tokens
+            if ttft_s > 0:
+                self.ttft_s.add(ttft_s)
+            if decode_tps > 0:
+                self.decode_tps.add(decode_tps)
+
+    def record_failure(self):
+        with self.lock:
+            self.requests_total += 1
+            self.requests_failed += 1
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        with self.lock:
+            lines = [
+                "# TYPE mst_requests_total counter",
+                f"mst_requests_total {self.requests_total}",
+                "# TYPE mst_requests_failed_total counter",
+                f"mst_requests_failed_total {self.requests_failed}",
+                "# TYPE mst_prompt_tokens_total counter",
+                f"mst_prompt_tokens_total {self.prompt_tokens_total}",
+                "# TYPE mst_generation_tokens_total counter",
+                f"mst_generation_tokens_total {self.generation_tokens_total}",
+                "# TYPE mst_ttft_seconds summary",
+                f'mst_ttft_seconds{{quantile="0.5"}} {self.ttft_s.percentile(50):.6f}',
+                f'mst_ttft_seconds{{quantile="0.95"}} {self.ttft_s.percentile(95):.6f}',
+                "# TYPE mst_decode_tokens_per_second summary",
+                f'mst_decode_tokens_per_second{{quantile="0.5"}} {self.decode_tps.percentile(50):.3f}',
+                f'mst_decode_tokens_per_second{{quantile="0.95"}} {self.decode_tps.percentile(95):.3f}',
+            ]
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,76 @@
+import http.client
+import json
+
+from mlx_sharding_tpu.utils.observability import ServingMetrics, _Reservoir, profile_trace
+
+
+def test_reservoir_percentiles():
+    r = _Reservoir(capacity=100)
+    for i in range(100):
+        r.add(float(i))
+    assert abs(r.percentile(50) - 50) <= 2
+    assert abs(r.percentile(95) - 95) <= 2
+
+
+def test_metrics_render():
+    m = ServingMetrics()
+    m.record_request(prompt_tokens=10, generation_tokens=20, ttft_s=0.5, decode_tps=40.0)
+    m.record_failure()
+    out = m.render()
+    assert "mst_requests_total 2" in out
+    assert "mst_requests_failed_total 1" in out
+    assert "mst_generation_tokens_total 20" in out
+    assert 'mst_decode_tokens_per_second{quantile="0.5"} 40.000' in out
+
+
+def test_profile_trace_noop():
+    with profile_trace(None):
+        pass  # must not require jax
+
+
+def test_metrics_endpoint(tmp_path):
+    """/metrics live on the server after a request."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from mlx_sharding_tpu.config import LlamaConfig
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.models.llama import LlamaModel
+    from mlx_sharding_tpu.server.openai_api import ModelProvider, make_server
+    from tests.test_tokenizer_utils import ByteTokenizer
+
+    model = LlamaModel(
+        LlamaConfig(
+            vocab_size=300, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        )
+    )
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    gen = Generator(model, params, max_seq=128, cache_dtype=jnp.float32, prefill_chunk=16)
+    provider = ModelProvider.__new__(ModelProvider)
+    provider.default_model = "tiny"
+    provider.trust_remote_paths = False
+    provider._key = None
+    provider._set("tiny", gen, ByteTokenizer())
+    srv = make_server(provider, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt": "hi", "max_tokens": 5}),
+            {"Content-Type": "application/json"},
+        )
+        conn.getresponse().read()
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert "mst_requests_total 1" in body
+        assert "mst_generation_tokens_total 5" in body
+        conn.close()
+    finally:
+        srv.shutdown()
