@@ -16,10 +16,31 @@ rather than repeating K/V (no HBM duplication).
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def _flash_eligible(q, k, v, logit_softcap, sliding_window, sinks) -> bool:
+    """Use the Pallas kernel for MXU-aligned prefill on TPU: standard causal
+    GQA only (no softcap/window/sinks), T and S multiples of 128, head dims
+    lane-aligned. Opt out with MST_FLASH=0."""
+    if os.environ.get("MST_FLASH", "1") == "0":
+        return False
+    if logit_softcap is not None or sliding_window is not None or sinks is not None:
+        return False
+    b, t, hq, dk = q.shape
+    s, dv = k.shape[1], v.shape[-1]
+    return (
+        jax.default_backend() == "tpu"
+        and t >= 128
+        and t % 128 == 0
+        and s % 128 == 0
+        and dk % 128 == 0
+        and dv % 128 == 0
+    )
 
 
 def causal_attention(
@@ -34,7 +55,14 @@ def causal_attention(
     sinks: Optional[jax.Array] = None,  # reserved for attention-sink variants
 ) -> jax.Array:
     """Returns (B, T, Hq, Dv). Keys at positions > query position (or outside
-    the sliding window, or beyond the valid prefix) contribute nothing."""
+    the sliding window, or beyond the valid prefix) contribute nothing.
+
+    Prefill chunks that qualify route to the Pallas flash kernel
+    (ops/flash_attention.py); everything else takes the fused-XLA path below."""
+    if _flash_eligible(q, k, v, logit_softcap, sliding_window, sinks):
+        from mlx_sharding_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, offset, scale)
     b, t, hq, dk = q.shape
     s, hkv = k.shape[1], k.shape[2]
     groups = hq // hkv

@@ -1,0 +1,39 @@
+"""Pallas flash-attention kernel vs the XLA reference path (interpret mode
+on CPU; the same kernel compiles for TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.ops import causal_attention
+from mlx_sharding_tpu.ops.flash_attention import flash_attention
+
+
+@pytest.mark.parametrize(
+    "b,t,s,hq,hkv,dk,offset",
+    [
+        (1, 128, 256, 4, 4, 64, 0),  # plain prefill from empty cache
+        (1, 128, 256, 8, 2, 64, 64),  # GQA + continuation chunk at offset
+        (2, 256, 256, 4, 2, 32, 0),  # batch, full-capacity prompt
+    ],
+)
+def test_flash_matches_xla(b, t, s, hq, hkv, dk, offset):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, t, hq, dk)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, hkv, dk)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, hkv, dk)), jnp.float32)
+    scale = dk**-0.5
+    ref = causal_attention(q, k, v, jnp.asarray(offset), scale)
+    got = flash_attention(
+        q, k, v, jnp.asarray(offset), scale, block_q=64, block_k=64, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_rejects_ragged_blocks():
+    q = jnp.zeros((1, 100, 2, 16))
+    k = jnp.zeros((1, 128, 2, 16))
+    v = jnp.zeros((1, 128, 2, 16))
+    with pytest.raises(ValueError, match="multiples"):
+        flash_attention(q, k, v, jnp.asarray(0), 1.0, block_q=64, block_k=64, interpret=True)
