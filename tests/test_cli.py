@@ -1,0 +1,85 @@
+"""End-user CLI paths, driven as subprocesses against a real on-disk
+checkpoint + tokenizer (built offline by make_tiny_checkpoint)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from tests.make_tiny_checkpoint import make_tiny_checkpoint
+
+    return str(make_tiny_checkpoint(tmp_path_factory.mktemp("cli_ckpt")))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # PYTHONPATH must NOT include the axon sitecustomize dir: its register
+    # hook overrides jax_platforms to "axon,cpu" and the child would try to
+    # claim the real TPU (or hang if the tunnel is down).
+    env["PYTHONPATH"] = str(REPO)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return subprocess.run(
+        [sys.executable, *args], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def test_generate_cli(ckpt):
+    r = _run(
+        ["-m", "mlx_sharding_tpu.cli.generate", "--model", ckpt,
+         "--prompt", "the quick", "--max-tokens", "8",
+         "--max-seq", "128", "--prefill-chunk", "16"]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "tokens-per-sec" in r.stderr
+    assert "TTFT" in r.stderr
+
+
+def test_generate_cli_spmd_pipeline(ckpt):
+    r = _run(
+        ["-m", "mlx_sharding_tpu.cli.generate", "--model", ckpt,
+         "--prompt", "hello", "--max-tokens", "4", "--num-stages", "4",
+         "--max-seq", "64", "--prefill-chunk", "16"]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Generation" in r.stderr
+
+
+def test_generate_cli_chained_pipeline(ckpt):
+    r = _run(
+        ["-m", "mlx_sharding_tpu.cli.generate", "--model", ckpt,
+         "--prompt", "hello", "--max-tokens", "4", "--stage-bounds", "0-1,1-4",
+         "--max-seq", "64", "--prefill-chunk", "16"]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_shard_tool_cli(ckpt, tmp_path):
+    r = _run(
+        ["-m", "mlx_sharding_tpu.shard_tool", "--model", ckpt,
+         "--output-dir", str(tmp_path), "--num-stages", "2"]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    for stage in ("stage_00", "stage_01"):
+        cfg = json.loads((tmp_path / stage / "config.json").read_text())
+        assert "start_layer" in cfg and "end_layer" in cfg
+        assert (tmp_path / stage / "tokenizer.json").exists()
+    # a stage checkpoint loads and generates via the CLI
+    r = _run(
+        ["-m", "mlx_sharding_tpu.cli.generate", "--model", str(tmp_path / "stage_00"),
+         "--prompt", "x", "--max-tokens", "2", "--max-seq", "32",
+         "--prefill-chunk", "8"]
+    )
+    # stage 0 alone has no head -> logits are hidden states; generation becomes
+    # meaningless but the load path must still work end-to-end. It should fail
+    # cleanly or produce output; either way no traceback-free crash:
+    assert "Traceback" not in r.stderr or r.returncode != 0
