@@ -58,10 +58,31 @@ def _probe_backend(timeout: int = 300) -> bool:
         return False
 
 
+CPU_FALLBACK_MODEL = dict(
+    model_type="llama",
+    vocab_size=4096,
+    hidden_size=512,
+    intermediate_size=1408,
+    num_hidden_layers=8,
+    num_attention_heads=8,
+    num_key_value_heads=4,
+    tie_word_embeddings=True,
+)
+
+
 def main() -> int:
-    if not _probe_backend():
-        print("bench: TPU backend unreachable (probe timed out)", file=sys.stderr)
-        return 1
+    cpu_fallback = not _probe_backend()
+    if cpu_fallback:
+        # The axon tunnel can be down for reasons outside this repo; a
+        # clearly-labeled CPU number beats a hung or absent benchmark.
+        print(
+            "bench: TPU backend unreachable (probe timed out) — running the "
+            "CPU fallback with a tiny model; metric name reflects this",
+            file=sys.stderr,
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     import jax
     import jax.numpy as jnp
@@ -70,7 +91,7 @@ def main() -> int:
     from mlx_sharding_tpu.models import build_model
 
     print(f"bench: devices={jax.devices()}", file=sys.stderr)
-    model, cfg = build_model(dict(BENCH_MODEL))
+    model, cfg = build_model(dict(CPU_FALLBACK_MODEL if cpu_fallback else BENCH_MODEL))
     t0 = time.perf_counter()
     params = jax.jit(lambda k: model.init_params(k, jnp.bfloat16))(
         jax.random.PRNGKey(0)
@@ -109,13 +130,21 @@ def main() -> int:
         f"TTFT={ttft * 1000:.0f} ms ({n} tokens)",
         file=sys.stderr,
     )
+    metric = (
+        "decode_tokens_per_sec_tiny_cpu_fallback"
+        if cpu_fallback
+        else "decode_tokens_per_sec_3b_bf16_1chip"
+    )
+    # vs_baseline is only meaningful against the documented nominal on the
+    # real chip; the CPU fallback reports 0 there.
+    vs = 0.0 if cpu_fallback else round(decode_tps / NOMINAL_SINGLE_HOST_MLX_TOKS, 3)
     print(
         json.dumps(
             {
-                "metric": "decode_tokens_per_sec_3b_bf16_1chip",
+                "metric": metric,
                 "value": round(decode_tps, 2),
                 "unit": "tokens/sec",
-                "vs_baseline": round(decode_tps / NOMINAL_SINGLE_HOST_MLX_TOKS, 3),
+                "vs_baseline": vs,
             }
         )
     )

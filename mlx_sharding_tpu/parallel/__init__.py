@@ -1,3 +1,10 @@
-from mlx_sharding_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP, make_mesh
+from mlx_sharding_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    make_mesh,
+)
 
-__all__ = ["make_mesh", "AXIS_PP", "AXIS_TP", "AXIS_DP", "AXIS_SP"]
+__all__ = ["make_mesh", "AXIS_PP", "AXIS_TP", "AXIS_DP", "AXIS_SP", "AXIS_EP"]

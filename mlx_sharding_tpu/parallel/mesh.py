@@ -27,24 +27,25 @@ from jax.sharding import Mesh
 AXIS_DP = "dp"
 AXIS_PP = "pp"
 AXIS_SP = "sp"
+AXIS_EP = "ep"
 AXIS_TP = "tp"
 
 # outermost → innermost; innermost axes get the fastest interconnect links
-MESH_AXIS_ORDER = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
+MESH_AXIS_ORDER = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_EP, AXIS_TP)
 
 
 def make_mesh(
-    dp: int = 1, pp: int = 1, sp: int = 1, tp: int = 1, devices=None
+    dp: int = 1, pp: int = 1, sp: int = 1, tp: int = 1, ep: int = 1, devices=None
 ) -> Mesh:
     if devices is None:
         devices = jax.devices()
-    n = dp * pp * sp * tp
+    n = dp * pp * sp * ep * tp
     if n > len(devices):
         raise ValueError(
-            f"mesh dp={dp} pp={pp} sp={sp} tp={tp} needs {n} devices, "
+            f"mesh dp={dp} pp={pp} sp={sp} ep={ep} tp={tp} needs {n} devices, "
             f"have {len(devices)}"
         )
-    grid = np.asarray(devices[:n]).reshape(dp, pp, sp, tp)
+    grid = np.asarray(devices[:n]).reshape(dp, pp, sp, ep, tp)
     return Mesh(grid, MESH_AXIS_ORDER)
 
 

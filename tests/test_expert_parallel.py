@@ -1,0 +1,35 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.ops.moe import apply_experts, mixtral_routing
+from mlx_sharding_tpu.parallel.expert_parallel import expert_parallel_apply
+from mlx_sharding_tpu.parallel.mesh import make_mesh
+
+
+@pytest.mark.parametrize("ep", [2, 4, 8])
+def test_expert_parallel_matches_local(ep):
+    rng = np.random.default_rng(0)
+    n, h, i, e, k = 32, 16, 24, 8, 2
+    x = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
+    router = jnp.asarray(rng.normal(size=(h, e)), jnp.float32)
+    wg = jnp.asarray(rng.normal(size=(e, h, i)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.normal(size=(e, h, i)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.normal(size=(e, i, h)), jnp.float32) * 0.1
+    weights, idx = mixtral_routing(x, router, k)
+
+    ref = apply_experts(x, weights, idx, wg, wu, wd)
+    mesh = make_mesh(ep=ep)
+    got = expert_parallel_apply(x, weights, idx, wg, wu, wd, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_expert_parallel_rejects_uneven():
+    mesh = make_mesh(ep=4)
+    x = jnp.zeros((4, 8))
+    w = jnp.zeros((4, 2))
+    idx = jnp.zeros((4, 2), jnp.int32)
+    wg = jnp.zeros((6, 8, 8))  # 6 experts over ep=4
+    with pytest.raises(ValueError, match="not divisible"):
+        expert_parallel_apply(x, w, idx, wg, wg, jnp.zeros((6, 8, 8)), mesh)
