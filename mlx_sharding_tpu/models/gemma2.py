@@ -68,17 +68,17 @@ class Gemma2Model(BaseModel):
         return h, k_buf, v_buf
 
     def run_layers(self, layer_params, h, k, v, offset):
-        n_local = self.config.num_local_layers
-        # global layer indices for this stage's slice (window alternation
-        # follows the GLOBAL index, so stages stay consistent)
-        idxs = self.config.start_layer + jnp.arange(n_local)
-
+        # The GLOBAL layer index travels inside the param stack
+        # ("layer_idx", added by map_weights/init_params): window alternation
+        # follows it, so arbitrary stage slices — including the fused SPMD
+        # engine's per-device shards, which can't see start_layer — stay
+        # consistent with the full model.
         def body(h, xs):
-            p, k_buf, v_buf, idx = xs
-            h, k_buf, v_buf = self._layer(h, p, k_buf, v_buf, offset, idx)
+            p, k_buf, v_buf = xs
+            h, k_buf, v_buf = self._layer(h, p, k_buf, v_buf, offset, p["layer_idx"])
             return h, (k_buf, v_buf)
 
-        h, (k, v) = jax.lax.scan(body, h, (layer_params, k, v, idxs))
+        h, (k, v) = jax.lax.scan(body, h, (layer_params, k, v))
         return h, k, v
 
     def embed(self, params, tokens):
@@ -125,7 +125,9 @@ class Gemma2Model(BaseModel):
         from mlx_sharding_tpu.loading import collect_layer_stack, first_key
 
         cfg = self.config
-        params = {"layers": collect_layer_stack(weights, cfg, self.HF_LAYER_MAP, dtype)}
+        layers = collect_layer_stack(weights, cfg, self.HF_LAYER_MAP, dtype)
+        layers["layer_idx"] = jnp.arange(cfg.start_layer, cfg.end_layer, dtype=jnp.int32)
+        params = {"layers": layers}
         if cfg.needs_embed:
             embed = first_key(weights, "model.embed_tokens.weight", "embed_tokens.weight")
             params["embed"] = {"weight": jnp.asarray(embed, dtype)}
@@ -155,7 +157,9 @@ class Gemma2Model(BaseModel):
                 "down_proj": dense_init(next(keys), inter, hd, dtype),
             }
 
-        params = {"layers": stack_layers([layer() for _ in range(nl)])}
+        layers = stack_layers([layer() for _ in range(nl)])
+        layers["layer_idx"] = jnp.arange(cfg.start_layer, cfg.end_layer, dtype=jnp.int32)
+        params = {"layers": layers}
         if cfg.needs_embed:
             params["embed"] = {
                 "weight": dense_init(next(keys), cfg.vocab_size, hd, dtype, scale=0.02)

@@ -1,0 +1,59 @@
+"""Fused SPMD pipeline with non-Llama architectures: Gemma-2 (global
+layer-index alternation must survive stage slicing) and Mixtral (MoE expert
+stacks ride the stage split)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mlx_sharding_tpu.config import Gemma2Config, MixtralConfig
+from mlx_sharding_tpu.generate import Generator
+from mlx_sharding_tpu.models.gemma2 import Gemma2Model
+from mlx_sharding_tpu.models.mixtral import MixtralModel
+from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+
+def test_gemma2_pipeline_odd_layers_per_stage():
+    """4 stages x 1 layer: stages 1 and 3 hold GLOBAL odd (non-sliding)
+    layers — with per-stage-local indices they would wrongly apply the
+    window."""
+    cfg = Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, sliding_window=4, query_pre_attn_scalar=8,
+    )
+    model = Gemma2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    prompt = list(range(2, 12))  # > sliding_window so the window matters
+
+    ref_gen = Generator(model, params, max_seq=32, cache_dtype=jnp.float32, prefill_chunk=16)
+    ref = [t for t, _ in ref_gen.generate_step(prompt, max_tokens=6)]
+
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(4), max_seq=32,
+        cache_dtype=jnp.float32, prefill_chunk=16,
+    )
+    got = [t for t, _ in eng.generate_step(prompt, max_tokens=6)]
+    assert got == ref
+
+
+def test_mixtral_pipeline():
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+    )
+    model = MixtralModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(1), jnp.float32)
+    prompt = [5, 9, 2]
+
+    ref_gen = Generator(model, params, max_seq=32, cache_dtype=jnp.float32, prefill_chunk=8)
+    ref = [t for t, _ in ref_gen.generate_step(prompt, max_tokens=6)]
+
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(2), max_seq=32,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    got = [t for t, _ in eng.generate_step(prompt, max_tokens=6)]
+    assert got == ref
