@@ -1,0 +1,67 @@
+"""Native checkpoints — Orbax save/restore of the scan-ready param pytree.
+
+The safetensors path (loading.py / shard_tool.py) exists for checkpoint
+compatibility with the reference's ecosystem; this module is the TPU-native
+format: the *already stacked, already transposed* parameter pytree lands on
+disk via Orbax, so a stage restore is a straight async read into (sharded)
+device buffers with zero name-remapping or per-tensor transposes — the
+"per-stage checkpoint emission" upgrade SURVEY §5 (checkpoint/resume) calls
+for. The model config (with its baked stage bounds, same idea as
+sharding_weight.py:48-60) rides alongside as JSON.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+NATIVE_MARKER = "native_checkpoint.json"
+
+
+def save_native_checkpoint(path: str | Path, params: dict, config) -> Path:
+    """Write params (Orbax) + config (JSON). ``config`` is a BaseConfig or a
+    plain dict."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).resolve()
+    path.mkdir(parents=True, exist_ok=True)
+    config_dict = config if isinstance(config, dict) else config.to_dict()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path / "params", params, force=True)
+    (path / NATIVE_MARKER).write_text(json.dumps(config_dict, indent=2))
+    return path
+
+
+def is_native_checkpoint(path: str | Path) -> bool:
+    return (Path(path) / NATIVE_MARKER).is_file()
+
+
+def load_native_checkpoint(
+    path: str | Path,
+    start_layer: int | None = None,
+    end_layer: int | None = None,
+):
+    """Returns (model, params). Stage bounds may be overridden only to the
+    bounds the checkpoint actually contains (native checkpoints are already
+    stage-filtered)."""
+    import orbax.checkpoint as ocp
+
+    from mlx_sharding_tpu.models import build_model
+
+    path = Path(path).resolve()
+    config_dict = json.loads((path / NATIVE_MARKER).read_text())
+    if start_layer is not None or end_layer is not None:
+        baked = (config_dict.get("start_layer", 0), config_dict.get("end_layer"))
+        wanted = (
+            start_layer if start_layer is not None else baked[0],
+            end_layer if end_layer is not None else baked[1],
+        )
+        if wanted != baked:
+            raise ValueError(
+                f"native checkpoint holds layers {baked}, cannot re-slice to "
+                f"{wanted}; re-shard from the source checkpoint instead"
+            )
+    model, config = build_model(config_dict)
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(path / "params")
+    return model, params

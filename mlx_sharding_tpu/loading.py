@@ -135,8 +135,13 @@ def load_model(
     end_layer: Optional[int] = None,
     dtype=jnp.bfloat16,
 ):
-    """Full load path (ref: shard/utils.py:33-68). Returns (model, params)."""
+    """Full load path (ref: shard/utils.py:33-68). Returns (model, params).
+    Native (Orbax) checkpoints are detected and restored directly."""
     model_path = get_model_path(path_or_repo)
+    from mlx_sharding_tpu.checkpoint import is_native_checkpoint, load_native_checkpoint
+
+    if is_native_checkpoint(model_path):
+        return load_native_checkpoint(model_path, start_layer, end_layer)
     config_dict = load_config(model_path, start_layer, end_layer)
     model, config = build_model(config_dict)
     weights = load_raw_weights(model_path)

@@ -39,8 +39,12 @@ def save_sharded_weights(
     start_layer: int,
     end_layer: int,
     total_layers: int | None = None,
+    emit_native: bool = False,
 ) -> Path:
-    """Write one stage's checkpoint into ``output_dir``. Returns the dir."""
+    """Write one stage's checkpoint into ``output_dir``. Returns the dir.
+    With ``emit_native`` the stage is additionally materialized through the
+    model's weight mapper and saved as a native (Orbax) checkpoint under
+    ``output_dir/native/`` — stacked, transposed, restore-ready."""
     model_path = get_model_path(str(model_path))
     output_dir = Path(output_dir)
     output_dir.mkdir(parents=True, exist_ok=True)
@@ -72,6 +76,22 @@ def save_sharded_weights(
         json.dump(config_dict, f, indent=2)
 
     copy_other_files(model_path, output_dir)
+
+    if emit_native:
+        import jax.numpy as jnp
+
+        from mlx_sharding_tpu.checkpoint import save_native_checkpoint
+        from mlx_sharding_tpu.models import get_model_class
+        from mlx_sharding_tpu.loading import dequantize_weights
+
+        weights_for_map = kept
+        if config.quantization is not None:
+            weights_for_map = dequantize_weights(kept, config.quantization)
+        model = get_model_class(config.model_type)(config)
+        params = model.map_weights(weights_for_map, jnp.bfloat16)
+        native_dir = output_dir / "native"
+        save_native_checkpoint(native_dir, params, config)
+        copy_other_files(model_path, native_dir)
     return output_dir
 
 
@@ -99,7 +119,10 @@ def even_partition(num_layers: int, num_stages: int) -> list[tuple[int, int]]:
 
 
 def shard_all_stages(
-    model_path: str | Path, output_root: str | Path, num_stages: int
+    model_path: str | Path,
+    output_root: str | Path,
+    num_stages: int,
+    emit_native: bool = False,
 ) -> list[Path]:
     model_path = get_model_path(str(model_path))
     with open(model_path / "config.json") as f:
@@ -107,7 +130,9 @@ def shard_all_stages(
     dirs = []
     for i, (start, end) in enumerate(even_partition(num_layers, num_stages)):
         out = Path(output_root) / f"stage_{i:02d}"
-        dirs.append(save_sharded_weights(model_path, out, start, end))
+        dirs.append(
+            save_sharded_weights(model_path, out, start, end, emit_native=emit_native)
+        )
     return dirs
 
 
@@ -127,10 +152,17 @@ def main(argv=None):
         "--num-stages", type=int, default=None,
         help="emit all stages at once under output-dir/stage_NN/",
     )
+    parser.add_argument(
+        "--emit-native", action="store_true",
+        help="also write each stage as a native (Orbax) checkpoint under "
+        "<stage>/native/ — stacked and transposed, restore-ready",
+    )
     args = parser.parse_args(argv)
 
     if args.num_stages:
-        dirs = shard_all_stages(args.model, args.output_dir, args.num_stages)
+        dirs = shard_all_stages(
+            args.model, args.output_dir, args.num_stages, args.emit_native
+        )
         for d in dirs:
             print(d)
     else:
@@ -139,7 +171,7 @@ def main(argv=None):
         print(
             save_sharded_weights(
                 args.model, args.output_dir, args.start_layer, args.end_layer,
-                args.total_layers,
+                args.total_layers, emit_native=args.emit_native,
             )
         )
 

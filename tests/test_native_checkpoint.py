@@ -1,0 +1,57 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.checkpoint import (
+    is_native_checkpoint,
+    load_native_checkpoint,
+    save_native_checkpoint,
+)
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.loading import load_model
+from mlx_sharding_tpu.models.llama import LlamaModel
+
+TINY = dict(
+    vocab_size=128,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=4,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+
+def test_roundtrip_logits_identical(tmp_path):
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    tokens = jnp.asarray([[3, 7, 11]], jnp.int32)
+    ref, _ = model(params, tokens, model.make_cache(1, 8, jnp.float32))
+
+    save_native_checkpoint(tmp_path / "ck", params, cfg)
+    assert is_native_checkpoint(tmp_path / "ck")
+    model2, params2 = load_native_checkpoint(tmp_path / "ck")
+    got, _ = model2(params2, tokens, model2.make_cache(1, 8, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_load_model_detects_native(tmp_path):
+    cfg = LlamaConfig(**{**TINY, "start_layer": 1, "end_layer": 3})
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(1), jnp.float32)
+    save_native_checkpoint(tmp_path / "stage", params, cfg)
+
+    model2, params2 = load_model(str(tmp_path / "stage"), dtype=jnp.float32)
+    assert model2.config.start_layer == 1 and model2.config.end_layer == 3
+    assert params2["layers"]["q_proj"].shape[0] == 2
+
+
+def test_native_refuses_reslice(tmp_path):
+    cfg = LlamaConfig(**{**TINY, "start_layer": 0, "end_layer": 2})
+    model = LlamaModel(cfg)
+    save_native_checkpoint(
+        tmp_path / "s", model.init_params(jax.random.PRNGKey(0), jnp.float32), cfg
+    )
+    with pytest.raises(ValueError, match="re-slice"):
+        load_native_checkpoint(tmp_path / "s", start_layer=1, end_layer=2)

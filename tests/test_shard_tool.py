@@ -67,6 +67,19 @@ def test_sharded_reload_matches_full(hf_checkpoint, tmp_path):
     np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-3, atol=2e-3)
 
 
+def test_emit_native_stage_loads_and_matches(hf_checkpoint, tmp_path):
+    """--emit-native writes an Orbax stage restoreable through load_model
+    with identical logits to the safetensors stage."""
+    path, _ = hf_checkpoint
+    out = save_sharded_weights(path, tmp_path / "s0", 0, 3, emit_native=True)
+    m_st, p_st = load_model(str(out), dtype=jnp.bfloat16)
+    m_nat, p_nat = load_model(str(out / "native"))
+    tokens = jnp.asarray([[9, 4, 2]], jnp.int32)
+    a, _ = m_st(p_st, tokens, m_st.make_cache(1, 8, jnp.bfloat16))
+    b, _ = m_nat(p_nat, tokens, m_nat.make_cache(1, 8, jnp.bfloat16))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_aux_files_copied(hf_checkpoint, tmp_path):
     path, _ = hf_checkpoint
     (path / "tokenizer_config.json").write_text("{}")
