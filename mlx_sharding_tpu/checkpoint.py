@@ -40,10 +40,19 @@ def load_native_checkpoint(
     path: str | Path,
     start_layer: int | None = None,
     end_layer: int | None = None,
+    dtype=None,
 ):
     """Returns (model, params). Stage bounds may be overridden only to the
     bounds the checkpoint actually contains (native checkpoints are already
-    stage-filtered)."""
+    stage-filtered). ``dtype`` requests the floating-point dtype of the
+    restored params (matching the safetensors path's contract).
+
+    Restore goes through an abstract target pytree (shapes/dtypes from
+    ``model.init_params`` under ``eval_shape``) so Orbax can read directly
+    into buffers of the requested dtype rather than materializing host numpy
+    first; a plain restore + cast is the fallback for structure drift."""
+    import jax
+    import jax.numpy as jnp
     import orbax.checkpoint as ocp
 
     from mlx_sharding_tpu.models import build_model
@@ -62,6 +71,20 @@ def load_native_checkpoint(
                 f"{wanted}; re-shard from the source checkpoint instead"
             )
     model, config = build_model(config_dict)
-    with ocp.StandardCheckpointer() as ckptr:
-        params = ckptr.restore(path / "params")
+    dtype = dtype or jnp.bfloat16
+    try:
+        abstract = jax.eval_shape(
+            lambda: model.init_params(jax.random.PRNGKey(0), dtype)
+        )
+        with ocp.StandardCheckpointer() as ckptr:
+            params = ckptr.restore(path / "params", abstract)
+    except Exception:
+        with ocp.StandardCheckpointer() as ckptr:
+            params = ckptr.restore(path / "params")
+        params = jax.tree.map(
+            lambda x: x.astype(dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
     return model, params

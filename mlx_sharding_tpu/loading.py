@@ -38,7 +38,11 @@ def get_model_path(path_or_repo: str, revision: Optional[str] = None) -> Path:
         snapshot_download(
             repo_id=path_or_repo,
             revision=revision,
-            allow_patterns=["*.json", "*.safetensors", "*.model", "tokenizer*"],
+            # params/** covers native (Orbax) checkpoints uploaded to a repo —
+            # the marker alone matching *.json must not strand the payload.
+            allow_patterns=[
+                "*.json", "*.safetensors", "*.model", "tokenizer*", "params/**",
+            ],
         )
     )
 
@@ -141,7 +145,7 @@ def load_model(
     from mlx_sharding_tpu.checkpoint import is_native_checkpoint, load_native_checkpoint
 
     if is_native_checkpoint(model_path):
-        return load_native_checkpoint(model_path, start_layer, end_layer)
+        return load_native_checkpoint(model_path, start_layer, end_layer, dtype=dtype)
     config_dict = load_config(model_path, start_layer, end_layer)
     model, config = build_model(config_dict)
     weights = load_raw_weights(model_path)

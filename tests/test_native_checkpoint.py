@@ -31,7 +31,7 @@ def test_roundtrip_logits_identical(tmp_path):
 
     save_native_checkpoint(tmp_path / "ck", params, cfg)
     assert is_native_checkpoint(tmp_path / "ck")
-    model2, params2 = load_native_checkpoint(tmp_path / "ck")
+    model2, params2 = load_native_checkpoint(tmp_path / "ck", dtype=jnp.float32)
     got, _ = model2(params2, tokens, model2.make_cache(1, 8, jnp.float32))
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
@@ -45,6 +45,19 @@ def test_load_model_detects_native(tmp_path):
     model2, params2 = load_model(str(tmp_path / "stage"), dtype=jnp.float32)
     assert model2.config.start_layer == 1 and model2.config.end_layer == 3
     assert params2["layers"]["q_proj"].shape[0] == 2
+
+
+def test_native_honors_requested_dtype(tmp_path):
+    """A float32 request against a float32-saved checkpoint stays f32; a
+    bf16 request against the same checkpoint delivers bf16 params."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(2), jnp.float32)
+    save_native_checkpoint(tmp_path / "ck", params, cfg)
+    _, p32 = load_model(str(tmp_path / "ck"), dtype=jnp.float32)
+    assert p32["layers"]["q_proj"].dtype == jnp.float32
+    _, p16 = load_model(str(tmp_path / "ck"), dtype=jnp.bfloat16)
+    assert p16["layers"]["q_proj"].dtype == jnp.bfloat16
 
 
 def test_native_refuses_reslice(tmp_path):
