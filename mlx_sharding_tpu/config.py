@@ -138,6 +138,11 @@ class DeepseekV2Config(BaseConfig):
     attention_bias: bool = False
     max_position_embeddings: int = 163840
     rope_theta: float = 10000.0
+    # "compressed": cache the shared KV latent (kv_lora_rank + rope dims per
+    # token, independent of head count) and absorb kv_b into the query/output
+    # sides at attention time — the MLA inference optimization. "full": cache
+    # decompressed per-head K/V (the reference's layout, deepseek_v2.py:120-125).
+    mla_cache_mode: str = "compressed"
 
     def __post_init__(self):
         super().__post_init__()

@@ -57,15 +57,20 @@ class DeepseekV2Model(BaseModel):
 
     def cache_head_dim(self):
         cfg = self.config
+        if cfg.mla_cache_mode == "compressed":
+            # one shared "head": latent + rope dims; the v buffer is a dummy
+            # (values are a slice of the latent key)
+            return (cfg.kv_lora_rank + cfg.qk_rope_head_dim, 1)
         # (K dim, V dim) tuple — ref deepseek_v2.py:120-125
         return (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim, cfg.v_head_dim)
 
     def make_cache(self, batch, max_seq, dtype=jnp.bfloat16):
         from mlx_sharding_tpu.cache import init_cache
 
+        cfg = self.config
+        heads = 1 if cfg.mla_cache_mode == "compressed" else cfg.num_attention_heads
         return init_cache(
-            self.config.num_local_layers, batch, max_seq,
-            self.config.num_attention_heads,  # MLA keeps all heads in cache
+            cfg.num_local_layers, batch, max_seq, heads,
             self.cache_head_dim(), dtype,
         )
 
@@ -75,6 +80,7 @@ class DeepseekV2Model(BaseModel):
         b, t, _ = h.shape
         heads = cfg.num_attention_heads
         nope, rope_d, v_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        rank = cfg.kv_lora_rank
 
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
         if cfg.q_lora_rank is None:
@@ -83,24 +89,47 @@ class DeepseekV2Model(BaseModel):
             q = rms_norm(r @ p["q_a_proj"], p["q_a_norm"], cfg.rms_norm_eps) @ p["q_b_proj"]
         q = q.reshape(b, t, heads, nope + rope_d)
         q_nope, q_pe = q[..., :nope], q[..., nope:]
+        q_pe = apply_rope_interleaved(q_pe, self.inv_freq, offset, self.rope_scale)
 
         ckv = r @ p["kv_a_proj"]  # (B, T, rank + rope_d)
-        compressed, k_pe = ckv[..., : cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank :]
-        kv = rms_norm(compressed, p["kv_a_norm"], cfg.rms_norm_eps) @ p["kv_b_proj"]
-        kv = kv.reshape(b, t, heads, nope + v_d)
-        k_nope, v = kv[..., :nope], kv[..., nope:]
-
-        q_pe = apply_rope_interleaved(q_pe, self.inv_freq, offset, self.rope_scale)
+        compressed, k_pe_raw = ckv[..., :rank], ckv[..., rank:]
+        latent = rms_norm(compressed, p["kv_a_norm"], cfg.rms_norm_eps)
         k_pe = apply_rope_interleaved(
-            k_pe[:, :, None, :], self.inv_freq, offset, self.rope_scale
+            k_pe_raw[:, :, None, :], self.inv_freq, offset, self.rope_scale
         )  # single shared rope head
-        k = jnp.concatenate(
-            [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], rope_d))], axis=-1
-        )
-        q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
 
-        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
-        attn = causal_attention(q_full, k_buf, v_buf, offset, self.scale)
+        if cfg.mla_cache_mode == "compressed":
+            # Cache the latent, not per-head K/V: per token only
+            # rank + rope_d numbers, independent of head count. kv_b is
+            # absorbed into the query (scores) and output (values) sides, so
+            # the math is identical to the decompressed path.
+            w_b = p["kv_b_proj"].reshape(rank, heads, nope + v_d)
+            w_bk, w_bv = w_b[..., :nope], w_b[..., nope:]
+            q_lat = jnp.einsum(
+                "bthn,rhn->bthr", q_nope, w_bk, preferred_element_type=jnp.float32
+            ).astype(h.dtype)
+            q_cat = jnp.concatenate([q_lat, q_pe], axis=-1)  # (B,T,H,rank+rope)
+            k_new = jnp.concatenate([latent[:, :, None, :], k_pe], axis=-1)
+            dummy_v = jnp.zeros((b, t, 1, 1), v_buf.dtype)
+            k_buf, v_buf = write_layer_kv(k_buf, v_buf, k_new, dummy_v, offset)
+            # MQA over the single latent head; "values" are the latent slice
+            # of the key buffer, so no second buffer is stored.
+            out_lat = causal_attention(
+                q_cat, k_buf, k_buf[..., :rank], offset, self.scale
+            )  # (B,T,H,rank)
+            attn = jnp.einsum(
+                "bthr,rhv->bthv", out_lat, w_bv, preferred_element_type=jnp.float32
+            ).astype(h.dtype)
+        else:
+            kv = (latent @ p["kv_b_proj"]).reshape(b, t, heads, nope + v_d)
+            k_nope, v = kv[..., :nope], kv[..., nope:]
+            k = jnp.concatenate(
+                [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], rope_d))],
+                axis=-1,
+            )
+            q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
+            k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
+            attn = causal_attention(q_full, k_buf, v_buf, offset, self.scale)
         return h + attn.reshape(b, t, -1) @ p["o_proj"], k_buf, v_buf
 
     @staticmethod

@@ -70,12 +70,22 @@ def test_logits_parity_full(hf_checkpoint):
     np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
 
 
-def test_cache_tuple_head_dims(hf_checkpoint):
+def test_cache_head_dims(hf_checkpoint):
     path, _ = hf_checkpoint
+    # default: compressed MLA cache — one shared latent head
     model, _ = load_model(str(path), dtype=jnp.float32)
     cache = model.make_cache(1, 8, jnp.float32)
-    assert cache.k.shape[-1] == 16 + 8  # qk_nope + qk_rope
-    assert cache.v.shape[-1] == 12  # v_head_dim
+    assert cache.k.shape[-2:] == (1, 16 + 8)  # kv_lora_rank + qk_rope
+    # full mode keeps the reference's decompressed tuple head dims
+    from mlx_sharding_tpu.models import build_model
+    import json
+
+    cfg = json.loads((path / "config.json").read_text())
+    cfg["mla_cache_mode"] = "full"
+    model_f, _ = build_model(cfg)
+    cache_f = model_f.make_cache(1, 8, jnp.float32)
+    assert cache_f.k.shape[-1] == 16 + 8  # qk_nope + qk_rope
+    assert cache_f.v.shape[-1] == 12  # v_head_dim
 
 
 def test_prefill_equals_decode(hf_checkpoint):

@@ -1,0 +1,77 @@
+"""Compressed MLA cache: identical logits to the decompressed path and to
+HF, at a fraction of the KV memory."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.loading import load_model
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from tests.test_deepseek_v2 import TINY_HF, _make_checkpoint  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tiny_dsv2_mla")
+    model = _make_checkpoint(path)
+    return path, model
+
+
+def _load(path, mode, **kw):
+    import json
+
+    cfg = json.loads((path / "config.json").read_text())
+    cfg["mla_cache_mode"] = mode
+    (path / "config.json").write_text(json.dumps(cfg))
+    return load_model(str(path), dtype=jnp.float32, **kw)
+
+
+def test_compressed_matches_hf_and_full(hf_checkpoint):
+    path, hf_model = hf_checkpoint
+    tokens = [[2, 45, 99, 3, 27, 81, 5, 150]]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+
+    model_c, params_c = _load(path, "compressed")
+    got_c, _ = model_c(
+        params_c, jnp.asarray(tokens, jnp.int32), model_c.make_cache(1, 16, jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got_c), ref, rtol=3e-3, atol=3e-3)
+
+    model_f, params_f = _load(path, "full")
+    got_f, _ = model_f(
+        params_f, jnp.asarray(tokens, jnp.int32), model_f.make_cache(1, 16, jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_c), np.asarray(got_f), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_compressed_cache_is_smaller(hf_checkpoint):
+    path, _ = hf_checkpoint
+    model_c, _ = _load(path, "compressed")
+    model_f, _ = _load(path, "full")
+    cache_c = model_c.make_cache(1, 32, jnp.float32)
+    cache_f = model_f.make_cache(1, 32, jnp.float32)
+    size = lambda c: c.k.size + c.v.size
+    assert size(cache_c) < size(cache_f) / 2
+    # latent head: rank + rope dims, one shared head
+    assert cache_c.k.shape[-2:] == (1, TINY_HF["kv_lora_rank"] + TINY_HF["qk_rope_head_dim"])
+
+
+def test_compressed_prefill_equals_decode(hf_checkpoint):
+    path, _ = hf_checkpoint
+    model, params = _load(path, "compressed")
+    tokens = jnp.asarray([[2, 17, 42, 9, 77, 23]], jnp.int32)
+    full, _ = model(params, tokens, model.make_cache(1, 16, jnp.float32))
+    cache = model.make_cache(1, 16, jnp.float32)
+    outs = []
+    for i in range(tokens.shape[1]):
+        logits, cache = model(params, tokens[:, i : i + 1], cache)
+        outs.append(logits[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(got), rtol=2e-3, atol=2e-3)
