@@ -71,6 +71,12 @@ def load_native_checkpoint(
                 f"{wanted}; re-shard from the source checkpoint instead"
             )
     model, config = build_model(config_dict)
+    if not (path / "params").exists():
+        raise FileNotFoundError(
+            f"native checkpoint at {path} has its marker but no params/ "
+            "payload — re-emit it (shard_tool --emit-native) or check the "
+            "download included params/**"
+        )
     dtype = dtype or jnp.bfloat16
     try:
         abstract = jax.eval_shape(
