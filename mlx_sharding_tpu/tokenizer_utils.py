@@ -26,6 +26,17 @@ class StreamingDetokenizer:
         self._tokenizer = tokenizer
         self.reset()
 
+    # Region restart cap: decoding is O(region length) per token, so without
+    # restarts a long newline-free output costs O(n²) total. Restarts keep
+    # the last token as a decode prefix: tokenizers that strip leading
+    # whitespace at sequence start (SentencePiece-family "▁word") strip it
+    # from the prefix-only decode and the prefix+next decode equally, so the
+    # emitted *difference* stays correct.
+    MAX_REGION_TOKENS = 64
+    # A region that never decodes cleanly (adversarial lone continuation
+    # bytes) is force-dropped at this bound so per-token cost stays bounded.
+    MAX_DIRTY_REGION_TOKENS = 256
+
     def reset(self):
         self.tokens: list[int] = []
         self._region_start = 0  # first token of the un-flushed decode region
@@ -33,22 +44,28 @@ class StreamingDetokenizer:
         self.text = ""  # all emitted text
         self.last_segment = ""
 
+    def _restart_region(self):
+        """Start a new region keeping the last token as decode prefix."""
+        self._region_start = len(self.tokens) - 1
+        self._emitted = self._tokenizer.decode(self.tokens[self._region_start :])
+
     def add_token(self, token: int):
         self.tokens.append(token)
         region = self.tokens[self._region_start :]
         decoded = self._tokenizer.decode(region)
         if decoded.endswith("�"):
-            # Mid-codepoint; wait for more tokens.
+            # Mid-codepoint; wait for more tokens — but never unboundedly.
             self.last_segment = ""
+            if len(region) >= self.MAX_DIRTY_REGION_TOKENS:
+                # drop the undecodable tail entirely
+                self._region_start = len(self.tokens)
+                self._emitted = ""
             return
         segment = decoded[len(self._emitted) :]
         self.last_segment = segment
         self.text += segment
-        if decoded.endswith("\n"):
-            # Newline is a safe merge boundary — restart the region so decode
-            # cost stays O(region), not O(total).
-            self._region_start = len(self.tokens)
-            self._emitted = ""
+        if decoded.endswith("\n") or len(region) >= self.MAX_REGION_TOKENS:
+            self._restart_region()
         else:
             self._emitted = decoded
 

@@ -63,6 +63,58 @@ def test_detokenizer_finalize_drops_dangling_bytes():
     assert d.text == ""
 
 
+def test_detokenizer_long_output_region_caps():
+    """A long newline-free stream stays correct across region restarts."""
+    tok = ByteTokenizer()
+    d = StreamingDetokenizer(tok)
+    text = ("abcdefghij" * 40) + "é🎉 end"  # 400+ chars, multibyte near the end
+    for t in tok.encode(text):
+        d.add_token(t)
+    d.finalize()
+    assert d.text == text
+
+
+class MetaspaceTokenizer:
+    """SentencePiece-style fake: words carry a leading-space marker and a
+    decode that STRIPS the leading space at sequence start — the behavior
+    that would drop spaces at region restarts without the prefix-token
+    scheme. Vocabulary: id = index into the word list."""
+
+    words = ["▁the", "▁quick", "▁brown", "▁fox", "▁jumps", "▁over", "▁lazy", "▁dog"]
+    eos_token_id = None
+
+    def decode(self, ids):
+        s = "".join(self.words[i] for i in ids).replace("▁", " ")
+        return s[1:] if s.startswith(" ") else s
+
+
+def test_detokenizer_metaspace_spaces_survive_restarts():
+    tok = MetaspaceTokenizer()
+    d = StreamingDetokenizer(tok)
+    d.MAX_REGION_TOKENS = 3  # force frequent restarts
+    ids = [0, 1, 2, 3, 4, 5, 0, 6, 7] * 4
+    for t in ids:
+        d.add_token(t)
+    d.finalize()
+    expected = tok.decode(ids)
+    assert d.text == expected, f"{d.text!r} != {expected!r}"
+
+
+def test_detokenizer_dirty_region_bounded():
+    """A flood of lone continuation bytes can't grow the region forever."""
+    tok = ByteTokenizer()
+    d = StreamingDetokenizer(tok)
+    d.MAX_DIRTY_REGION_TOKENS = 16
+    for _ in range(100):
+        d.add_token(0xBD)  # UTF-8 continuation byte, never decodes cleanly
+    assert len(d.tokens) - d._region_start <= 16
+    # recovery: clean text after the garbage still streams
+    for t in tok.encode("ok"):
+        d.add_token(t)
+    d.finalize()
+    assert d.text.endswith("ok")
+
+
 def test_stopping_criteria_eos():
     s = stopping_criteria([1, 2, 3], [], eos_token_id=3)
     assert s.stop_met and s.trim_length == 0
