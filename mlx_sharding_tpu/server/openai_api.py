@@ -98,7 +98,9 @@ class ModelProvider:
         prefill_chunk: int = 256,
         cache_dtype=None,
         trust_remote_paths: bool = False,
+        chat_template: Optional[str] = None,
     ):
+        self.chat_template = chat_template
         self.default_model = default_model
         self.start_layer = start_layer
         self.end_layer = end_layer
@@ -179,6 +181,10 @@ class ModelProvider:
         return self.generator, self.tokenizer
 
     def _set(self, key, generator, tokenizer):
+        # operator-supplied chat template wins over the checkpoint's
+        # (ref shard/openai_api.py --chat-template flag behavior)
+        if getattr(self, "chat_template", None):
+            tokenizer.chat_template = self.chat_template
         self._key = key
         self.generator = generator
         self.tokenizer = tokenizer
@@ -613,6 +619,9 @@ def main(argv=None):
     parser.add_argument("--log-level", default="INFO")
     parser.add_argument("--profile-dir", default=None,
                         help="write JAX profiler traces per request here")
+    parser.add_argument("--chat-template", default=None,
+                        help="jinja chat template (inline, or @/path/to/file) "
+                        "overriding the tokenizer's")
     # multi-host (DCN) bring-up — the jax.distributed control plane
     parser.add_argument("--coordinator", default=None,
                         help="host:port of jax.distributed coordinator")
@@ -634,10 +643,14 @@ def main(argv=None):
             tuple(int(x) for x in part.split("-"))
             for part in args.stage_bounds.split(",")
         ]
+    chat_template = args.chat_template
+    if chat_template and chat_template.startswith("@"):
+        chat_template = Path(chat_template[1:]).read_text()
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
         num_stages=args.num_stages, stage_bounds=stage_bounds,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
+        chat_template=chat_template,
     )
     server = make_server(provider, args.host, args.port, profile_dir=args.profile_dir)
     logger.info("serving on http://%s:%d", args.host, args.port)
