@@ -93,6 +93,37 @@ def test_pipeline_eight_stages_one_layer_each(model_and_params):
     assert got == ref
 
 
+def test_pipeline_seeded_sampling_matches_single_device(model_and_params):
+    """Replicated sampling on psum'd logits must reproduce the single-device
+    sampler exactly (same PRNG path, same tempered nucleus)."""
+    model, params = model_and_params
+    prompt = [3, 1, 4]
+    ref_gen = Generator(model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8)
+    ref = [
+        t for t, _ in ref_gen.generate_step(
+            prompt, temperature=0.9, top_p=0.8, seed=11, max_tokens=8
+        )
+    ]
+    eng = _engine(model, params, stages=4)
+    got = [
+        t for t, _ in eng.generate_step(
+            prompt, temperature=0.9, top_p=0.8, seed=11, max_tokens=8
+        )
+    ]
+    assert got == ref
+
+
+def test_pipeline_microbatched_multichunk_prefill(model_and_params):
+    """M=2 microbatches with a prompt spanning several prefill chunks."""
+    model, params = model_and_params
+    prompt = list(range(1, 20))  # chunks of 8: 8+8+4(padded)
+    ref_gen = Generator(model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8)
+    ref = [t for t, _ in ref_gen.generate_step(prompt, max_tokens=5)]
+    eng = _engine(model, params, stages=2, micro=2)
+    got = [t for t, _ in eng.generate_step(prompt, max_tokens=5)]
+    assert got == ref
+
+
 def test_pipeline_microbatched_decode(model_and_params):
     """M=3 microbatches: every microbatch decodes the same greedy sequence
     the single-request path produces (independent caches, filled bubble)."""
