@@ -34,6 +34,7 @@ from mlx_sharding_tpu.ops.rope import (
     apply_rope_interleaved,
     rope_frequencies,
     yarn_frequencies,
+    yarn_get_mscale,
 )
 
 
@@ -54,6 +55,15 @@ class DeepseekV2Model(BaseModel):
             self.rope_scale = 1.0
         self.inv_freq = jnp.asarray(inv_freq)
         self.scale = config.head_dim**-0.5  # head_dim == qk_nope + qk_rope
+        # DeepSeek's YaRN variant also rescales the softmax scale itself when
+        # mscale_all_dim is set (mlx_lm DeepseekV2Attention; DeepSeek remote
+        # code). The cos/sin attention_factor above is 1.0 for real V2
+        # checkpoints (mscale == mscale_all_dim == 0.707), so without this the
+        # logits come out ~1.59x too small at factor=40.
+        if rope_type == "yarn" and scaling.get("mscale_all_dim"):
+            self.scale *= yarn_get_mscale(
+                float(scaling["factor"]), float(scaling["mscale_all_dim"])
+            ) ** 2
 
     def cache_head_dim(self):
         cfg = self.config

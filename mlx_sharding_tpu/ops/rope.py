@@ -62,6 +62,17 @@ def rope_frequencies(
     return inv_freq.astype(np.float32)
 
 
+def yarn_get_mscale(scale: float, mscale: float = 1.0) -> float:
+    """DeepSeek's YaRN magnitude-scale helper (paper 2309.00071 §3.4).
+
+    With ``rope_scaling.mscale_all_dim`` set, DeepSeek-V2 multiplies the
+    attention softmax scale by ``yarn_get_mscale(factor, mscale_all_dim)**2``
+    (mlx_lm DeepseekV2Attention / DeepSeek remote code) on top of the cos/sin
+    attention factor — models must apply this or logits are ~1.59x too small
+    at factor=40."""
+    return 1.0 if scale <= 1 else 0.1 * mscale * math.log(scale) + 1.0
+
+
 def yarn_frequencies(
     head_dim: int,
     theta: float,
@@ -84,16 +95,17 @@ def yarn_frequencies(
     beta_fast = float(rope_scaling.get("beta_fast") or 32)
     beta_slow = float(rope_scaling.get("beta_slow") or 1)
 
-    def get_mscale(scale, m=1.0):
-        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
-
     if attention_factor is None:
-        if mscale and mscale_all_dim:
-            attention_factor = get_mscale(factor, mscale) / get_mscale(
-                factor, mscale_all_dim
-            )
-        else:
-            attention_factor = get_mscale(factor)
+        # DeepSeek remote-code convention: unconditional ratio with defaults
+        # mscale=1, mscale_all_dim=0 (and get_mscale(f, 0) == 1). This keeps
+        # the cos/sin factor consistent with the model-side softmax-scale
+        # correction (deepseek_v2.py), which fires whenever mscale_all_dim is
+        # set — regardless of whether mscale is.
+        attention_factor = yarn_get_mscale(
+            factor, 1.0 if mscale is None else float(mscale)
+        ) / yarn_get_mscale(
+            factor, 0.0 if mscale_all_dim is None else float(mscale_all_dim)
+        )
 
     def correction_dim(num_rotations):
         return (dim * math.log(orig_max / (num_rotations * 2 * math.pi))) / (

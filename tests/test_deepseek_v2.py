@@ -147,7 +147,16 @@ def test_group_limited_routing(tmp_path):
 
 
 def test_yarn_rope(tmp_path):
-    """DeepSeek-Coder-V2-Lite ships yarn rope scaling."""
+    """DeepSeek-Coder-V2-Lite ships yarn rope scaling.
+
+    HF's native DeepseekV2 port omits DeepSeek's mscale_all_dim softmax-scale
+    correction (mlx_lm DeepseekV2Attention and DeepSeek's remote code apply
+    ``yarn_get_mscale(factor, mscale_all_dim)**2``; HF keeps a bare
+    ``qk_head_dim**-0.5``). The reference's behavior comes from mlx_lm, so we
+    implement the correction — and patch HF's per-layer scale here so the
+    parity check targets the corrected math."""
+    from mlx_sharding_tpu.ops.rope import yarn_get_mscale
+
     hf = _make_checkpoint(
         tmp_path,
         rope_scaling=dict(
@@ -156,6 +165,10 @@ def test_yarn_rope(tmp_path):
         ),
         max_position_embeddings=256,
     )
+    mscale_sq = yarn_get_mscale(4.0, 0.707) ** 2
+    assert mscale_sq > 1.05  # the correction must be material for this test
+    for layer in hf.model.layers:
+        layer.self_attn.scaling *= mscale_sq
     tokens = [[2, 45, 99, 3, 27, 81, 5, 150, 7, 9]]
     with torch.no_grad():
         ref = hf(torch.tensor(tokens)).logits.numpy()

@@ -117,3 +117,36 @@ def test_softcap_changes_scores():
     plain = causal_attention(q, k, v, jnp.asarray(0), 1.0)
     capped = causal_attention(q, k, v, jnp.asarray(0), 1.0, logit_softcap=5.0)
     assert not np.allclose(np.asarray(plain), np.asarray(capped))
+
+
+def test_yarn_mscale_conventions():
+    """DeepSeek remote-code convention: the cos/sin attention factor is the
+    unconditional ratio get(f, mscale=1)/get(f, mscale_all_dim=0), and the
+    model-side softmax-scale correction (get(f, mscale_all_dim)**2) fires
+    whenever mscale_all_dim is set — so the net logit scale is get(f, mscale)^2
+    in every key combination."""
+    import math
+
+    from mlx_sharding_tpu.ops.rope import yarn_frequencies, yarn_get_mscale
+
+    f = 40.0
+    base = dict(type="yarn", factor=f, original_max_position_embeddings=64,
+                beta_fast=32, beta_slow=1)
+
+    def factor_of(**keys):
+        _, af = yarn_frequencies(8, 10000.0, {**base, **keys}, 256)
+        return af
+
+    g = yarn_get_mscale
+    assert math.isclose(factor_of(), g(f, 1.0))
+    assert math.isclose(factor_of(mscale=0.707, mscale_all_dim=0.707), 1.0)
+    assert math.isclose(
+        factor_of(mscale_all_dim=0.707), g(f, 1.0) / g(f, 0.707)
+    )
+    assert math.isclose(factor_of(mscale=0.8), g(f, 0.8))
+    # net check for the mscale_all_dim-only shape: (ratio applied to q AND k)
+    # times the model-side correction == reference's get(f, 1)^2
+    net = factor_of(mscale_all_dim=0.707) ** 2 * g(f, 0.707) ** 2
+    assert math.isclose(net, g(f, 1.0) ** 2)
+    # explicit attention_factor overrides the ratio entirely
+    assert factor_of(attention_factor=2.5) == 2.5
