@@ -32,17 +32,23 @@ def main(argv=None):
     parser.add_argument("--num-stages", type=int, default=None,
                         help="run the model as an N-stage fused SPMD pipeline on the local mesh")
     parser.add_argument("--stage-bounds", default=None,
-                        help="chained-pipeline stage bounds, e.g. '0-14,14-27' "
+                        help="pipeline stage bounds, e.g. '0-14,14-27' "
                         "(uneven splits and MoE/dense mixes allowed)")
+    parser.add_argument("--engine", choices=("fused", "chained"), default="fused",
+                        help="pipeline engine for --stage-bounds: 'fused' runs all "
+                        "stages as one SPMD program per token (default); 'chained' "
+                        "uses per-stage programs with D2D hand-off")
     parser.add_argument("--no-chat-template", action="store_true")
     args = parser.parse_args(argv)
+    if args.engine == "chained" and not args.stage_bounds:
+        parser.error("--engine chained requires --stage-bounds")
 
     import jax.numpy as jnp
 
     from mlx_sharding_tpu.generate import Generator, stream_generate
     from mlx_sharding_tpu.loading import get_model_path, load_model
 
-    if args.stage_bounds:
+    if args.stage_bounds and args.engine == "chained":
         from mlx_sharding_tpu.parallel.chained import load_chained_pipeline
 
         bounds = [
@@ -53,13 +59,21 @@ def main(argv=None):
             args.model, bounds, max_seq=args.max_seq,
             prefill_chunk=args.prefill_chunk,
         )
-    elif args.num_stages and args.num_stages > 1:
+    elif args.stage_bounds or (args.num_stages and args.num_stages > 1):
         from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
         from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
+        bounds = None
+        if args.stage_bounds:
+            bounds = [
+                tuple(int(x) for x in part.split("-"))
+                for part in args.stage_bounds.split(",")
+            ]
         model, params = load_model(args.model, args.start_layer, args.end_layer)
         generator = PipelineEngine(
-            model, params, pipeline_mesh(args.num_stages),
+            model, params,
+            pipeline_mesh(len(bounds) if bounds else args.num_stages),
+            stage_bounds=bounds,
             max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         )
     else:

@@ -33,6 +33,34 @@ def dense_init(key, in_dim: int, out_dim: int, dtype, scale: float | None = None
     return (jax.random.normal(key, (in_dim, out_dim), jnp.float32) * scale).astype(dtype)
 
 
+def scan_layers(layer_fn, h, layer_params, k, v, mask=None):
+    """``lax.scan`` over a stacked layer group with optional per-layer
+    active masking.
+
+    ``layer_fn(h, p, k_buf, v_buf) -> (h, k_buf, v_buf)`` is the single-layer
+    body; ``mask`` is an (L,) bool array (or None == all active). Masked-out
+    slots leave both the hidden state and their cache rows untouched, which is
+    what lets the fused SPMD engine pad uneven/heterogeneous stages to a
+    uniform per-stage slot count: padding slots carry zero params and scan
+    through as no-ops regardless of architecture semantics."""
+
+    def body(h, xs):
+        if mask is None:
+            p, k_buf, v_buf = xs
+            h, k_buf, v_buf = layer_fn(h, p, k_buf, v_buf)
+            return h, (k_buf, v_buf)
+        p, k_buf, v_buf, m = xs
+        h2, k2, v2 = layer_fn(h, p, k_buf, v_buf)
+        return jnp.where(m, h2, h), (
+            jnp.where(m, k2, k_buf),
+            jnp.where(m, v2, v_buf),
+        )
+
+    xs = (layer_params, k, v) if mask is None else (layer_params, k, v, mask)
+    h, (k, v) = jax.lax.scan(body, h, xs)
+    return h, k, v
+
+
 def stack_layers(per_layer: list[dict]) -> dict:
     """[{name: (…)}, …] → {name: (L, …)} for lax.scan consumption."""
     out = {}
@@ -59,13 +87,30 @@ class BaseModel:
         construction, shard/utils.py:142-150)."""
         cfg = self.config
         return init_cache(
-            cfg.num_local_layers, batch, max_seq, cfg.num_key_value_heads,
+            cfg.num_local_layers, batch, max_seq, self.cache_num_heads(),
             self.cache_head_dim(), dtype,
         )
 
     def cache_head_dim(self):
         """Int or (k_dim, v_dim) tuple (MLA, ref deepseek_v2.py:120-125)."""
         return self.config.head_dim
+
+    def cache_num_heads(self) -> int:
+        """Head count of the KV buffers. Models whose cache layout departs
+        from plain GQA (e.g. MLA's single compressed-latent head) override
+        this — engines must use it instead of config.num_key_value_heads."""
+        return self.config.num_key_value_heads
+
+    # -- layer structure ---------------------------------------------------
+    def layer_group_ranges(self) -> dict:
+        """Global-layer ranges of structurally distinct layer groups.
+
+        ``{group_key: (g0, g1)}`` where ``group_key=None`` means the model's
+        ``params["layers"]`` is itself the stacked pytree (homogeneous
+        models); string keys name sub-dicts (DeepSeek's dense/moe split).
+        The fused pipeline engine uses this to build per-stage uniform
+        stacks with masked padding for uneven/heterogeneous splits."""
+        return {None: (0, self.config.num_hidden_layers)}
 
     # -- forward ----------------------------------------------------------
     def __call__(self, params, x, cache: KVCache):

@@ -74,15 +74,19 @@ class DeepseekV2Model(BaseModel):
         # (K dim, V dim) tuple — ref deepseek_v2.py:120-125
         return (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim, cfg.v_head_dim)
 
-    def make_cache(self, batch, max_seq, dtype=jnp.bfloat16):
-        from mlx_sharding_tpu.cache import init_cache
-
+    def cache_num_heads(self) -> int:
         cfg = self.config
-        heads = 1 if cfg.mla_cache_mode == "compressed" else cfg.num_attention_heads
-        return init_cache(
-            cfg.num_local_layers, batch, max_seq, heads,
-            self.cache_head_dim(), dtype,
-        )
+        return 1 if cfg.mla_cache_mode == "compressed" else cfg.num_attention_heads
+
+    def layer_group_ranges(self) -> dict:
+        cfg = self.config
+        fk = min(max(cfg.first_k_dense_replace, 0), cfg.num_hidden_layers)
+        out = {}
+        if fk > 0:
+            out["dense"] = (0, fk)
+        if fk < cfg.num_hidden_layers:
+            out["moe"] = (fk, cfg.num_hidden_layers)
+        return out
 
     # ------------------------------------------------------------------
     def _attention(self, h, p, k_buf, v_buf, offset):
@@ -181,30 +185,33 @@ class DeepseekV2Model(BaseModel):
         )
         return n_dense, cfg.num_local_layers - n_dense
 
-    def run_layers(self, layer_params, h, k, v, offset):
-        n_dense, n_moe = self._layer_split()
-        ks, vs = [], []
-        if n_dense:
-            def dense_body(h, xs):
-                p, k_buf, v_buf = xs
-                h, k_buf, v_buf = self._dense_layer(h, p, k_buf, v_buf, offset)
-                return h, (k_buf, v_buf)
+    def run_layers(self, layer_params, h, k, v, offset, mask=None):
+        """Two scans (dense prefix, MoE suffix) over structurally distinct
+        param stacks. The group sizes come from the param stacks themselves
+        (not the config bounds), so the fused engine's padded uniform stacks
+        and the single-program/chained stage params both work; ``mask`` is a
+        matching {group: (L,) bool} dict for padded slots."""
+        from mlx_sharding_tpu.models.base import scan_layers
 
-            h, (kd, vd) = jax.lax.scan(
-                dense_body, h,
-                (layer_params["dense"], k[:n_dense], v[:n_dense]),
+        n_dense = (
+            next(iter(layer_params["dense"].values())).shape[0]
+            if "dense" in layer_params
+            else 0
+        )
+        ks, vs = [], []
+        if "dense" in layer_params:
+            h, kd, vd = scan_layers(
+                lambda h, p, kb, vb: self._dense_layer(h, p, kb, vb, offset),
+                h, layer_params["dense"], k[:n_dense], v[:n_dense],
+                None if mask is None else mask["dense"],
             )
             ks.append(kd)
             vs.append(vd)
-        if n_moe:
-            def moe_body(h, xs):
-                p, k_buf, v_buf = xs
-                h, k_buf, v_buf = self._moe_layer(h, p, k_buf, v_buf, offset)
-                return h, (k_buf, v_buf)
-
-            h, (km, vm) = jax.lax.scan(
-                moe_body, h,
-                (layer_params["moe"], k[n_dense:], v[n_dense:]),
+        if "moe" in layer_params:
+            h, km, vm = scan_layers(
+                lambda h, p, kb, vb: self._moe_layer(h, p, kb, vb, offset),
+                h, layer_params["moe"], k[n_dense:], v[n_dense:],
+                None if mask is None else mask["moe"],
             )
             ks.append(km)
             vs.append(vm)

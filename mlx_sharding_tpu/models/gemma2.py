@@ -67,19 +67,18 @@ class Gemma2Model(BaseModel):
         h = h + rms_norm(ff, p["post_ffw_norm"], eps, offset=1.0)
         return h, k_buf, v_buf
 
-    def run_layers(self, layer_params, h, k, v, offset):
+    def run_layers(self, layer_params, h, k, v, offset, mask=None):
         # The GLOBAL layer index travels inside the param stack
         # ("layer_idx", added by map_weights/init_params): window alternation
         # follows it, so arbitrary stage slices — including the fused SPMD
         # engine's per-device shards, which can't see start_layer — stay
         # consistent with the full model.
-        def body(h, xs):
-            p, k_buf, v_buf = xs
-            h, k_buf, v_buf = self._layer(h, p, k_buf, v_buf, offset, p["layer_idx"])
-            return h, (k_buf, v_buf)
+        from mlx_sharding_tpu.models.base import scan_layers
 
-        h, (k, v) = jax.lax.scan(body, h, (layer_params, k, v))
-        return h, k, v
+        def body(h, p, k_buf, v_buf):
+            return self._layer(h, p, k_buf, v_buf, offset, p["layer_idx"])
+
+        return scan_layers(body, h, layer_params, k, v, mask)
 
     def embed(self, params, tokens):
         # embedding scaled by sqrt(hidden) (ref gemma2.py:42-43)

@@ -57,19 +57,19 @@ class LlamaModel(BaseModel):
         ff = (jax.nn.silu(r @ p["gate_proj"]) * (r @ p["up_proj"])) @ p["down_proj"]
         return h + ff, k_buf, v_buf
 
-    def run_layers(self, layer_params, h, k, v, offset):
+    def run_layers(self, layer_params, h, k, v, offset, mask=None):
         """The stage body: scan the (local) stacked layers, threading the
         full-capacity K/V buffers (L, B, S, H, D) through as scan xs/ys.
         This is the piece the SPMD pipeline executes per tick; ``__call__``
-        wraps it with embed/head for the single-program path."""
+        wraps it with embed/head for the single-program path. ``mask`` is an
+        optional (L,) bool marking active layers — padding slots in the fused
+        engine's uniform per-stage stacks scan as no-ops."""
+        from mlx_sharding_tpu.models.base import scan_layers
 
-        def body(h, xs):
-            p, k_buf, v_buf = xs
-            h, k_buf, v_buf = self._layer(h, p, k_buf, v_buf, offset)
-            return h, (k_buf, v_buf)
+        def body(h, p, k_buf, v_buf):
+            return self._layer(h, p, k_buf, v_buf, offset)
 
-        h, (k, v) = jax.lax.scan(body, h, (layer_params, k, v))
-        return h, k, v
+        return scan_layers(body, h, layer_params, k, v, mask)
 
     def embed(self, params, tokens):
         return self.embed_tokens(params, tokens)

@@ -55,14 +55,13 @@ class MixtralModel(BaseModel):
         moe = apply_experts(flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"])
         return h + moe.reshape(b, t, hidden), k_buf, v_buf
 
-    def run_layers(self, layer_params, h, k, v, offset):
-        def body(h, xs):
-            p, k_buf, v_buf = xs
-            h, k_buf, v_buf = self._layer(h, p, k_buf, v_buf, offset)
-            return h, (k_buf, v_buf)
+    def run_layers(self, layer_params, h, k, v, offset, mask=None):
+        from mlx_sharding_tpu.models.base import scan_layers
 
-        h, (k, v) = jax.lax.scan(body, h, (layer_params, k, v))
-        return h, k, v
+        def body(h, p, k_buf, v_buf):
+            return self._layer(h, p, k_buf, v_buf, offset)
+
+        return scan_layers(body, h, layer_params, k, v, mask)
 
     def apply_head(self, params, h):
         cfg = self.config

@@ -48,20 +48,78 @@ from mlx_sharding_tpu.sample import (
 )
 
 
-def split_layer_params(layer_params: dict, num_stages: int) -> dict:
-    """{name: (total_L, …)} → {name: (S, L, …)}: contiguous, equal-size layer
-    ranges per stage — the reference's partitioning rule
-    (sharding_weight.py:16-24) restricted to even splits, which is what a
-    homogeneous mesh wants."""
-    out = {}
-    for name, w in layer_params.items():
-        total = w.shape[0]
-        if total % num_stages:
-            raise ValueError(
-                f"{total} layers not divisible into {num_stages} equal stages"
-            )
-        out[name] = w.reshape(num_stages, total // num_stages, *w.shape[1:])
-    return out
+def balanced_stage_bounds(num_layers: int, num_stages: int) -> list[tuple[int, int]]:
+    """Contiguous near-equal ``[start, end)`` bounds (larger stages first),
+    the default when the caller gives no explicit split."""
+    base, extra = divmod(num_layers, num_stages)
+    bounds, start = [], 0
+    for s in range(num_stages):
+        size = base + (1 if s < extra else 0)
+        bounds.append((start, start + size))
+        start += size
+    return bounds
+
+
+def split_stage_stacks(model, layer_params: dict, stage_bounds) -> tuple[dict, dict, int]:
+    """Split a full model's stacked layer params into per-stage uniform
+    stacks for the fused SPMD engine, supporting uneven bounds and
+    heterogeneous layer groups (DeepSeek's dense prefix + MoE suffix).
+
+    Every stage gets the SAME structure — for each layer group, ``slots =
+    max(layers of that group on any stage)`` rows, zero-padded — so the
+    arrays stack to (S, slots, …) and shard over ``pp``. A bool mask marks
+    the real rows; ``scan_layers`` turns padding slots into no-ops. This is
+    how one SPMD program serves the reference's arbitrary ``[start, end)``
+    splits (e.g. the BASELINE DeepSeek 0-14/14-27 config,
+    /root/reference/shard/utils.py:36-39) without per-stage programs.
+
+    Returns ``(stacked_params, masks, total_slots)`` where ``masks`` mirrors
+    the group structure of ``stacked_params`` ((S, slots) bool arrays) and
+    ``total_slots`` is the per-stage KV-cache layer count.
+    """
+    stage_bounds = list(stage_bounds)
+    S = len(stage_bounds)
+    if stage_bounds[0][0] != 0 or stage_bounds[-1][1] != model.config.num_hidden_layers:
+        raise ValueError(f"stage bounds {stage_bounds} must cover all layers")
+    for (a0, a1), (b0, b1) in zip(stage_bounds, stage_bounds[1:]):
+        if a1 != b0:
+            raise ValueError(f"stage bounds {stage_bounds} must be contiguous")
+    if any(e <= s for s, e in stage_bounds):
+        raise ValueError(f"stage bounds {stage_bounds} contain an empty stage")
+
+    ranges = model.layer_group_ranges()
+
+    def split_group(stack: dict, g0: int, g1: int):
+        rows_per_stage = [
+            (min(max(s, g0), g1) - g0, min(max(e, g0), g1) - g0)
+            for s, e in stage_bounds
+        ]
+        slots = max(hi - lo for lo, hi in rows_per_stage)
+        stacked = {}
+        for name, w in stack.items():
+            rows = []
+            for lo, hi in rows_per_stage:
+                part = w[lo:hi]
+                if hi - lo < slots:
+                    pad = [(0, slots - (hi - lo))] + [(0, 0)] * (w.ndim - 1)
+                    part = jnp.pad(part, pad)
+                rows.append(part)
+            stacked[name] = jnp.stack(rows)
+        mask = np.zeros((S, slots), bool)
+        for si, (lo, hi) in enumerate(rows_per_stage):
+            mask[si, : hi - lo] = True
+        return stacked, jnp.asarray(mask), slots
+
+    if list(ranges) == [None]:
+        stacked, mask, slots = split_group(layer_params, *ranges[None])
+        return stacked, mask, slots
+    stacked_all, masks_all, total = {}, {}, 0
+    for key, (g0, g1) in ranges.items():
+        stacked, mask, slots = split_group(layer_params[key], g0, g1)
+        stacked_all[key] = stacked
+        masks_all[key] = mask
+        total += slots
+    return stacked_all, masks_all, total
 
 
 def stack_stage_params(stage_param_list: list[dict]) -> dict:
@@ -89,6 +147,7 @@ class PipelineEngine:
         params: dict,
         mesh: Mesh,
         *,
+        stage_bounds=None,
         microbatches: int = 1,
         batch: int = 1,
         max_seq: int = 4096,
@@ -112,12 +171,20 @@ class PipelineEngine:
         stage_sharding = NamedSharding(mesh, P(AXIS_PP))
         replicated = NamedSharding(mesh, P())
 
-        split = split_layer_params(params["layers"], S)
+        if stage_bounds is None:
+            stage_bounds = balanced_stage_bounds(cfg.num_hidden_layers, S)
+        elif len(stage_bounds) != S:
+            raise ValueError(
+                f"{len(stage_bounds)} stage bounds for a {S}-stage pp mesh"
+            )
+        self.stage_bounds = [tuple(b) for b in stage_bounds]
+        split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
         self.layer_params = jax.device_put(split, stage_sharding)
+        self.layer_masks = jax.device_put(masks, stage_sharding)
         self.shared_params = jax.device_put(
             {k: v for k, v in params.items() if k != "layers"}, replicated
         )
-        self.layers_per_stage = cfg.num_hidden_layers // S
+        self.layers_per_stage = slots
 
         self._decode = self._build_step(t_len=1, with_sampling=True)
         self._prefill = self._build_step(t_len=prefill_chunk, with_sampling=False)
@@ -134,7 +201,7 @@ class PipelineEngine:
             self.microbatches,
             self.batch,
         )
-        shape = (S, L, M + 1, B, self.max_seq, cfg.num_key_value_heads)
+        shape = (S, L, M + 1, B, self.max_seq, self.model.cache_num_heads())
         sharding = NamedSharding(self.mesh, P(AXIS_PP))
         return KVCache(
             k=jax.device_put(jnp.zeros((*shape, k_dim), self.cache_dtype), sharding),
@@ -146,10 +213,11 @@ class PipelineEngine:
     def _build_step(self, t_len: int, with_sampling: bool):
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
 
-        def body(layer_params, shared, tokens, k, v, offset, n_valid):
+        def body(layer_params, masks, shared, tokens, k, v, offset, n_valid):
             # Per-device views: layer_params (1, L, …) → (L, …); k/v
             # (1, L, M+1, B, seq, H, D) → (L, M+1, …).
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
+            masks = jax.tree.map(lambda x: x[0], masks)
             k, v = k[0], v[0]
             s = jax.lax.axis_index(AXIS_PP)
             h0 = jnp.zeros((B, t_len, model.config.hidden_size), k.dtype)
@@ -170,7 +238,9 @@ class PipelineEngine:
                 m_write = jnp.where(is_real, m, M)
                 k_m = jax.lax.dynamic_index_in_dim(k, m_write, 1, keepdims=False)
                 v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
-                h_out, k_m, v_m = model.run_layers(layer_params, h_in, k_m, v_m, offset)
+                h_out, k_m, v_m = model.run_layers(
+                    layer_params, h_in, k_m, v_m, offset, mask=masks
+                )
                 k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
                 v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
 
@@ -200,6 +270,7 @@ class PipelineEngine:
             mesh=self.mesh,
             in_specs=(
                 jax.tree.map(lambda _: spec_stage, self.layer_params),
+                jax.tree.map(lambda _: spec_stage, self.layer_masks),
                 jax.tree.map(lambda _: spec_rep, self.shared_params),
                 spec_rep,  # tokens
                 spec_stage,  # k
@@ -213,9 +284,10 @@ class PipelineEngine:
 
         if with_sampling:
 
-            def step(layer_params, shared, tokens, cache, recent, key, sp, n_valid):
+            def step(layer_params, masks, shared, tokens, cache, recent, key, sp, n_valid):
                 logits, k, v = smapped(
-                    layer_params, shared, tokens, cache.k, cache.v, cache.offset, n_valid
+                    layer_params, masks, shared, tokens, cache.k, cache.v,
+                    cache.offset, n_valid,
                 )
                 key, sub = jax.random.split(key)
                 flat = logits.reshape(M * B, -1)
@@ -224,16 +296,17 @@ class PipelineEngine:
                 new_cache = KVCache(k=k, v=v, offset=cache.offset + n_valid)
                 return tok.reshape(M, B), logprobs, new_cache, recent, key
 
-            return jax.jit(step, donate_argnums=(3, 4))
+            return jax.jit(step, donate_argnums=(4, 5))
 
-        def step(layer_params, shared, tokens, cache, n_valid):
+        def step(layer_params, masks, shared, tokens, cache, n_valid):
             logits, k, v = smapped(
-                layer_params, shared, tokens, cache.k, cache.v, cache.offset, n_valid
+                layer_params, masks, shared, tokens, cache.k, cache.v,
+                cache.offset, n_valid,
             )
             new_cache = KVCache(k=k, v=v, offset=cache.offset + n_valid)
             return logits, new_cache
 
-        return jax.jit(step, donate_argnums=(3,))
+        return jax.jit(step, donate_argnums=(4,))
 
     @staticmethod
     def _sample_fn(logits, recent, key, sp):
@@ -288,8 +361,8 @@ class PipelineEngine:
             if n_valid < c:
                 chunk = np.pad(chunk, ((0, 0), (0, 0), (0, c - n_valid)))
             logits, cache = self._prefill(
-                self.layer_params, self.shared_params, jnp.asarray(chunk), cache,
-                jnp.asarray(n_valid, jnp.int32),
+                self.layer_params, self.layer_masks, self.shared_params,
+                jnp.asarray(chunk), cache, jnp.asarray(n_valid, jnp.int32),
             )
         tok, logprobs, recent, key = self._sample(logits, recent, key, sp)
 
@@ -297,8 +370,8 @@ class PipelineEngine:
         one = jnp.asarray(1, jnp.int32)
         while True:
             next_tok, next_logprobs, cache, recent, key = self._decode(
-                self.layer_params, self.shared_params, tok[..., None], cache,
-                recent, key, sp, one,
+                self.layer_params, self.layer_masks, self.shared_params,
+                tok[..., None], cache, recent, key, sp, one,
             )
             yield int(tok[0, 0]), logprobs
             n += 1

@@ -94,6 +94,7 @@ class ModelProvider:
         end_layer: Optional[int] = None,
         num_stages: Optional[int] = None,
         stage_bounds: Optional[list[tuple[int, int]]] = None,
+        engine: str = "fused",
         max_seq: int = 4096,
         prefill_chunk: int = 256,
         cache_dtype=None,
@@ -106,11 +107,16 @@ class ModelProvider:
         self.end_layer = end_layer
         self.num_stages = num_stages
         self.stage_bounds = stage_bounds
+        self.engine = engine
         self.max_seq = max_seq
         self.prefill_chunk = prefill_chunk
         self.cache_dtype = cache_dtype
         self.trust_remote_paths = trust_remote_paths
         self._key: Optional[str] = None
+        # hot-swap loads must be serialized: two concurrent requests naming
+        # different models would otherwise race _key/generator mutation and
+        # double-load onto the device
+        self._load_lock = threading.Lock()
         self.generator = None
         self.tokenizer = None
         if default_model:
@@ -127,58 +133,61 @@ class ModelProvider:
         # (ref shard/openai_api.py:96-104 cwd-relative validation).
         p = Path(name)
         if not self.trust_remote_paths:
-            resolved = p.resolve()
-            if not str(resolved).startswith(str(Path.cwd().resolve())):
+            # Proper containment check — a plain str.startswith would let a
+            # sibling like /root/repo-evil pass for cwd /root/repo.
+            if not p.resolve().is_relative_to(Path.cwd().resolve()):
                 raise ValueError(f"model path {name!r} escapes the working directory")
         return name
 
     def load(self, name: str):
         target = self._validate(name)
-        if self._key == target:
-            return self.generator, self.tokenizer
-        logger.info("loading model %s", target)
-        import jax.numpy as jnp
+        with self._load_lock:
+            if self._key == target:
+                return self.generator, self.tokenizer
+            logger.info("loading model %s", target)
+            import jax.numpy as jnp
 
-        from mlx_sharding_tpu.generate import Generator
-        from mlx_sharding_tpu.loading import get_model_path, load_model
+            from mlx_sharding_tpu.generate import Generator
+            from mlx_sharding_tpu.loading import get_model_path, load_model
 
-        cache_dtype = self.cache_dtype or jnp.bfloat16
-        if self.stage_bounds:
-            from mlx_sharding_tpu.parallel.chained import load_chained_pipeline
+            cache_dtype = self.cache_dtype or jnp.bfloat16
+            if self.stage_bounds and self.engine == "chained":
+                from mlx_sharding_tpu.parallel.chained import load_chained_pipeline
 
-            generator = load_chained_pipeline(
-                target, self.stage_bounds, dtype=cache_dtype,
-                max_seq=self.max_seq, cache_dtype=cache_dtype,
-                prefill_chunk=self.prefill_chunk,
-            )
+                generator = load_chained_pipeline(
+                    target, self.stage_bounds, dtype=cache_dtype,
+                    max_seq=self.max_seq, cache_dtype=cache_dtype,
+                    prefill_chunk=self.prefill_chunk,
+                )
+            else:
+                model, params = load_model(
+                    target, self.start_layer, self.end_layer, dtype=cache_dtype
+                )
+                stages = (
+                    len(self.stage_bounds) if self.stage_bounds
+                    else (self.num_stages or 1)
+                )
+                if stages > 1:
+                    from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+                    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+                    generator = PipelineEngine(
+                        model, params, pipeline_mesh(stages),
+                        stage_bounds=self.stage_bounds,
+                        max_seq=self.max_seq, cache_dtype=cache_dtype,
+                        prefill_chunk=self.prefill_chunk,
+                    )
+                else:
+                    generator = Generator(
+                        model, params, max_seq=self.max_seq,
+                        cache_dtype=cache_dtype,
+                        prefill_chunk=self.prefill_chunk,
+                    )
             from transformers import AutoTokenizer
 
             tokenizer = AutoTokenizer.from_pretrained(str(get_model_path(target)))
             self._set(target, generator, tokenizer)
             return self.generator, self.tokenizer
-        model, params = load_model(
-            target, self.start_layer, self.end_layer,
-            dtype=self.cache_dtype or jnp.bfloat16,
-        )
-        if self.num_stages and self.num_stages > 1:
-            from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
-            from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
-
-            generator = PipelineEngine(
-                model, params, pipeline_mesh(self.num_stages),
-                max_seq=self.max_seq, cache_dtype=cache_dtype,
-                prefill_chunk=self.prefill_chunk,
-            )
-        else:
-            generator = Generator(
-                model, params, max_seq=self.max_seq, cache_dtype=cache_dtype,
-                prefill_chunk=self.prefill_chunk,
-            )
-        from transformers import AutoTokenizer
-
-        tokenizer = AutoTokenizer.from_pretrained(str(get_model_path(target)))
-        self._set(target, generator, tokenizer)
-        return self.generator, self.tokenizer
 
     def _set(self, key, generator, tokenizer):
         # operator-supplied chat template wins over the checkpoint's
@@ -220,7 +229,14 @@ class APIHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _error(self, code: int, message: str):
-        self._json(code, {"error": {"message": message, "type": "invalid_request_error"}})
+        # OpenAI error envelope with a type that reflects the status class,
+        # so clients can distinguish bad requests from engine failures.
+        kind = (
+            "invalid_request_error" if code == 400
+            else "not_found_error" if code == 404
+            else "server_error"
+        )
+        self._json(code, {"error": {"message": message, "type": kind, "code": code}})
 
     # ------------------------------------------------------------- routing
     def do_OPTIONS(self):
@@ -613,7 +629,12 @@ def main(argv=None):
     parser.add_argument("--num-stages", type=int, default=None,
                         help="pipeline stages on the local mesh (fused SPMD engine)")
     parser.add_argument("--stage-bounds", default=None,
-                        help="chained-pipeline bounds, e.g. '0-14,14-27'")
+                        help="pipeline stage bounds, e.g. '0-14,14-27' "
+                        "(uneven splits and MoE/dense mixes allowed)")
+    parser.add_argument("--engine", choices=("fused", "chained"), default="fused",
+                        help="pipeline engine for --stage-bounds: fused SPMD "
+                        "(one program per token, default) or chained per-stage "
+                        "programs")
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
     parser.add_argument("--log-level", default="INFO")
@@ -629,6 +650,8 @@ def main(argv=None):
     parser.add_argument("--num-processes", type=int, default=None)
     args = parser.parse_args(argv)
 
+    if args.engine == "chained" and not args.stage_bounds:
+        parser.error("--engine chained requires --stage-bounds")
     logging.basicConfig(level=args.log_level.upper())
     if args.coordinator:
         import jax
@@ -649,6 +672,7 @@ def main(argv=None):
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
         num_stages=args.num_stages, stage_bounds=stage_bounds,
+        engine=args.engine,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         chat_template=chat_template,
     )

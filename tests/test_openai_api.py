@@ -34,6 +34,7 @@ def server():
     provider.default_model = "tiny"
     provider.trust_remote_paths = False
     provider._key = None
+    provider._load_lock = threading.Lock()
     provider._set("tiny", gen, ByteTokenizer())
     srv = make_server(provider, "127.0.0.1", 0)
     port = srv.server_address[1]

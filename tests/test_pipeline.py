@@ -11,7 +11,7 @@ from mlx_sharding_tpu.config import LlamaConfig
 from mlx_sharding_tpu.generate import Generator
 from mlx_sharding_tpu.models.llama import LlamaModel
 from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
-from mlx_sharding_tpu.parallel.pipeline import PipelineEngine, split_layer_params
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine, split_stage_stacks
 
 TINY = dict(
     vocab_size=256,
@@ -39,16 +39,44 @@ def _engine(model, params, stages, micro=1, **kw):
     )
 
 
-def test_split_layer_params():
+class _Homog:
+    """Minimal model stub for split_stage_stacks: homogeneous 8-layer group."""
+
+    class config:
+        num_hidden_layers = 8
+
+    def layer_group_ranges(self):
+        return {None: (0, 8)}
+
+
+def test_split_stage_stacks_even():
     p = {"w": jnp.arange(24).reshape(8, 3)}
-    s = split_layer_params(p, 4)
-    assert s["w"].shape == (4, 2, 3)
+    s, mask, slots = split_stage_stacks(_Homog(), p, [(0, 2), (2, 4), (4, 6), (6, 8)])
+    assert s["w"].shape == (4, 2, 3) and slots == 2
+    assert bool(mask.all())
     np.testing.assert_array_equal(np.asarray(s["w"][1, 0]), np.asarray(p["w"][2]))
 
 
-def test_split_rejects_uneven():
-    with pytest.raises(ValueError, match="not divisible"):
-        split_layer_params({"w": jnp.zeros((7, 2))}, 4)
+def test_split_stage_stacks_uneven_pads_and_masks():
+    p = {"w": jnp.arange(16).reshape(8, 2)}
+    s, mask, slots = split_stage_stacks(_Homog(), p, [(0, 5), (5, 7), (7, 8)])
+    assert s["w"].shape == (3, 5, 2) and slots == 5
+    np.testing.assert_array_equal(
+        np.asarray(mask),
+        [[True] * 5, [True, True, False, False, False], [True] + [False] * 4],
+    )
+    np.testing.assert_array_equal(np.asarray(s["w"][2, 0]), np.asarray(p["w"][7]))
+    assert not np.asarray(s["w"][2, 1:]).any()  # zero padding
+
+
+def test_split_stage_stacks_rejects_bad_bounds():
+    p = {"w": jnp.zeros((8, 2))}
+    with pytest.raises(ValueError, match="cover all layers"):
+        split_stage_stacks(_Homog(), p, [(0, 4), (4, 7)])
+    with pytest.raises(ValueError, match="contiguous"):
+        split_stage_stacks(_Homog(), p, [(0, 4), (5, 8)])
+    with pytest.raises(ValueError, match="empty stage"):
+        split_stage_stacks(_Homog(), p, [(0, 8), (8, 8)])
 
 
 def test_pipeline_matches_single_device_greedy(model_and_params):
@@ -142,16 +170,16 @@ def test_pipeline_microbatched_decode(model_and_params):
     cache = eng.init_cache()
     chunk = np.pad(prompt_arr, ((0, 0), (0, 0), (0, 8 - len(prompt))))
     logits, cache = eng._prefill(
-        eng.layer_params, eng.shared_params, jnp.asarray(chunk), cache,
-        jnp.asarray(len(prompt), jnp.int32),
+        eng.layer_params, eng.layer_masks, eng.shared_params, jnp.asarray(chunk),
+        cache, jnp.asarray(len(prompt), jnp.int32),
     )
     recent = init_recent_tokens(M, 20)
     tok, _, recent, key = eng._sample(logits, recent, key, sp)
     seqs = [[int(tok[m, 0])] for m in range(M)]
     for _ in range(5):
         tok, _, cache, recent, key = eng._decode(
-            eng.layer_params, eng.shared_params, tok[..., None], cache,
-            recent, key, sp, jnp.asarray(1, jnp.int32),
+            eng.layer_params, eng.layer_masks, eng.shared_params, tok[..., None],
+            cache, recent, key, sp, jnp.asarray(1, jnp.int32),
         )
         for m in range(M):
             seqs[m].append(int(tok[m, 0]))

@@ -34,6 +34,7 @@ def server():
     provider.default_model = "tiny-pp"
     provider.trust_remote_paths = False
     provider._key = None
+    provider._load_lock = threading.Lock()
     provider._set("tiny-pp", eng, ByteTokenizer())
     srv = make_server(provider, "127.0.0.1", 0)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
