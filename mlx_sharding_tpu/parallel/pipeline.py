@@ -44,6 +44,7 @@ from mlx_sharding_tpu.sample import (
     init_recent_tokens,
     make_sampler_params,
     sample_token,
+    sample_token_batched,
     update_recent_tokens,
 )
 
@@ -189,6 +190,19 @@ class PipelineEngine:
         self._decode = self._build_step(t_len=1, with_sampling=True)
         self._prefill = self._build_step(t_len=prefill_chunk, with_sampling=False)
         self._sample = jax.jit(self._sample_fn, donate_argnums=(1,))
+        # continuous-batching programs, built on first use by the scheduler
+        self._decode_cb = None
+        self._prefill_slot = None
+
+    def decode_cb(self):
+        if self._decode_cb is None:
+            self._decode_cb = self._build_decode_cb()
+        return self._decode_cb
+
+    def prefill_slot(self):
+        if self._prefill_slot is None:
+            self._prefill_slot = self._build_prefill_slot()
+        return self._prefill_slot
 
     # ------------------------------------------------------------------
     def init_cache(self) -> KVCache:
@@ -203,30 +217,39 @@ class PipelineEngine:
         )
         shape = (S, L, M + 1, B, self.max_seq, self.model.cache_num_heads())
         sharding = NamedSharding(self.mesh, P(AXIS_PP))
+        # offset is PER MICROBATCH SLOT: continuous batching runs a different
+        # request (at a different sequence position) in every slot
         return KVCache(
             k=jax.device_put(jnp.zeros((*shape, k_dim), self.cache_dtype), sharding),
             v=jax.device_put(jnp.zeros((*shape, v_dim), self.cache_dtype), sharding),
-            offset=jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())),
+            offset=jax.device_put(
+                jnp.zeros((M,), jnp.int32), NamedSharding(self.mesh, P())
+            ),
         )
 
     # ------------------------------------------------------------------
     def _build_step(self, t_len: int, with_sampling: bool):
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
 
-        def body(layer_params, masks, shared, tokens, k, v, offset, n_valid):
+        def body(layer_params, masks, shared, tokens, k, v, offsets, active, n_valid):
             # Per-device views: layer_params (1, L, …) → (L, …); k/v
-            # (1, L, M+1, B, seq, H, D) → (L, M+1, …).
+            # (1, L, M+1, B, seq, H, D) → (L, M+1, …). ``offsets`` is (M,) —
+            # each slot's sequence position — and ``active`` (M,) bool marks
+            # slots holding a live request (inactive slots' compute is routed
+            # to the scratch cache slice and their logits are garbage the
+            # scheduler ignores).
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
             masks = jax.tree.map(lambda x: x[0], masks)
             k, v = k[0], v[0]
             s = jax.lax.axis_index(AXIS_PP)
             h0 = jnp.zeros((B, t_len, model.config.hidden_size), k.dtype)
             out0 = jnp.zeros((M, B, model.config.vocab_size), jnp.float32)
+            offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
 
             def tick(carry, t):
                 h_buf, k, v, out = carry
                 m = jnp.clip(t - s, 0, M - 1)
-                is_real = (t >= s) & (t - s < M)
+                is_real = (t >= s) & (t - s < M) & active[m]
 
                 tok_m = jax.lax.dynamic_index_in_dim(
                     tokens, jnp.clip(t, 0, M - 1), 0, keepdims=False
@@ -236,6 +259,7 @@ class PipelineEngine:
 
                 # scratch slice M swallows non-real writes
                 m_write = jnp.where(is_real, m, M)
+                offset = offsets_pad[m_write]
                 k_m = jax.lax.dynamic_index_in_dim(k, m_write, 1, keepdims=False)
                 v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
                 h_out, k_m, v_m = model.run_layers(
@@ -275,19 +299,24 @@ class PipelineEngine:
                 spec_rep,  # tokens
                 spec_stage,  # k
                 spec_stage,  # v
-                spec_rep,  # offset
+                spec_rep,  # offsets (M,)
+                spec_rep,  # active (M,)
                 spec_rep,  # n_valid
             ),
             out_specs=(spec_rep, spec_stage, spec_stage),
             check_vma=False,
         )
+        if t_len == 1:
+            self._smapped_decode = smapped  # shared by the continuous-batching step
+
+        all_active = jnp.ones((M,), bool)
 
         if with_sampling:
 
             def step(layer_params, masks, shared, tokens, cache, recent, key, sp, n_valid):
                 logits, k, v = smapped(
                     layer_params, masks, shared, tokens, cache.k, cache.v,
-                    cache.offset, n_valid,
+                    cache.offset, all_active, n_valid,
                 )
                 key, sub = jax.random.split(key)
                 flat = logits.reshape(M * B, -1)
@@ -301,12 +330,125 @@ class PipelineEngine:
         def step(layer_params, masks, shared, tokens, cache, n_valid):
             logits, k, v = smapped(
                 layer_params, masks, shared, tokens, cache.k, cache.v,
-                cache.offset, n_valid,
+                cache.offset, all_active, n_valid,
             )
             new_cache = KVCache(k=k, v=v, offset=cache.offset + n_valid)
             return logits, new_cache
 
         return jax.jit(step, donate_argnums=(4,))
+
+    # ---------------------------------------------------- continuous batching
+    def _build_decode_cb(self):
+        """Decode step for continuous batching: per-slot offsets advance only
+        on active slots, per-slot sampler params and PRNG keys (each slot
+        reproduces the solo request with that seed), logits of inactive slots
+        sampled-but-ignored. Reuses the same shard_map body as the uniform
+        decode; only the host-visible wrapper differs."""
+        smapped, M, B = self._smapped_decode, self.microbatches, self.batch
+        if B != 1:
+            raise ValueError("continuous batching expects batch=1 per slot")
+
+        def step(
+            layer_params, masks, shared, tokens, cache, active, recent, keys,
+            sp, rep_sizes,
+        ):
+            one = jnp.asarray(1, jnp.int32)
+            logits, k, v = smapped(
+                layer_params, masks, shared, tokens, cache.k, cache.v,
+                cache.offset, active, one,
+            )
+            split = jax.vmap(jax.random.split)(keys)  # (M, 2, 2)
+            keys, subs = split[:, 0], split[:, 1]
+            # per-slot effective repetition window: only the last rep_sizes[m]
+            # entries of the fixed-width buffer participate, so each slot's
+            # penalty semantics match a solo run with that context size
+            W = recent.shape[1]
+            valid = jnp.arange(W)[None, :] >= (W - rep_sizes)[:, None]
+            tok, logprobs = sample_token_batched(
+                subs, logits.reshape(M, -1), sp, jnp.where(valid, recent, -1)
+            )
+            recent = update_recent_tokens(recent, tok)
+            new_cache = KVCache(
+                k=k, v=v, offset=cache.offset + active.astype(jnp.int32)
+            )
+            return tok.reshape(M, B), logprobs, new_cache, recent, keys
+
+        return jax.jit(step, donate_argnums=(4, 6, 7))
+
+    def _build_prefill_slot(self):
+        """Prefill one chunk of ONE slot's request while other slots' state
+        stays untouched — the admit path of continuous batching. S ticks
+        (single microbatch): stage s processes at tick s, cache writes land in
+        slice ``slot`` at that slot's offset, last stage banks the
+        last-valid-position logits."""
+        model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
+        t_len = self.prefill_chunk
+
+        def body(layer_params, masks, shared, tokens, slot, k, v, offsets, n_valid):
+            layer_params = jax.tree.map(lambda x: x[0], layer_params)
+            masks = jax.tree.map(lambda x: x[0], masks)
+            k, v = k[0], v[0]
+            s = jax.lax.axis_index(AXIS_PP)
+            h0 = jnp.zeros((B, t_len, model.config.hidden_size), k.dtype)
+            out0 = jnp.zeros((B, model.config.vocab_size), jnp.float32)
+            offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
+
+            def tick(carry, t):
+                h_buf, k, v, out = carry
+                is_real = t == s
+                h_first = model.embed(shared, tokens).astype(h_buf.dtype)
+                h_in = jnp.where(s == 0, h_first, h_buf)
+                m_write = jnp.where(is_real, slot, M)
+                offset = offsets_pad[m_write]
+                k_m = jax.lax.dynamic_index_in_dim(k, m_write, 1, keepdims=False)
+                v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
+                h_out, k_m, v_m = model.run_layers(
+                    layer_params, h_in, k_m, v_m, offset, mask=masks
+                )
+                k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
+                v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
+
+                last = jax.lax.dynamic_index_in_dim(h_out, n_valid - 1, 1, keepdims=False)
+                logits = model.apply_head(shared, last).astype(jnp.float32)
+                out = jnp.where(is_real & (s == S - 1), logits, out)
+
+                h_next = jax.lax.ppermute(
+                    h_out, AXIS_PP, [(i, (i + 1) % S) for i in range(S)]
+                )
+                return (h_next, k, v, out), None
+
+            (_, k, v, out), _ = jax.lax.scan(tick, (h0, k, v, out0), jnp.arange(S))
+            out = jax.lax.psum(out, AXIS_PP)
+            return out, k[None], v[None]
+
+        spec_stage, spec_rep = P(AXIS_PP), P()
+        smapped = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(
+                jax.tree.map(lambda _: spec_stage, self.layer_params),
+                jax.tree.map(lambda _: spec_stage, self.layer_masks),
+                jax.tree.map(lambda _: spec_rep, self.shared_params),
+                spec_rep,  # tokens (B, T)
+                spec_rep,  # slot
+                spec_stage,  # k
+                spec_stage,  # v
+                spec_rep,  # offsets
+                spec_rep,  # n_valid
+            ),
+            out_specs=(spec_rep, spec_stage, spec_stage),
+            check_vma=False,
+        )
+
+        def step(layer_params, masks, shared, tokens, slot, cache, n_valid):
+            logits, k, v = smapped(
+                layer_params, masks, shared, tokens, slot, cache.k, cache.v,
+                cache.offset, n_valid,
+            )
+            offsets = cache.offset.at[slot].add(n_valid)
+            return logits, KVCache(k=k, v=v, offset=offsets)
+
+        return jax.jit(step, donate_argnums=(5,))
 
     @staticmethod
     def _sample_fn(logits, recent, key, sp):

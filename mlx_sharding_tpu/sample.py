@@ -129,6 +129,72 @@ def sample_token(
     return token.astype(jnp.int32), logprobs
 
 
+def stack_sampler_params(params_list: list[SamplerParams]) -> SamplerParams:
+    """Per-request sampler params → one batched pytree with leading (B,)
+    (bias buffers padded to a common width). Used by the continuous-batching
+    scheduler, where every microbatch slot runs its own request with its own
+    temperature/top-p/penalty/bias."""
+    slots = max(p.bias_indices.shape[0] for p in params_list)
+
+    def pad(p: SamplerParams) -> SamplerParams:
+        n = p.bias_indices.shape[0]
+        if n == slots:
+            return p
+        return p._replace(
+            bias_indices=jnp.pad(p.bias_indices, (0, slots - n)),
+            bias_values=jnp.pad(p.bias_values, (0, slots - n)),
+        )
+
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *[pad(p) for p in params_list])
+
+
+def set_sampler_slot(
+    batched: SamplerParams, slot: int, one: SamplerParams
+) -> SamplerParams:
+    """Write one request's params into row ``slot`` of a batched pytree
+    (bias buffers truncated/padded to the batched width)."""
+    width = batched.bias_indices.shape[1]
+    n = one.bias_indices.shape[0]
+    if n < width:
+        one = one._replace(
+            bias_indices=jnp.pad(one.bias_indices, (0, width - n)),
+            bias_values=jnp.pad(one.bias_values, (0, width - n)),
+        )
+    elif n > width:
+        raise ValueError(
+            f"logit_bias with {n} entries exceeds the scheduler's per-slot "
+            f"bias width {width}"
+        )
+    return jax.tree.map(lambda full, x: full.at[slot].set(x), batched, one)
+
+
+def sample_token_batched(
+    keys: jax.Array,  # (B, 2) uint32 — one PRNG key per row
+    logits: jax.Array,  # (B, V) f32
+    params: SamplerParams,  # every leaf with leading (B,)
+    recent_tokens: jax.Array,  # (B, W) int32, -1 padded
+) -> tuple[jax.Array, jax.Array]:
+    """Per-row sampling with per-row params and per-row PRNG keys — each
+    continuous-batching slot behaves exactly like a solo request with that
+    seed, so draining a slot and re-running the request serially reproduces
+    its tokens."""
+    logits = logits.astype(jnp.float32)
+    logits = jax.vmap(lambda l, i, v: l.at[i].add(v))(
+        logits, params.bias_indices, params.bias_values
+    )
+    logits = jax.vmap(
+        lambda l, r, p: apply_repetition_penalty(l[None], r[None], p)[0]
+    )(logits, recent_tokens, params.repetition_penalty)
+
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    filtered = jax.vmap(top_p_filter)(logits / safe_temp, params.top_p)
+    sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(keys, filtered)
+    token = jnp.where(params.temperature > 0, sampled, greedy)
+    return token.astype(jnp.int32), logprobs
+
+
 def update_recent_tokens(recent: jax.Array, token: jax.Array) -> jax.Array:
     """Shift the (B, W) window left and append the new token — the device-side
     version of the reference's ``repetition_context`` deque trim

@@ -1,0 +1,354 @@
+"""Continuous batching scheduler over the fused engine's microbatch axis.
+
+The reference serializes requests entirely — one request owns the whole
+pipeline until it finishes (single-threaded stdlib HTTP front end,
+ref: shard/openai_api.py:543-563). Round 1 of this repo kept that behavior
+(a generation lock). This module replaces it with slot-level continuous
+batching, the thing the fused engine's ``M`` axis was designed for:
+
+- every microbatch slot holds an independent request with its own KV-cache
+  offset, sampler params, PRNG key and repetition window;
+- a single scheduler thread owns the engine and loops: admit pending
+  requests into free slots (chunked prefill that leaves other slots'
+  state untouched), then run ONE fused decode step advancing every active
+  slot by one token;
+- tokens stream out through per-request queues; a slot is reclaimed when
+  its request hits max_tokens or its consumer disappears (client
+  disconnect / stop sequence matched by the server layer).
+
+Determinism: each slot samples with its own PRNG-key chain seeded from the
+request's seed, so a request's token stream is identical whether it ran
+alone or interleaved with others (tested in tests/test_scheduler.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_sharding_tpu.cache import KVCache
+from mlx_sharding_tpu.sample import (
+    SamplerParams,
+    make_sampler_params,
+    sample_token_batched,
+    set_sampler_slot,
+    stack_sampler_params,
+)
+
+
+@dataclass
+class _Request:
+    prompt: np.ndarray  # (T,) int32
+    sp: SamplerParams
+    seed: int
+    max_tokens: int
+    rep_context: int
+    out: queue.Queue = field(default_factory=lambda: queue.Queue())
+    cancelled: bool = False
+    slot: int = -1
+    produced: int = 0
+    prefill_pos: int = 0  # next prompt index to prefill; admission is chunked
+
+
+class ContinuousBatcher:
+    """Drives a :class:`PipelineEngine` (built with ``microbatches=M``,
+    ``batch=1``) as an M-slot continuous-batching server backend.
+
+    ``generate_step`` has the same contract as ``Generator.generate_step`` /
+    ``PipelineEngine.generate_step`` — the API server uses it unchanged, but
+    without the global generation lock (``concurrent = True``).
+    """
+
+    concurrent = True
+
+    def __init__(self, engine, *, repetition_window: int = 64):
+        if engine.batch != 1:
+            raise ValueError("continuous batching expects engine batch=1")
+        self.engine = engine
+        self.M = engine.microbatches
+        self.W = repetition_window
+        self._submit: queue.Queue = queue.Queue()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._start_lock = threading.Lock()
+
+        # device-side per-slot state
+        self.cache: KVCache = engine.init_cache()
+        self.recent = jnp.full((self.M, self.W), -1, jnp.int32)
+        self.keys = jnp.stack([jax.random.PRNGKey(0)] * self.M)
+        # bias width 512 covers OpenAI's documented logit_bias cap (300);
+        # larger requests are rejected on the submitting thread
+        self.sp = stack_sampler_params(
+            [make_sampler_params(min_bias_slots=512) for _ in range(self.M)]
+        )
+        self.rep_sizes = jnp.full((self.M,), self.W, jnp.int32)
+        self.active = jnp.zeros((self.M,), bool)
+        self.last_tok = jnp.zeros((self.M, 1), jnp.int32)
+
+        # host-side slot table
+        self._slots: list[Optional[_Request]] = [None] * self.M
+
+        self._first_sample = jax.jit(self._first_sample_fn)
+        self._set_active = jax.jit(
+            lambda active, slot, val: active.at[slot].set(val)
+        )
+
+    # ------------------------------------------------------------- public
+    def generate_step(
+        self,
+        prompt_tokens,
+        *,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        repetition_penalty: Optional[float] = None,
+        repetition_context_size: int = 20,
+        logit_bias: Optional[dict[int, float]] = None,
+        seed: Optional[int] = None,
+        max_tokens: int = 256,
+    ):
+        import time as _time
+
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if prompt.size + max_tokens > self.engine.max_seq:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_tokens ({max_tokens}) exceeds "
+                f"KV capacity {self.engine.max_seq}"
+            )
+        sp = make_sampler_params(temperature, top_p, repetition_penalty, logit_bias)
+        if sp.bias_indices.shape[0] > self.sp.bias_indices.shape[1]:
+            raise ValueError(
+                f"logit_bias with {len(logit_bias)} entries exceeds the "
+                f"scheduler's per-slot bias width "
+                f"{self.sp.bias_indices.shape[1]}"
+            )
+        if repetition_penalty is not None and repetition_context_size > self.W:
+            # silently shrinking the window would make --concurrent output
+            # diverge from the serial path for the same request
+            raise ValueError(
+                f"repetition_context_size {repetition_context_size} exceeds "
+                f"the scheduler's window {self.W}"
+            )
+        req = _Request(
+            prompt=prompt,
+            sp=sp,
+            seed=int(_time.time_ns()) & 0x7FFFFFFF if seed is None else seed,
+            max_tokens=max_tokens,
+            rep_context=min(repetition_context_size, self.W),
+        )
+        self._ensure_running()
+        self._submit.put(req)
+        try:
+            while True:
+                item = req.out.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            req.cancelled = True  # scheduler reclaims the slot next tick
+
+    def close(self):
+        self._stop = True
+        if self._thread is not None:
+            self._submit.put(None)  # wake the idle wait
+            self._thread.join(timeout=10)
+
+    # ------------------------------------------------------------ internals
+    def _ensure_running(self):
+        with self._start_lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._loop, name="continuous-batcher", daemon=True
+                )
+                self._thread.start()
+
+    def _first_sample_fn(self, logits, keys, sp, recent, rep_sizes, slot):
+        """Sample the first token of the request in ``slot`` from its prefill
+        logits, using the same split-then-sample key chain as the decode
+        step, leaving other slots' keys untouched."""
+        split = jax.random.split(keys[slot])
+        key_new, sub = split[0], split[1]
+        row = jnp.arange(self.W) >= self.W - rep_sizes[slot]
+        masked = jnp.where(row, recent[slot], -1)
+        tok, logprobs = sample_token_batched(
+            sub[None],
+            logits.reshape(1, -1),
+            jax.tree.map(lambda x: x[slot][None], sp),
+            masked[None],
+        )
+        keys = keys.at[slot].set(key_new)
+        recent = recent.at[slot].set(
+            jnp.concatenate([recent[slot, 1:], tok.astype(jnp.int32)])
+        )
+        return tok[0], logprobs[0], keys, recent
+
+    def _assign_slot(self, req: _Request, slot: int):
+        """Claim ``slot`` for ``req`` and reset its device-side state: offset
+        0, repetition window seeded from the prompt tail (same as
+        init_recent_tokens in the serial path), the request's sampler params
+        and PRNG key. Prefill happens incrementally in the loop — one chunk
+        per scheduler tick — so active slots keep decoding during admission."""
+        W = self.W
+        prompt = req.prompt
+        self.cache = self.cache._replace(
+            offset=self.cache.offset.at[slot].set(0)
+        )
+        self.sp = set_sampler_slot(self.sp, slot, req.sp)
+        self.rep_sizes = self.rep_sizes.at[slot].set(req.rep_context)
+        self._slots[slot] = req
+        req.slot = slot
+        req.prefill_pos = 0
+
+    def _prefill_one_chunk(self, req: _Request):
+        """Run ONE prefill chunk for a mid-admission request; on the last
+        chunk, sample the first token and activate the slot for decode."""
+        eng = self.engine
+        c = eng.prefill_chunk
+        slot_arr = jnp.asarray(req.slot, jnp.int32)
+        chunk = req.prompt[req.prefill_pos : req.prefill_pos + c]
+        n_valid = chunk.size
+        if n_valid < c:
+            chunk = np.pad(chunk, (0, c - n_valid))
+        logits, self.cache = eng.prefill_slot()(
+            eng.layer_params, eng.layer_masks, eng.shared_params,
+            jnp.asarray(chunk[None]), slot_arr, self.cache,
+            jnp.asarray(n_valid, jnp.int32),
+        )
+        req.prefill_pos += n_valid
+        if req.prefill_pos < req.prompt.size:
+            return
+
+        # Seed the PRNG key and repetition window only NOW: decode ticks for
+        # other slots ran between this request's chunks and they split/shift
+        # ALL M rows — setting these at assignment would leave the slot with
+        # mangled state by prefill completion and break the deterministic
+        # serial-parity guarantee for multi-chunk prompts.
+        W = self.W
+        row = np.full((W,), -1, np.int32)
+        tail = (
+            req.prompt[-req.rep_context:] if req.rep_context else req.prompt[:0]
+        )
+        if tail.size:
+            row[W - tail.size:] = tail
+        self.recent = self.recent.at[req.slot].set(jnp.asarray(row))
+        self.keys = self.keys.at[req.slot].set(jax.random.PRNGKey(req.seed))
+
+        tok, logprobs, self.keys, self.recent = self._first_sample(
+            logits[0], self.keys, self.sp, self.recent, self.rep_sizes, slot_arr
+        )
+        self.last_tok = self.last_tok.at[req.slot, 0].set(tok)
+        self.active = self._set_active(self.active, slot_arr, True)
+        self._emit(req, int(tok), logprobs[None])
+
+    def _emit(self, req: _Request, token: int, logprobs):
+        req.produced += 1
+        # logprobs stays a LAZY (1, V) device array — same contract as the
+        # serial generate_step; the server materializes it only when the
+        # client asked for logprobs, so no per-token full-vocab transfer
+        req.out.put((token, logprobs))
+        if req.produced >= req.max_tokens:
+            self._finish(req)
+
+    def _finish(self, req: _Request):
+        if req.slot >= 0:
+            self.active = self._set_active(
+                self.active, jnp.asarray(req.slot, jnp.int32), False
+            )
+            self._slots[req.slot] = None
+            req.slot = -1
+        req.out.put(None)
+
+    def _reap_cancelled(self):
+        for req in list(self._slots):
+            if req is not None and req.cancelled:
+                self._finish(req)
+
+    def _decode_once(self):
+        eng = self.engine
+        decode = eng.decode_cb()
+        tok, logprobs, self.cache, self.recent, self.keys = decode(
+            eng.layer_params, eng.layer_masks, eng.shared_params,
+            self.last_tok, self.cache, self.active, self.recent, self.keys,
+            self.sp, self.rep_sizes,
+        )
+        self.last_tok = tok
+        tok_host = np.asarray(tok)
+        for slot, req in enumerate(self._slots):
+            if req is None or req.prefill_pos < req.prompt.size:
+                continue
+            self._emit(req, int(tok_host[slot, 0]), logprobs[slot : slot + 1])
+
+    def _tick(self):
+        """One scheduler iteration: reap, assign free slots, run one prefill
+        chunk per mid-admission request, one decode step for active slots."""
+        self._reap_cancelled()
+        while None in self._slots:
+            try:
+                req = self._submit.get_nowait()
+            except queue.Empty:
+                break
+            if req is None:
+                continue
+            if req.cancelled:
+                req.out.put(None)
+                continue
+            self._assign_slot(req, self._slots.index(None))
+        prefilling = [
+            r for r in self._slots
+            if r is not None and r.prefill_pos < r.prompt.size
+        ]
+        for req in prefilling:
+            self._prefill_one_chunk(req)
+        if bool(np.asarray(self.active).any()):
+            self._decode_once()
+        elif not any(self._slots):
+            # idle: block until the next request arrives
+            try:
+                req = self._submit.get(timeout=0.2)
+            except queue.Empty:
+                return
+            if req is None or req.cancelled:
+                return
+            self._assign_slot(req, self._slots.index(None))
+
+    def _fail_all(self, exc: BaseException):
+        for slot, req in enumerate(self._slots):
+            if req is not None:
+                req.slot = -1
+                self._slots[slot] = None
+                req.out.put(exc)
+        self.active = jnp.zeros_like(self.active)
+        while True:
+            try:
+                req = self._submit.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.out.put(exc)
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                self._tick()
+            except Exception as exc:  # noqa: BLE001 — a dead scheduler thread
+                # would hang every consumer; surface the error to them instead
+                self._fail_all(exc)
+        # graceful shutdown: end every in-flight and queued request's stream
+        for req in list(self._slots):
+            if req is not None:
+                self._finish(req)
+        while True:
+            try:
+                req = self._submit.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.out.put(None)

@@ -95,6 +95,7 @@ class ModelProvider:
         num_stages: Optional[int] = None,
         stage_bounds: Optional[list[tuple[int, int]]] = None,
         engine: str = "fused",
+        concurrent: int = 1,
         max_seq: int = 4096,
         prefill_chunk: int = 256,
         cache_dtype=None,
@@ -108,6 +109,7 @@ class ModelProvider:
         self.num_stages = num_stages
         self.stage_bounds = stage_bounds
         self.engine = engine
+        self.concurrent = max(1, concurrent)
         self.max_seq = max_seq
         self.prefill_chunk = prefill_chunk
         self.cache_dtype = cache_dtype
@@ -167,16 +169,21 @@ class ModelProvider:
                     len(self.stage_bounds) if self.stage_bounds
                     else (self.num_stages or 1)
                 )
-                if stages > 1:
+                if stages > 1 or self.concurrent > 1:
                     from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
                     from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
                     generator = PipelineEngine(
                         model, params, pipeline_mesh(stages),
                         stage_bounds=self.stage_bounds,
+                        microbatches=self.concurrent,
                         max_seq=self.max_seq, cache_dtype=cache_dtype,
                         prefill_chunk=self.prefill_chunk,
                     )
+                    if self.concurrent > 1:
+                        from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+                        generator = ContinuousBatcher(generator)
                 else:
                     generator = Generator(
                         model, params, max_seq=self.max_seq,
@@ -194,9 +201,12 @@ class ModelProvider:
         # (ref shard/openai_api.py --chat-template flag behavior)
         if getattr(self, "chat_template", None):
             tokenizer.chat_template = self.chat_template
+        old = getattr(self, "generator", None)
         self._key = key
         self.generator = generator
         self.tokenizer = tokenizer
+        if old is not None and hasattr(old, "close"):
+            old.close()  # stop a replaced batcher's scheduler thread
 
 
 class APIHandler(BaseHTTPRequestHandler):
@@ -412,7 +422,17 @@ class APIHandler(BaseHTTPRequestHandler):
             max_tokens=params["max_tokens"],
         )
 
-        with self.gen_lock:
+        # a concurrency-safe generator (ContinuousBatcher) interleaves
+        # requests itself; everything else is serialized by the lock, which
+        # is the reference's single-request behavior (shard/openai_api.py:543-563)
+        import contextlib
+
+        lock = (
+            contextlib.nullcontext()
+            if getattr(generator, "concurrent", False)
+            else self.gen_lock
+        )
+        with lock:
             if params["stream"]:
                 self._stream(
                     rid, obj + ".chunk", model_name, generator, tokenizer,
@@ -635,6 +655,10 @@ def main(argv=None):
                         help="pipeline engine for --stage-bounds: fused SPMD "
                         "(one program per token, default) or chained per-stage "
                         "programs")
+    parser.add_argument("--concurrent", type=int, default=1,
+                        help="continuous-batching slots: serve up to N "
+                        "requests interleaved in one fused engine (N>1 "
+                        "replaces the per-request generation lock)")
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
     parser.add_argument("--log-level", default="INFO")
@@ -652,6 +676,8 @@ def main(argv=None):
 
     if args.engine == "chained" and not args.stage_bounds:
         parser.error("--engine chained requires --stage-bounds")
+    if args.concurrent > 1 and args.engine == "chained":
+        parser.error("--concurrent requires the fused engine")
     logging.basicConfig(level=args.log_level.upper())
     if args.coordinator:
         import jax
@@ -672,7 +698,7 @@ def main(argv=None):
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
         num_stages=args.num_stages, stage_bounds=stage_bounds,
-        engine=args.engine,
+        engine=args.engine, concurrent=args.concurrent,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         chat_template=chat_template,
     )
