@@ -121,3 +121,39 @@ class BaseModel:
 
     def embed_tokens(self, params, tokens):
         return jnp.take(params["embed"]["weight"], tokens, axis=0)
+
+    # -- embed/head decomposition -----------------------------------------
+    # The fused engine vocab-shards the embedding table and LM head over the
+    # pp axis (each device holds vocab/S rows); these hooks isolate the
+    # arch-specific pieces around the sharded table lookup / vocab matmul so
+    # the engine can own the collectives. apply_head/embed compose them for
+    # the single-program and chained paths.
+
+    def embed_transform(self, h):
+        """Post-lookup transform (Gemma-2 scales by sqrt(hidden))."""
+        return h
+
+    def head_input(self, params, h):
+        """Transform before the vocab projection (the final norm)."""
+        raise NotImplementedError
+
+    def head_transform(self, logits):
+        """Elementwise transform after the vocab projection (Gemma-2
+        softcap). Must be shard-local: applied per vocab shard."""
+        return logits
+
+    def head_is_tied(self) -> bool:
+        """True when logits project through the embedding table transposed."""
+        return bool(getattr(self.config, "tie_word_embeddings", False))
+
+    def embed(self, params, tokens):
+        return self.embed_transform(self.embed_tokens(params, tokens))
+
+    def apply_head(self, params, h):
+        h = self.head_input(params, h)
+        w = (
+            params["embed"]["weight"].T
+            if self.head_is_tied()
+            else params["lm_head"]["weight"]
+        )
+        return self.head_transform(h @ w)

@@ -217,10 +217,8 @@ class DeepseekV2Model(BaseModel):
             vs.append(vm)
         return h, jnp.concatenate(ks, axis=0), jnp.concatenate(vs, axis=0)
 
-    def apply_head(self, params, h):
-        cfg = self.config
-        h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
-        return h @ params["lm_head"]["weight"]
+    def head_input(self, params, h):
+        return rms_norm(h, params["final_norm"]["weight"], self.config.rms_norm_eps)
 
     def __call__(self, params, x, cache: KVCache, n_valid=None):
         cfg = self.config
@@ -232,9 +230,6 @@ class DeepseekV2Model(BaseModel):
         if cfg.is_last_stage:
             return self.apply_head(params, h), cache
         return h, cache
-
-    def embed(self, params, tokens):
-        return self.embed_tokens(params, tokens)
 
     # ------------------------------------------------------------------
     def _attn_map(self) -> dict:

@@ -80,19 +80,23 @@ class Gemma2Model(BaseModel):
 
         return scan_layers(body, h, layer_params, k, v, mask)
 
-    def embed(self, params, tokens):
+    def embed_transform(self, h):
         # embedding scaled by sqrt(hidden) (ref gemma2.py:42-43)
-        h = self.embed_tokens(params, tokens)
         return h * jnp.asarray(self.config.hidden_size**0.5, h.dtype)
 
-    def apply_head(self, params, h):
-        cfg = self.config
-        h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps, offset=1.0)
-        logits = h @ params["embed"]["weight"].T  # always tied (ref :23-24)
-        cap = cfg.final_logit_softcapping
+    def head_input(self, params, h):
+        return rms_norm(
+            h, params["final_norm"]["weight"], self.config.rms_norm_eps, offset=1.0
+        )
+
+    def head_transform(self, logits):
+        cap = self.config.final_logit_softcapping
         if cap:  # ref gemma2.py:80-84
             logits = cap * jnp.tanh(logits / cap)
         return logits
+
+    def head_is_tied(self) -> bool:
+        return True  # always projects through the embedding (ref :23-24)
 
     def __call__(self, params, x, cache: KVCache, n_valid=None):
         cfg = self.config

@@ -71,17 +71,10 @@ class LlamaModel(BaseModel):
 
         return scan_layers(body, h, layer_params, k, v, mask)
 
-    def embed(self, params, tokens):
-        return self.embed_tokens(params, tokens)
-
-    def apply_head(self, params, h):
-        """Final norm + LM head (tied-embedding aware — ref llama.py:74-77,
-        84-89)."""
-        cfg = self.config
-        h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
-        if cfg.tie_word_embeddings:
-            return h @ params["embed"]["weight"].T
-        return h @ params["lm_head"]["weight"]
+    def head_input(self, params, h):
+        """Final norm before the (tied-embedding aware) LM head — ref
+        llama.py:74-77, 84-89."""
+        return rms_norm(h, params["final_norm"]["weight"], self.config.rms_norm_eps)
 
     def __call__(self, params, x, cache: KVCache, n_valid=None):
         """``n_valid`` (traced scalar) advances the cache by fewer positions

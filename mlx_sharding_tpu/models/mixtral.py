@@ -63,12 +63,8 @@ class MixtralModel(BaseModel):
 
         return scan_layers(body, h, layer_params, k, v, mask)
 
-    def apply_head(self, params, h):
-        cfg = self.config
-        h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
-        if cfg.tie_word_embeddings:
-            return h @ params["embed"]["weight"].T
-        return h @ params["lm_head"]["weight"]
+    def head_input(self, params, h):
+        return rms_norm(h, params["final_norm"]["weight"], self.config.rms_norm_eps)
 
     def __call__(self, params, x, cache: KVCache, n_valid=None):
         cfg = self.config
@@ -80,9 +76,6 @@ class MixtralModel(BaseModel):
         if cfg.is_last_stage:
             return self.apply_head(params, h), cache
         return h, cache
-
-    def embed(self, params, tokens):
-        return self.embed_tokens(params, tokens)
 
     # ------------------------------------------------------------------
     HF_LAYER_MAP = {

@@ -134,9 +134,10 @@ class PipelineEngine:
     """Runs a full (unsharded-config) model across a ``pp`` mesh axis.
 
     ``params`` is the full model's pytree (stacked layers over ALL layers);
-    layer stacks are split per stage and placed with a ``P('pp')`` sharding,
-    while embed / final-norm / head are replicated (vocab-sharding them over
-    pp is the follow-up optimization). The KV cache is one global array
+    layer stacks are split per stage and placed with a ``P('pp')`` sharding.
+    The embedding table and LM head are vocab-sharded over pp (each device
+    holds vocab/S rows; see the collectives in ``_vs_embed``/``_vs_head``);
+    only the final norm stays replicated. The KV cache is one global array
     sharded on its leading stage axis — stage-local in HBM, exactly the
     reference's "KV stays on the shard" invariant (shard/server/server.py:9-10)
     without the process.
@@ -182,10 +183,33 @@ class PipelineEngine:
         split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
         self.layer_params = jax.device_put(split, stage_sharding)
         self.layer_masks = jax.device_put(masks, stage_sharding)
-        self.shared_params = jax.device_put(
-            {k: v for k, v in params.items() if k != "layers"}, replicated
-        )
         self.layers_per_stage = slots
+
+        # Vocab-shard the embedding table and LM head over pp: each device
+        # holds vocab/S rows instead of a full replica (Llama-3 vocab in bf16
+        # is ~1 GB/device replicated). Embedding rows are re-assembled with a
+        # tiny (B,T,H) psum per tick; logits are computed per vocab shard
+        # post-scan and all-gathered — (S-1)/S x V bytes/device vs the full-V
+        # psum before, with head FLOPs divided by S.
+        self.vocab_size = cfg.vocab_size
+        self._head_tied = model.head_is_tied()
+        Vs = -(-cfg.vocab_size // S)
+        table = params["embed"]["weight"]
+        table = jnp.pad(table, ((0, Vs * S - table.shape[0]), (0, 0)))
+        vparts = [table.reshape(S, Vs, -1)]
+        if not self._head_tied:
+            head = params["lm_head"]["weight"]  # (H, V)
+            head = jnp.pad(head, ((0, 0), (0, Vs * S - head.shape[1])))
+            # (S, H, Vs) so each device's slice is its vocab shard
+            vparts.append(head.reshape(-1, S, Vs).transpose(1, 0, 2))
+        self.vocab_parts = jax.device_put(tuple(vparts), stage_sharding)
+        self.shared_params = jax.device_put(
+            {
+                k: v for k, v in params.items()
+                if k not in ("layers", "embed", "lm_head")
+            },
+            replicated,
+        )
 
         self._decode = self._build_step(t_len=1, with_sampling=True)
         self._prefill = self._build_step(t_len=prefill_chunk, with_sampling=False)
@@ -227,11 +251,37 @@ class PipelineEngine:
             ),
         )
 
+    # ----------------------------------------------------- vocab sharding
+    def _vs_embed(self, s, vparts, ids):
+        """Embedding lookup against this device's vocab shard + psum to
+        assemble full rows (only the owner contributes non-zeros)."""
+        table = vparts[0]  # (Vs, H)
+        Vs = table.shape[0]
+        lo = s * Vs
+        rows = jnp.take(table, jnp.clip(ids - lo, 0, Vs - 1), axis=0)
+        owned = (ids >= lo) & (ids < lo + Vs)
+        rows = jnp.where(owned[..., None], rows, jnp.zeros((), rows.dtype))
+        return self.model.embed_transform(jax.lax.psum(rows, AXIS_PP))
+
+    def _vs_head(self, shared, vparts, h):
+        """Final norm + per-shard vocab projection + all-gather. ``h`` must
+        already be replicated (post-psum of the banked hidden states)."""
+        model = self.model
+        hn = model.head_input(shared, h)
+        if self._head_tied:
+            w = vparts[0]  # (Vs, H) — the embedding shard, transposed in-op
+            logits = jnp.einsum("...h,vh->...v", hn, w)
+        else:
+            logits = hn @ vparts[1]  # (H, Vs)
+        logits = model.head_transform(logits)
+        full = jax.lax.all_gather(logits, AXIS_PP, axis=logits.ndim - 1, tiled=True)
+        return full[..., : self.vocab_size].astype(jnp.float32)
+
     # ------------------------------------------------------------------
     def _build_step(self, t_len: int, with_sampling: bool):
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
 
-        def body(layer_params, masks, shared, tokens, k, v, offsets, active, n_valid):
+        def body(layer_params, masks, vparts, shared, tokens, k, v, offsets, active, n_valid):
             # Per-device views: layer_params (1, L, …) → (L, …); k/v
             # (1, L, M+1, B, seq, H, D) → (L, M+1, …). ``offsets`` is (M,) —
             # each slot's sequence position — and ``active`` (M,) bool marks
@@ -240,10 +290,13 @@ class PipelineEngine:
             # scheduler ignores).
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
             masks = jax.tree.map(lambda x: x[0], masks)
+            vparts = jax.tree.map(lambda x: x[0], vparts)
             k, v = k[0], v[0]
             s = jax.lax.axis_index(AXIS_PP)
             h0 = jnp.zeros((B, t_len, model.config.hidden_size), k.dtype)
-            out0 = jnp.zeros((M, B, model.config.vocab_size), jnp.float32)
+            # bank HIDDEN states, not logits: the vocab projection runs once
+            # post-scan against this device's vocab shard
+            out0 = jnp.zeros((M, B, model.config.hidden_size), k.dtype)
             offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
 
             def tick(carry, t):
@@ -254,7 +307,7 @@ class PipelineEngine:
                 tok_m = jax.lax.dynamic_index_in_dim(
                     tokens, jnp.clip(t, 0, M - 1), 0, keepdims=False
                 )  # (B, T)
-                h_first = model.embed(shared, tok_m).astype(h_buf.dtype)
+                h_first = self._vs_embed(s, vparts, tok_m).astype(h_buf.dtype)
                 h_in = jnp.where(s == 0, h_first, h_buf)
 
                 # scratch slice M swallows non-real writes
@@ -268,13 +321,13 @@ class PipelineEngine:
                 k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
                 v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
 
-                # bank last-valid-position logits on the final stage
+                # bank the last-valid-position hidden state on the final stage
                 last = jax.lax.dynamic_index_in_dim(h_out, n_valid - 1, 1, keepdims=False)
-                logits = model.apply_head(shared, last).astype(jnp.float32)  # (B, V)
                 is_real_out = is_real & (s == S - 1)
                 m_out = jnp.clip(t - (S - 1), 0, M - 1)
                 out = jax.lax.dynamic_update_index_in_dim(
-                    out, jnp.where(is_real_out, logits, out[m_out]), m_out, 0
+                    out, jnp.where(is_real_out, last.astype(out.dtype), out[m_out]),
+                    m_out, 0,
                 )
 
                 h_next = jax.lax.ppermute(
@@ -286,7 +339,8 @@ class PipelineEngine:
                 tick, (h0, k, v, out0), jnp.arange(S + M - 1)
             )
             out = jax.lax.psum(out, AXIS_PP)  # only stage S-1 contributed
-            return out, k[None], v[None]
+            logits = self._vs_head(shared, vparts, out)  # (M, B, V) f32
+            return logits, k[None], v[None]
 
         spec_stage, spec_rep = P(AXIS_PP), P()
         smapped = jax.shard_map(
@@ -295,6 +349,7 @@ class PipelineEngine:
             in_specs=(
                 jax.tree.map(lambda _: spec_stage, self.layer_params),
                 jax.tree.map(lambda _: spec_stage, self.layer_masks),
+                jax.tree.map(lambda _: spec_stage, self.vocab_parts),
                 jax.tree.map(lambda _: spec_rep, self.shared_params),
                 spec_rep,  # tokens
                 spec_stage,  # k
@@ -313,9 +368,9 @@ class PipelineEngine:
 
         if with_sampling:
 
-            def step(layer_params, masks, shared, tokens, cache, recent, key, sp, n_valid):
+            def step(layer_params, masks, vparts, shared, tokens, cache, recent, key, sp, n_valid):
                 logits, k, v = smapped(
-                    layer_params, masks, shared, tokens, cache.k, cache.v,
+                    layer_params, masks, vparts, shared, tokens, cache.k, cache.v,
                     cache.offset, all_active, n_valid,
                 )
                 key, sub = jax.random.split(key)
@@ -325,17 +380,17 @@ class PipelineEngine:
                 new_cache = KVCache(k=k, v=v, offset=cache.offset + n_valid)
                 return tok.reshape(M, B), logprobs, new_cache, recent, key
 
-            return jax.jit(step, donate_argnums=(4, 5))
+            return jax.jit(step, donate_argnums=(5, 6))
 
-        def step(layer_params, masks, shared, tokens, cache, n_valid):
+        def step(layer_params, masks, vparts, shared, tokens, cache, n_valid):
             logits, k, v = smapped(
-                layer_params, masks, shared, tokens, cache.k, cache.v,
+                layer_params, masks, vparts, shared, tokens, cache.k, cache.v,
                 cache.offset, all_active, n_valid,
             )
             new_cache = KVCache(k=k, v=v, offset=cache.offset + n_valid)
             return logits, new_cache
 
-        return jax.jit(step, donate_argnums=(4,))
+        return jax.jit(step, donate_argnums=(5,))
 
     # ---------------------------------------------------- continuous batching
     def _build_decode_cb(self):
@@ -349,12 +404,12 @@ class PipelineEngine:
             raise ValueError("continuous batching expects batch=1 per slot")
 
         def step(
-            layer_params, masks, shared, tokens, cache, active, recent, keys,
-            sp, rep_sizes,
+            layer_params, masks, vparts, shared, tokens, cache, active, recent,
+            keys, sp, rep_sizes,
         ):
             one = jnp.asarray(1, jnp.int32)
             logits, k, v = smapped(
-                layer_params, masks, shared, tokens, cache.k, cache.v,
+                layer_params, masks, vparts, shared, tokens, cache.k, cache.v,
                 cache.offset, active, one,
             )
             split = jax.vmap(jax.random.split)(keys)  # (M, 2, 2)
@@ -373,7 +428,7 @@ class PipelineEngine:
             )
             return tok.reshape(M, B), logprobs, new_cache, recent, keys
 
-        return jax.jit(step, donate_argnums=(4, 6, 7))
+        return jax.jit(step, donate_argnums=(5, 7, 8))
 
     def _build_prefill_slot(self):
         """Prefill one chunk of ONE slot's request while other slots' state
@@ -384,19 +439,20 @@ class PipelineEngine:
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
         t_len = self.prefill_chunk
 
-        def body(layer_params, masks, shared, tokens, slot, k, v, offsets, n_valid):
+        def body(layer_params, masks, vparts, shared, tokens, slot, k, v, offsets, n_valid):
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
             masks = jax.tree.map(lambda x: x[0], masks)
+            vparts = jax.tree.map(lambda x: x[0], vparts)
             k, v = k[0], v[0]
             s = jax.lax.axis_index(AXIS_PP)
             h0 = jnp.zeros((B, t_len, model.config.hidden_size), k.dtype)
-            out0 = jnp.zeros((B, model.config.vocab_size), jnp.float32)
+            out0 = jnp.zeros((B, model.config.hidden_size), k.dtype)
             offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
 
             def tick(carry, t):
                 h_buf, k, v, out = carry
                 is_real = t == s
-                h_first = model.embed(shared, tokens).astype(h_buf.dtype)
+                h_first = self._vs_embed(s, vparts, tokens).astype(h_buf.dtype)
                 h_in = jnp.where(s == 0, h_first, h_buf)
                 m_write = jnp.where(is_real, slot, M)
                 offset = offsets_pad[m_write]
@@ -409,8 +465,9 @@ class PipelineEngine:
                 v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
 
                 last = jax.lax.dynamic_index_in_dim(h_out, n_valid - 1, 1, keepdims=False)
-                logits = model.apply_head(shared, last).astype(jnp.float32)
-                out = jnp.where(is_real & (s == S - 1), logits, out)
+                out = jnp.where(
+                    is_real & (s == S - 1), last.astype(out.dtype), out
+                )
 
                 h_next = jax.lax.ppermute(
                     h_out, AXIS_PP, [(i, (i + 1) % S) for i in range(S)]
@@ -419,7 +476,8 @@ class PipelineEngine:
 
             (_, k, v, out), _ = jax.lax.scan(tick, (h0, k, v, out0), jnp.arange(S))
             out = jax.lax.psum(out, AXIS_PP)
-            return out, k[None], v[None]
+            logits = self._vs_head(shared, vparts, out)  # (B, V) f32
+            return logits, k[None], v[None]
 
         spec_stage, spec_rep = P(AXIS_PP), P()
         smapped = jax.shard_map(
@@ -428,6 +486,7 @@ class PipelineEngine:
             in_specs=(
                 jax.tree.map(lambda _: spec_stage, self.layer_params),
                 jax.tree.map(lambda _: spec_stage, self.layer_masks),
+                jax.tree.map(lambda _: spec_stage, self.vocab_parts),
                 jax.tree.map(lambda _: spec_rep, self.shared_params),
                 spec_rep,  # tokens (B, T)
                 spec_rep,  # slot
@@ -440,15 +499,15 @@ class PipelineEngine:
             check_vma=False,
         )
 
-        def step(layer_params, masks, shared, tokens, slot, cache, n_valid):
+        def step(layer_params, masks, vparts, shared, tokens, slot, cache, n_valid):
             logits, k, v = smapped(
-                layer_params, masks, shared, tokens, slot, cache.k, cache.v,
+                layer_params, masks, vparts, shared, tokens, slot, cache.k, cache.v,
                 cache.offset, n_valid,
             )
             offsets = cache.offset.at[slot].add(n_valid)
             return logits, KVCache(k=k, v=v, offset=offsets)
 
-        return jax.jit(step, donate_argnums=(5,))
+        return jax.jit(step, donate_argnums=(6,))
 
     @staticmethod
     def _sample_fn(logits, recent, key, sp):
@@ -503,8 +562,9 @@ class PipelineEngine:
             if n_valid < c:
                 chunk = np.pad(chunk, ((0, 0), (0, 0), (0, c - n_valid)))
             logits, cache = self._prefill(
-                self.layer_params, self.layer_masks, self.shared_params,
-                jnp.asarray(chunk), cache, jnp.asarray(n_valid, jnp.int32),
+                self.layer_params, self.layer_masks, self.vocab_parts,
+                self.shared_params, jnp.asarray(chunk), cache,
+                jnp.asarray(n_valid, jnp.int32),
             )
         tok, logprobs, recent, key = self._sample(logits, recent, key, sp)
 
@@ -512,8 +572,8 @@ class PipelineEngine:
         one = jnp.asarray(1, jnp.int32)
         while True:
             next_tok, next_logprobs, cache, recent, key = self._decode(
-                self.layer_params, self.layer_masks, self.shared_params,
-                tok[..., None], cache, recent, key, sp, one,
+                self.layer_params, self.layer_masks, self.vocab_parts,
+                self.shared_params, tok[..., None], cache, recent, key, sp, one,
             )
             yield int(tok[0, 0]), logprobs
             n += 1

@@ -218,8 +218,8 @@ class ContinuousBatcher:
         if n_valid < c:
             chunk = np.pad(chunk, (0, c - n_valid))
         logits, self.cache = eng.prefill_slot()(
-            eng.layer_params, eng.layer_masks, eng.shared_params,
-            jnp.asarray(chunk[None]), slot_arr, self.cache,
+            eng.layer_params, eng.layer_masks, eng.vocab_parts,
+            eng.shared_params, jnp.asarray(chunk[None]), slot_arr, self.cache,
             jnp.asarray(n_valid, jnp.int32),
         )
         req.prefill_pos += n_valid
@@ -275,9 +275,9 @@ class ContinuousBatcher:
         eng = self.engine
         decode = eng.decode_cb()
         tok, logprobs, self.cache, self.recent, self.keys = decode(
-            eng.layer_params, eng.layer_masks, eng.shared_params,
-            self.last_tok, self.cache, self.active, self.recent, self.keys,
-            self.sp, self.rep_sizes,
+            eng.layer_params, eng.layer_masks, eng.vocab_parts,
+            eng.shared_params, self.last_tok, self.cache, self.active,
+            self.recent, self.keys, self.sp, self.rep_sizes,
         )
         self.last_tok = tok
         tok_host = np.asarray(tok)
