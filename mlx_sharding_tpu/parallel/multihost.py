@@ -1,0 +1,265 @@
+"""Multi-host serving: rank-0 driver + worker protocol over jax.distributed.
+
+This is the reference's per-machine deployment reborn (one shard process per
+machine: /root/reference/shard/main.py:4-14, driven over gRPC from the
+primary at /root/reference/generate.py:17, shard/utils.py:162-164) on the
+TPU-native substrate. Differences, by design:
+
+- The reference ships ACTIVATIONS over the wire every token (serialize →
+  TCP → deserialize per stage, SURVEY §3.5). Here the model math runs as
+  multi-controller SPMD over one global mesh: every process executes the
+  SAME jitted step, and activations cross host boundaries inside XLA
+  collectives (ICI/DCN), never through Python.
+- The only thing rank 0 broadcasts is CONTROL: request admission (prompt
+  tokens + sampler params) and per-token step ops. Sampling is
+  replicated-deterministic — same PRNG key chain on every process — so
+  sampled tokens never need to be sent anywhere; every process computes
+  them identically.
+- Rank 0 is the reference's "primary": it owns the tokenizer, the HTTP
+  server and the decode loop. Ranks > 0 run :func:`serve_worker`, the
+  equivalent of `mlx-sharding-server` (shard/main.py): load the same
+  checkpoint, build the same engine, mirror the step sequence.
+
+Wire format: fixed-shape int32/float32 buffers through
+``multihost_utils.broadcast_one_to_all`` (a tiny psum over the global mesh),
+so the control plane itself is just another XLA collective — no sockets, no
+serde code, no message framing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_sharding_tpu.sample import (
+    init_recent_tokens,
+    make_sampler_params,
+)
+
+# control ops
+OP_IDLE = 0
+OP_REQUEST = 1
+OP_DECODE = 2
+OP_STOP_REQUEST = 3
+OP_SHUTDOWN = 4
+
+_BIAS_SLOTS = 64
+
+
+class _Shutdown(Exception):
+    pass
+
+
+class ControlPlane:
+    """Fixed-shape broadcast buffers; rank 0 publishes, all ranks receive the
+    same pytree (broadcast_one_to_all ignores non-zero ranks' inputs)."""
+
+    def __init__(self, max_prompt: int):
+        self.max_prompt = max_prompt
+
+    def _zeros(self):
+        return {
+            "header": np.zeros((8,), np.int32),
+            "floats": np.zeros((4,), np.float32),
+            "tokens": np.zeros((self.max_prompt,), np.int32),
+            "bias_idx": np.zeros((_BIAS_SLOTS,), np.int32),
+            "bias_val": np.zeros((_BIAS_SLOTS,), np.float32),
+        }
+
+    def exchange(self, msg: Optional[dict] = None) -> dict:
+        """Collective: rank 0 passes ``msg`` (padded in), workers pass None.
+        Everyone gets rank 0's message back as host numpy."""
+        from jax.experimental import multihost_utils
+
+        buf = self._zeros()
+        if msg is not None:
+            for k, v in msg.items():
+                arr = np.asarray(v).reshape(-1)
+                buf[k][: arr.size] = arr
+        out = multihost_utils.broadcast_one_to_all(buf)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _request_msg(prompt, temperature, top_p, repetition_penalty,
+                 repetition_context_size, logit_bias, seed, max_tokens):
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    bias_idx = np.zeros((_BIAS_SLOTS,), np.int32)
+    bias_val = np.zeros((_BIAS_SLOTS,), np.float32)
+    n_bias = 0
+    if logit_bias:
+        if len(logit_bias) > _BIAS_SLOTS:
+            # silent truncation would make multi-host output diverge from the
+            # same request served single-host
+            raise ValueError(
+                f"logit_bias with {len(logit_bias)} entries exceeds the "
+                f"multi-host control-plane width {_BIAS_SLOTS}"
+            )
+        items = list(logit_bias.items())
+        n_bias = len(items)
+        bias_idx[:n_bias] = [int(k) for k, _ in items]
+        bias_val[:n_bias] = [float(v) for _, v in items]
+    return {
+        "header": np.asarray(
+            [OP_REQUEST, prompt.size, max_tokens, seed,
+             repetition_context_size,
+             0 if repetition_penalty is None else 1, n_bias, 0],
+            np.int32,
+        ),
+        "floats": np.asarray(
+            [temperature, top_p, repetition_penalty or 1.0, 0.0], np.float32
+        ),
+        "tokens": prompt,
+        "bias_idx": bias_idx,
+        "bias_val": bias_val,
+    }
+
+
+def _start_request(engine, msg):
+    """Identical on every rank: prefill the broadcast prompt and sample the
+    first token. Returns the rolling decode state."""
+    hdr = msg["header"]
+    n_prompt = int(hdr[1])
+    seed = int(hdr[3])
+    rep_ctx = int(hdr[4])
+    n_bias = int(hdr[6])
+    temperature, top_p, rep_pen = (float(x) for x in msg["floats"][:3])
+    bias = {
+        int(i): float(v)
+        for i, v in zip(msg["bias_idx"][:n_bias], msg["bias_val"][:n_bias])
+    } or None
+    sp = make_sampler_params(
+        temperature, top_p, rep_pen if hdr[5] else None, bias
+    )
+    prompt = msg["tokens"][:n_prompt]
+
+    M, B = engine.microbatches, engine.batch
+    arr = np.broadcast_to(prompt.reshape(1, 1, -1), (M, B, n_prompt))
+    cache = engine.init_cache()
+
+    # every host-built input must be explicitly committed as a REPLICATED
+    # global array: under multi-controller JAX, mixing plain host arrays
+    # with global-mesh arrays in one jit is not well-defined
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(engine.mesh, P())
+    put = lambda x: jax.device_put(x, rep)  # noqa: E731
+    recent = put(init_recent_tokens(M * B, rep_ctx, arr.reshape(M * B, -1)))
+    key = put(jax.random.PRNGKey(seed))
+    sp = jax.tree.map(put, sp)
+
+    c = engine.prefill_chunk
+    logits = None
+    for start in range(0, n_prompt, c):
+        chunk = arr[..., start : start + c]
+        n_valid = chunk.shape[-1]
+        if n_valid < c:
+            chunk = np.pad(chunk, ((0, 0), (0, 0), (0, c - n_valid)))
+        logits, cache = engine._prefill(
+            engine.layer_params, engine.layer_masks, engine.vocab_parts,
+            engine.shared_params, put(jnp.asarray(chunk)), cache,
+            put(jnp.asarray(n_valid, jnp.int32)),
+        )
+    tok, logprobs, recent, key = engine._sample(logits, recent, key, sp)
+    return dict(cache=cache, recent=recent, key=key, sp=sp, tok=tok,
+                logprobs=logprobs, _put=put)
+
+
+def _decode_step(engine, state):
+    one = state["_put"](jnp.asarray(1, jnp.int32))
+    tok, logprobs, cache, recent, key = engine._decode(
+        engine.layer_params, engine.layer_masks, engine.vocab_parts,
+        engine.shared_params, state["tok"][..., None], state["cache"],
+        state["recent"], state["key"], state["sp"], one,
+    )
+    state.update(cache=cache, recent=recent, key=key, tok=tok, logprobs=logprobs)
+    return state
+
+
+class MultiHostPipeline:
+    """Rank-0 driver with the ``generate_step`` contract. Each yielded token
+    was computed redundantly by every process; the broadcasts only carry
+    \"take another step\" (one tiny collective per token — the reference pays
+    a full activation serialize/RPC per STAGE per token here)."""
+
+    concurrent = False  # requests serialize through the server's gen lock
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.ctrl = ControlPlane(max_prompt=engine.max_seq)
+
+    def generate_step(
+        self,
+        prompt_tokens,
+        *,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        repetition_penalty: Optional[float] = None,
+        repetition_context_size: int = 20,
+        logit_bias: Optional[dict[int, float]] = None,
+        seed: Optional[int] = None,
+        max_tokens: int = 256,
+    ):
+        import time as _time
+
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if prompt.size + max_tokens > self.engine.max_seq:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_tokens ({max_tokens}) exceeds "
+                f"KV capacity {self.engine.max_seq}"
+            )
+        msg = _request_msg(
+            prompt, temperature, top_p, repetition_penalty,
+            repetition_context_size, logit_bias,
+            # int32 control-plane field: mask user seeds into 31 bits
+            (int(_time.time_ns()) if seed is None else int(seed)) & 0x7FFFFFFF,
+            max_tokens,
+        )
+        self.ctrl.exchange(msg)
+        state = _start_request(self.engine, msg)
+        try:
+            n = 0
+            while True:
+                yield int(np.asarray(state["tok"]).reshape(-1)[0]), state["logprobs"]
+                n += 1
+                if n >= max_tokens:
+                    break
+                self.ctrl.exchange({"header": np.asarray([OP_DECODE], np.int32)})
+                state = _decode_step(self.engine, state)
+        finally:
+            # exactly one STOP per request, whether it ran to max_tokens or
+            # the consumer closed early (stop sequence / disconnect)
+            self.ctrl.exchange(
+                {"header": np.asarray([OP_STOP_REQUEST], np.int32)}
+            )
+
+    def shutdown(self):
+        self.ctrl.exchange({"header": np.asarray([OP_SHUTDOWN], np.int32)})
+
+    close = shutdown
+
+
+def serve_worker(engine) -> None:
+    """Rank>0 main loop — the reference's shard-server process
+    (shard/server/server.py:74-93) with the RPC surface replaced by the
+    broadcast control plane. Blocks until rank 0 publishes OP_SHUTDOWN."""
+    ctrl = ControlPlane(max_prompt=engine.max_seq)
+    while True:
+        msg = ctrl.exchange()
+        op = int(msg["header"][0])
+        if op == OP_SHUTDOWN:
+            return
+        if op != OP_REQUEST:
+            continue
+        state = _start_request(engine, msg)
+        while True:
+            step = ctrl.exchange()
+            op = int(step["header"][0])
+            if op == OP_DECODE:
+                state = _decode_step(engine, state)
+            elif op == OP_STOP_REQUEST:
+                break
+            elif op == OP_SHUTDOWN:
+                return

@@ -96,6 +96,7 @@ class ModelProvider:
         stage_bounds: Optional[list[tuple[int, int]]] = None,
         engine: str = "fused",
         concurrent: int = 1,
+        multihost: bool = False,
         max_seq: int = 4096,
         prefill_chunk: int = 256,
         cache_dtype=None,
@@ -110,6 +111,7 @@ class ModelProvider:
         self.stage_bounds = stage_bounds
         self.engine = engine
         self.concurrent = max(1, concurrent)
+        self.multihost = multihost
         self.max_seq = max_seq
         self.prefill_chunk = prefill_chunk
         self.cache_dtype = cache_dtype
@@ -146,6 +148,11 @@ class ModelProvider:
         with self._load_lock:
             if self._key == target:
                 return self.generator, self.tokenizer
+            if self.multihost and self._key is not None:
+                # workers mirror only the step sequence, not model swaps
+                raise ValueError(
+                    "model hot-swap is not supported in multi-host serving"
+                )
             logger.info("loading model %s", target)
             import jax.numpy as jnp
 
@@ -184,6 +191,16 @@ class ModelProvider:
                         from mlx_sharding_tpu.scheduler import ContinuousBatcher
 
                         generator = ContinuousBatcher(generator)
+                    elif self.multihost:
+                        import jax
+
+                        if jax.process_index() == 0:
+                            from mlx_sharding_tpu.parallel.multihost import (
+                                MultiHostPipeline,
+                            )
+
+                            generator = MultiHostPipeline(generator)
+                        # ranks > 0 keep the raw engine: serve_worker drives it
                 else:
                     generator = Generator(
                         model, params, max_seq=self.max_seq,
@@ -678,6 +695,16 @@ def main(argv=None):
         parser.error("--engine chained requires --stage-bounds")
     if args.concurrent > 1 and args.engine == "chained":
         parser.error("--concurrent requires the fused engine")
+    if args.coordinator and (args.num_processes or 1) > 1:
+        if args.concurrent > 1:
+            parser.error("--concurrent is not yet supported with multi-host "
+                         "serving (workers mirror the single-stream protocol)")
+        if not args.model:
+            parser.error("multi-host serving requires --model (workers load "
+                         "the model at startup)")
+        if not args.stage_bounds and (args.num_stages or 1) <= 1:
+            parser.error("multi-host serving requires a pipeline "
+                         "(--num-stages > 1 or --stage-bounds)")
     logging.basicConfig(level=args.log_level.upper())
     if args.coordinator:
         import jax
@@ -695,13 +722,26 @@ def main(argv=None):
     chat_template = args.chat_template
     if chat_template and chat_template.startswith("@"):
         chat_template = Path(chat_template[1:]).read_text()
+    multihost = bool(args.coordinator) and (args.num_processes or 1) > 1
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
         num_stages=args.num_stages, stage_bounds=stage_bounds,
-        engine=args.engine, concurrent=args.concurrent,
+        engine=args.engine, concurrent=args.concurrent, multihost=multihost,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         chat_template=chat_template,
     )
+    if multihost:
+        import jax
+
+        if jax.process_index() > 0:
+            # worker rank: no HTTP — mirror rank 0's step sequence until
+            # shutdown (the reference's per-machine shard server,
+            # /root/reference/shard/main.py:4-14, without the RPC surface)
+            from mlx_sharding_tpu.parallel.multihost import serve_worker
+
+            logger.info("worker rank %d serving", jax.process_index())
+            serve_worker(provider.generator)
+            return
     server = make_server(provider, args.host, args.port, profile_dir=args.profile_dir)
     logger.info("serving on http://%s:%d", args.host, args.port)
     server.serve_forever()
