@@ -38,10 +38,16 @@ def main(argv=None):
                         help="pipeline engine for --stage-bounds: 'fused' runs all "
                         "stages as one SPMD program per token (default); 'chained' "
                         "uses per-stage programs with D2D hand-off")
+    parser.add_argument("--sp", type=int, default=None,
+                        help="sequence-parallel prefill over N devices (ring "
+                        "attention); prompts longer than one prefill chunk "
+                        "shard their sequence dim")
     parser.add_argument("--no-chat-template", action="store_true")
     args = parser.parse_args(argv)
     if args.engine == "chained" and not args.stage_bounds:
         parser.error("--engine chained requires --stage-bounds")
+    if args.sp and (args.stage_bounds or args.num_stages):
+        parser.error("--sp applies to the single-stage generator only")
 
     import jax.numpy as jnp
 
@@ -78,8 +84,14 @@ def main(argv=None):
         )
     else:
         model, params = load_model(args.model, args.start_layer, args.end_layer)
+        sp_mesh = None
+        if args.sp and args.sp > 1:
+            from mlx_sharding_tpu.parallel.mesh import make_mesh
+
+            sp_mesh = make_mesh(sp=args.sp)
         generator = Generator(
-            model, params, max_seq=args.max_seq, prefill_chunk=args.prefill_chunk
+            model, params, max_seq=args.max_seq,
+            prefill_chunk=args.prefill_chunk, sp_mesh=sp_mesh,
         )
 
     from transformers import AutoTokenizer

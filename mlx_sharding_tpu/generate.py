@@ -75,9 +75,28 @@ class Generator:
         batch: int = 1,
         cache_dtype=jnp.bfloat16,
         prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
+        sp_mesh=None,
     ):
         self.model = model
         self.params = params
+        # optional sequence-parallel prefill: prompts longer than one chunk
+        # are sharded over the mesh's sp axis (ring attention) instead of
+        # looping chunks on one device — see parallel/sp_prefill.py
+        self.sp_mesh = sp_mesh
+        self._sp_prefill = None
+        if sp_mesh is not None:
+            from mlx_sharding_tpu.parallel.sp_prefill import (
+                SpPrefill,
+                supports_sp_prefill,
+            )
+
+            if not supports_sp_prefill(model):
+                raise ValueError(
+                    f"{type(model).__name__} does not support sequence-"
+                    "parallel prefill (needs layer_attn_inputs/layer_finish "
+                    "on a full first+last stage)"
+                )
+            self._sp_prefill = SpPrefill(model, params, sp_mesh, prefill_chunk)
         # Round capacity up to a chunk multiple: every (possibly padded)
         # prefill chunk then writes entirely inside the buffer, so padded
         # writes can never clamp-and-corrupt valid entries.
@@ -141,14 +160,18 @@ class Generator:
         # was verified above with host arithmetic — no per-chunk device sync.
         c = self.prefill_chunk
         last_logits = None
-        for start in range(0, n_prompt, c):
-            chunk = prompt[:, start : start + c]
-            n_valid = chunk.shape[1]
-            if n_valid < c:
-                chunk = np.pad(chunk, ((0, 0), (0, c - n_valid)))
-            last_logits, cache = self._prefill(
-                self.params, jnp.asarray(chunk), cache, jnp.asarray(n_valid, jnp.int32)
-            )
+        if self._sp_prefill is not None and n_prompt > c:
+            last_logits, cache = self._sp_prefill(prompt, cache)
+        else:
+            for start in range(0, n_prompt, c):
+                chunk = prompt[:, start : start + c]
+                n_valid = chunk.shape[1]
+                if n_valid < c:
+                    chunk = np.pad(chunk, ((0, 0), (0, c - n_valid)))
+                last_logits, cache = self._prefill(
+                    self.params, jnp.asarray(chunk), cache,
+                    jnp.asarray(n_valid, jnp.int32),
+                )
 
         tok, logprobs, recent, key = self._sample(last_logits, recent, key, sp)
 

@@ -31,7 +31,11 @@ class LlamaModel(BaseModel):
         self.scale = config.head_dim ** -0.5
 
     # ------------------------------------------------------------------
-    def _layer(self, h, p, k_buf, v_buf, offset):
+    def layer_attn_inputs(self, p, h, offset):
+        """Pre-attention half of a decoder layer: norm + QKV + RoPE at
+        absolute positions ``offset..offset+T``. Split out so the sequence-
+        parallel prefill path (parallel/sp_prefill.py) can swap the attention
+        op (ring over ``sp``) while reusing the exact projection math."""
         cfg = self.config
         b, t, _ = h.shape
         hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -49,13 +53,22 @@ class LlamaModel(BaseModel):
         v = v.reshape(b, t, hkv, d)
         q = apply_rope(q, self.inv_freq, offset)
         k = apply_rope(k, self.inv_freq, offset)
-        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
-        attn = causal_attention(q, k_buf, v_buf, offset, self.scale)
-        h = h + attn.reshape(b, t, -1) @ p["o_proj"]
+        return q, k, v
 
+    def layer_finish(self, p, h, attn):
+        """Post-attention half: output projection + SwiGLU MLP."""
+        cfg = self.config
+        b, t, _ = h.shape
+        h = h + attn.reshape(b, t, -1) @ p["o_proj"]
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
         ff = (jax.nn.silu(r @ p["gate_proj"]) * (r @ p["up_proj"])) @ p["down_proj"]
-        return h + ff, k_buf, v_buf
+        return h + ff
+
+    def _layer(self, h, p, k_buf, v_buf, offset):
+        q, k, v = self.layer_attn_inputs(p, h, offset)
+        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
+        attn = causal_attention(q, k_buf, v_buf, offset, self.scale)
+        return self.layer_finish(p, h, attn), k_buf, v_buf
 
     def run_layers(self, layer_params, h, k, v, offset, mask=None):
         """The stage body: scan the (local) stacked layers, threading the

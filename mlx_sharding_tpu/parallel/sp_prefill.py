@@ -1,0 +1,155 @@
+"""Sequence-parallel prefill: long prompts sharded over the ``sp`` axis.
+
+The reference's long-context story is "none" — the whole prompt goes through
+every stage in one call with a dense T×T mask (SURVEY §5). The framework's
+chunked prefill already bounds memory; this module adds the scaling axis the
+reference never had: the prompt's SEQUENCE dim is sharded over ``sp``
+devices, each device projects Q/K/V for its local T/S tokens (RoPE at global
+positions), attention runs as ring attention (K/V blocks rotate over ICI
+with a streaming-softmax accumulator — exact, no T×T anything), and the MLP
+halves stay local. One program prefills the entire prompt with per-device
+activation memory O(T/S).
+
+The resulting per-layer K/V (already rotated) is all-gathered into the
+standard decode cache, so generation continues on the ordinary single-
+device/pipeline decode path. Contract: bit-compatible logits with the
+dense prefill (tested sp=4 vs sp=1 in tests/test_sp_prefill.py).
+
+Currently wired for the Llama family (layer_attn_inputs/layer_finish
+hooks); other architectures keep the chunked path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mlx_sharding_tpu.cache import KVCache
+from mlx_sharding_tpu.parallel.mesh import AXIS_SP
+from mlx_sharding_tpu.parallel.ring_attention import ring_attention_local
+
+
+def supports_sp_prefill(model) -> bool:
+    cfg = model.config
+    return (
+        hasattr(model, "layer_attn_inputs")
+        and hasattr(model, "layer_finish")
+        and cfg.is_first_stage
+        and cfg.is_last_stage  # needs embed + head in-params
+    )
+
+
+def build_sp_prefill(model, mesh: Mesh):
+    """Returns ``fn(params, tokens (B, T_padded), n_valid) -> (logits (B,V),
+    ks, vs)`` where ks/vs are (L, B, T_padded, Hkv, D) full gathered K/V.
+    T_padded must divide by the sp size; positions >= n_valid are padding
+    (their K/V land in cache rows the decode loop overwrites/never attends).
+    """
+
+    def body(params, tokens, n_valid):
+        idx = jax.lax.axis_index(AXIS_SP)
+        t_local = tokens.shape[1]
+        offset = idx * t_local  # global position of this device's first token
+
+        h = model.embed(params, tokens)
+
+        def layer_body(h, p):
+            q, k, v = model.layer_attn_inputs(p, h, offset)
+            attn = ring_attention_local(q, k, v, model.scale)
+            return model.layer_finish(p, h, attn), (k, v)
+
+        h, (ks, vs) = jax.lax.scan(layer_body, h, params["layers"])
+
+        # last REAL position lives on device (n_valid-1) // t_local
+        local_last = jnp.clip(n_valid - 1 - offset, 0, t_local - 1)
+        last = jax.lax.dynamic_index_in_dim(h, local_last, 1, keepdims=False)
+        logits = model.apply_head(params, last).astype(jnp.float32)
+        owner = (n_valid - 1) // t_local == idx
+        logits = jax.lax.psum(jnp.where(owner, logits, 0.0), AXIS_SP)
+
+        # (L, B, T_local, H, D) -> full (L, B, T, H, D) for the decode cache
+        ks = jax.lax.all_gather(ks, AXIS_SP, axis=2, tiled=True)
+        vs = jax.lax.all_gather(vs, AXIS_SP, axis=2, tiled=True)
+        return logits, ks, vs
+
+    seq_spec = P(None, AXIS_SP)
+    rep = P()
+
+    def make(params_tree):
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(jax.tree.map(lambda _: rep, params_tree), seq_spec, rep),
+                out_specs=(rep, rep, rep),
+                check_vma=False,
+            )
+        )
+
+    return make
+
+
+class SpPrefill:
+    """Compiled, reusable sequence-parallel prefill for one (model, mesh).
+
+    Built once per Generator (mirrors how ``_prefill`` is jitted once).
+    Prompt lengths are bucketed to multiples of ``sp_size * prefill_chunk``
+    so the number of distinct compiled shapes stays bounded. Params are
+    replicated over the sp mesh ONCE at construction — every sp device needs
+    the full weights anyway; the cost is one extra replica on the default
+    device next to the generator's own copy.
+    """
+
+    def __init__(self, model, params, mesh: Mesh, prefill_chunk: int):
+        self.model = model
+        self.mesh = mesh
+        self.size = mesh.shape[AXIS_SP]
+        self.quantum = self.size * prefill_chunk
+        self._make = build_sp_prefill(model, mesh)
+        self._fn = None  # shape-polymorphic jit; compiles per T_pad bucket
+        self._rep = NamedSharding(mesh, P())
+        self._seq = NamedSharding(mesh, P(None, AXIS_SP))
+        self.params = jax.device_put(params, self._rep)
+
+        def write(cache, ks, vs, n_valid):
+            zero = jnp.zeros((), jnp.int32)
+            k = jax.lax.dynamic_update_slice(
+                cache.k, ks.astype(cache.k.dtype), (zero,) * cache.k.ndim
+            )
+            v = jax.lax.dynamic_update_slice(
+                cache.v, vs.astype(cache.v.dtype), (zero,) * cache.v.ndim
+            )
+            return KVCache(k=k, v=v, offset=n_valid)
+
+        self._write = jax.jit(write, donate_argnums=(0,))
+
+    def __call__(self, prompt: np.ndarray, cache: KVCache):
+        """Prefill ``prompt`` (B, T) into ``cache``; returns (logits, cache).
+        Padded K/V rows sit beyond ``offset`` and are never attended (causal
+        masking by offset) before being overwritten by decode."""
+        t = prompt.shape[1]
+        t_pad = -(-t // self.quantum) * self.quantum
+        if t_pad > cache.max_seq:
+            raise ValueError(
+                f"sp prefill needs {t_pad} cache rows, capacity {cache.max_seq}"
+            )
+        tokens = np.pad(prompt, ((0, 0), (0, t_pad - t)))
+        if self._fn is None:
+            self._fn = self._make(self.params)
+        logits, ks, vs = self._fn(
+            self.params,
+            jax.device_put(jnp.asarray(tokens), self._seq),
+            jax.device_put(jnp.asarray(t, jnp.int32), self._rep),
+        )
+        # the gathered K/V is replicated over sp; hand the default device's
+        # copy to the single-device decode cache without a host round-trip
+        dev = jax.devices()[0]
+        cache = self._write(
+            cache,
+            jax.device_put(ks, dev),
+            jax.device_put(vs, dev),
+            jax.device_put(jnp.asarray(t, jnp.int32), dev),
+        )
+        return jax.device_put(logits, dev), cache
