@@ -160,7 +160,14 @@ class Generator:
         # was verified above with host arithmetic — no per-chunk device sync.
         c = self.prefill_chunk
         last_logits = None
-        if self._sp_prefill is not None and n_prompt > c:
+        use_sp = (
+            self._sp_prefill is not None
+            and n_prompt > c
+            # quantum padding may need more cache rows than the prompt itself;
+            # fall back to the chunked path rather than fail a fitting request
+            and self._sp_prefill.padded_len(n_prompt) <= cache.max_seq
+        )
+        if use_sp:
             last_logits, cache = self._sp_prefill(prompt, cache)
         else:
             for start in range(0, n_prompt, c):

@@ -24,22 +24,29 @@ import jax.numpy as jnp
 
 
 def _flash_eligible(q, k, v, logit_softcap, sliding_window, sinks) -> bool:
-    """Use the Pallas kernel for MXU-aligned prefill on TPU: standard causal
-    GQA only (no softcap/window/sinks), T and S multiples of 128, head dims
-    lane-aligned. Opt out with MST_FLASH=0."""
+    """Use the Pallas kernel on TPU for standard causal GQA (no softcap/
+    window/sinks): prefill chunks with T a multiple of 128, and — opt-in via
+    MST_FLASH_DECODE=1 until measured on hardware — T=1 decode steps.
+
+    Head dims need only 64-alignment (Mosaic pads sub-128 lane tails): this
+    admits DeepSeek MLA's dk=192 full-mode and dk=rank+rope / dv=rank
+    compressed-mode shapes, not just the 128-multiples of round 1. Opt out
+    entirely with MST_FLASH=0."""
     if os.environ.get("MST_FLASH", "1") == "0":
         return False
     if logit_softcap is not None or sliding_window is not None or sinks is not None:
         return False
     b, t, hq, dk = q.shape
     s, dv = k.shape[1], v.shape[-1]
+    t_ok = (t >= 128 and t % 128 == 0) or (
+        t == 1 and os.environ.get("MST_FLASH_DECODE", "0") == "1"
+    )
     return (
         jax.default_backend() == "tpu"
-        and t >= 128
-        and t % 128 == 0
+        and t_ok
         and s % 128 == 0
-        and dk % 128 == 0
-        and dv % 128 == 0
+        and dk % 64 == 0
+        and dv % 64 == 0
     )
 
 

@@ -6,8 +6,9 @@ T×T mask and pushes the whole prompt through every stage in one call
 device keeps its Q block resident and the K/V blocks rotate around the ring
 via ``lax.ppermute`` (one ICI hop per step) while a streaming flash-style
 softmax (running max / normalizer / output, all fp32) accumulates the exact
-attention result — memory per device is O(T/S), communication overlaps with
-the block matmuls, and no T×T anything ever exists.
+attention result. Received blocks are processed in ``block_k`` sub-tiles, so
+the live score tensor is O(T/S x block_k) — no (T/S)² (let alone T×T)
+score matrix ever exists — and communication overlaps the block matmuls.
 
 Causality is enforced with *global* positions: query block ``s`` holds
 positions ``s*T_local + i``; at ring step ``j`` it sees K/V block
@@ -38,10 +39,19 @@ def _block_update(scores, v_blk, o, m, l):
     return o, m_new, l
 
 
-def ring_attention_local(q, k, v, scale: float, axis_name: str = AXIS_SP):
+def ring_attention_local(
+    q, k, v, scale: float, axis_name: str = AXIS_SP, block_k: int = 512
+):
     """shard_map-level kernel: q/k/v are this device's (B, T_local, H, D)
     blocks of a sequence sharded over ``axis_name``. Causal, GQA-aware.
-    Returns (B, T_local, Hq, Dv)."""
+    Returns (B, T_local, Hq, Dv).
+
+    Within each ring step the received K/V block is processed in ``block_k``
+    sub-tiles through the same streaming-softmax update, so the live score
+    tensor is (B, Hkv, G, T_local, block_k) — per-device activation memory
+    stays O(T_local * block_k), never O(T_local^2)."""
+    import math
+
     b, t, hq, dk = q.shape
     hkv = k.shape[2]
     groups = hq // hkv
@@ -51,6 +61,9 @@ def ring_attention_local(q, k, v, scale: float, axis_name: str = AXIS_SP):
     qg = q.reshape(b, t, hkv, groups, dk)
     q_pos = idx * t + jnp.arange(t)  # global positions of local queries
 
+    bk = math.gcd(t, block_k)  # largest aligned sub-tile <= block_k
+    nb = t // bk
+
     o = jnp.zeros((b, hkv, groups, t, v.shape[-1]), jnp.float32)
     m = jnp.full((b, hkv, groups, t), -jnp.inf, jnp.float32)
     l = jnp.zeros((b, hkv, groups, t), jnp.float32)
@@ -58,13 +71,25 @@ def ring_attention_local(q, k, v, scale: float, axis_name: str = AXIS_SP):
     def step(carry, j):
         o, m, l, k_blk, v_blk = carry
         blk = (idx - j) % size
-        k_pos = blk * t + jnp.arange(t)
-        scores = jnp.einsum(
-            "bthgd,bkhd->bhgtk", qg, k_blk, preferred_element_type=jnp.float32
-        ) * scale
-        allowed = k_pos[None, :] <= q_pos[:, None]  # (T, Tk) global causal
-        scores = jnp.where(allowed[None, None, None], scores, -jnp.inf)
-        o, m, l = _block_update(scores, v_blk, o, m, l)
+
+        # (B, T, H, D) -> (nb, B, bk, H, D) sub-tiles for the inner scan
+        k_sub = k_blk.reshape(b, nb, bk, hkv, -1).transpose(1, 0, 2, 3, 4)
+        v_sub = v_blk.reshape(b, nb, bk, hkv, -1).transpose(1, 0, 2, 3, 4)
+
+        def sub(carry2, xs):
+            o, m, l = carry2
+            ks, vs, si = xs
+            k_pos = blk * t + si * bk + jnp.arange(bk)
+            scores = jnp.einsum(
+                "bthgd,bkhd->bhgtk", qg, ks, preferred_element_type=jnp.float32
+            ) * scale
+            allowed = k_pos[None, :] <= q_pos[:, None]  # (T, bk) global causal
+            scores = jnp.where(allowed[None, None, None], scores, -jnp.inf)
+            return _block_update(scores, vs, o, m, l), None
+
+        (o, m, l), _ = jax.lax.scan(
+            sub, (o, m, l), (k_sub, v_sub, jnp.arange(nb))
+        )
         k_next = jax.lax.ppermute(
             k_blk, axis_name, [(i, (i + 1) % size) for i in range(size)]
         )

@@ -125,12 +125,15 @@ class SpPrefill:
 
         self._write = jax.jit(write, donate_argnums=(0,))
 
+    def padded_len(self, t: int) -> int:
+        return -(-t // self.quantum) * self.quantum
+
     def __call__(self, prompt: np.ndarray, cache: KVCache):
         """Prefill ``prompt`` (B, T) into ``cache``; returns (logits, cache).
         Padded K/V rows sit beyond ``offset`` and are never attended (causal
         masking by offset) before being overwritten by decode."""
         t = prompt.shape[1]
-        t_pad = -(-t // self.quantum) * self.quantum
+        t_pad = self.padded_len(t)
         if t_pad > cache.max_seq:
             raise ValueError(
                 f"sp prefill needs {t_pad} cache rows, capacity {cache.max_seq}"

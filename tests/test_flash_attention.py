@@ -37,3 +37,70 @@ def test_flash_rejects_ragged_blocks():
     v = jnp.zeros((1, 128, 2, 16))
     with pytest.raises(ValueError, match="multiples"):
         flash_attention(q, k, v, jnp.asarray(0), 1.0, block_q=64, block_k=64, interpret=True)
+
+
+@pytest.mark.parametrize(
+    "b,t,s,hq,hkv,dk,dv,offset",
+    [
+        # DeepSeek MLA full mode: dk = qk_nope+qk_rope = 192, dv = 128
+        (1, 128, 256, 8, 8, 192, 128, 0),
+        # DeepSeek MLA compressed mode: MQA over one latent head,
+        # dk = rank+rope = 576, "values" are the rank slice (512)
+        (1, 128, 128, 16, 1, 576, 512, 0),
+        (1, 128, 256, 8, 8, 192, 128, 96),  # continuation at offset
+    ],
+)
+def test_flash_mla_head_dims(b, t, s, hq, hkv, dk, dv, offset):
+    """VERDICT r1 item 7: the kernel must serve DeepSeek's 64-aligned (not
+    128-aligned) head dims."""
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(b, t, hq, dk)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, hkv, dk)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, hkv, dv)), jnp.float32)
+    scale = dk**-0.5
+    ref = causal_attention(q, k, v, jnp.asarray(offset), scale)
+    got = flash_attention(
+        q, k, v, jnp.asarray(offset), scale, block_q=64, block_k=64, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize(
+    "s,hq,hkv,dk,offset",
+    [(256, 8, 2, 64, 17), (256, 16, 1, 576, 40), (128, 4, 4, 192, 127)],
+)
+def test_flash_decode_step(s, hq, hkv, dk, offset):
+    """T=1 decode variant: one query row against a long cache, offset mid-
+    buffer — positions beyond the offset must contribute nothing."""
+    rng = np.random.default_rng(2)
+    dv = 512 if dk == 576 else dk
+    q = jnp.asarray(rng.normal(size=(1, 1, hq, dk)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, s, hkv, dk)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, s, hkv, dv)), jnp.float32)
+    scale = dk**-0.5
+    ref = causal_attention(q, k, v, jnp.asarray(offset), scale)
+    got = flash_attention(
+        q, k, v, jnp.asarray(offset), scale, block_k=64, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_eligibility_gates(monkeypatch):
+    from mlx_sharding_tpu.ops.attention import _flash_eligible
+
+    q192 = jnp.zeros((1, 128, 8, 192))
+    k192 = jnp.zeros((1, 256, 8, 192))
+    v128 = jnp.zeros((1, 256, 8, 128))
+    qd = jnp.zeros((1, 1, 8, 192))
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert _flash_eligible(q192, k192, v128, None, None, None)
+    # softcap/window stay on XLA
+    assert not _flash_eligible(q192, k192, v128, 30.0, None, None)
+    assert not _flash_eligible(q192, k192, v128, None, 4096, None)
+    # decode is opt-in until measured on hardware
+    assert not _flash_eligible(qd, k192, v128, None, None, None)
+    monkeypatch.setenv("MST_FLASH_DECODE", "1")
+    assert _flash_eligible(qd, k192, v128, None, None, None)
+    monkeypatch.setenv("MST_FLASH", "0")
+    assert not _flash_eligible(q192, k192, v128, None, None, None)

@@ -129,3 +129,20 @@ def test_unsupported_arch_raises():
             model, params, max_seq=32, cache_dtype=jnp.float32,
             sp_mesh=make_mesh(sp=2),
         )
+
+
+def test_sp_quantum_overflow_falls_back_to_chunked(model_and_params):
+    """A prompt that fits KV capacity must not fail just because quantum
+    padding (sp * prefill_chunk) would exceed it — it falls back to the
+    chunked path."""
+    model, params = model_and_params
+    # max_seq=40 rounds to 40 (chunk 8); quantum = 4*8=32 -> 33 tokens pad to 64
+    gen = Generator(
+        model, params, max_seq=40, cache_dtype=jnp.float32, prefill_chunk=8,
+        sp_mesh=make_mesh(sp=4),
+    )
+    ref = Generator(model, params, max_seq=40, cache_dtype=jnp.float32, prefill_chunk=8)
+    prompt = list(range(1, 34))  # 33 tokens
+    assert [t for t, _ in gen.generate_step(prompt, max_tokens=7)] == [
+        t for t, _ in ref.generate_step(prompt, max_tokens=7)
+    ]
