@@ -42,6 +42,10 @@ def main(argv=None):
                         help="sequence-parallel prefill over N devices (ring "
                         "attention); prompts longer than one prefill chunk "
                         "shard their sequence dim")
+    parser.add_argument("--keep-quantized", action="store_true",
+                        help="keep 4-bit decoder weights packed in HBM "
+                        "(fused dequant-matmul) instead of dequantizing at "
+                        "load")
     parser.add_argument("--no-chat-template", action="store_true")
     args = parser.parse_args(argv)
     if args.engine == "chained" and not args.stage_bounds:
@@ -64,6 +68,7 @@ def main(argv=None):
         generator = load_chained_pipeline(
             args.model, bounds, max_seq=args.max_seq,
             prefill_chunk=args.prefill_chunk,
+            keep_quantized=args.keep_quantized,
         )
     elif args.stage_bounds or (args.num_stages and args.num_stages > 1):
         from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
@@ -75,7 +80,10 @@ def main(argv=None):
                 tuple(int(x) for x in part.split("-"))
                 for part in args.stage_bounds.split(",")
             ]
-        model, params = load_model(args.model, args.start_layer, args.end_layer)
+        model, params = load_model(
+            args.model, args.start_layer, args.end_layer,
+            keep_quantized=args.keep_quantized,
+        )
         generator = PipelineEngine(
             model, params,
             pipeline_mesh(len(bounds) if bounds else args.num_stages),
@@ -83,7 +91,10 @@ def main(argv=None):
             max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         )
     else:
-        model, params = load_model(args.model, args.start_layer, args.end_layer)
+        model, params = load_model(
+            args.model, args.start_layer, args.end_layer,
+            keep_quantized=args.keep_quantized,
+        )
         sp_mesh = None
         if args.sp and args.sp > 1:
             from mlx_sharding_tpu.parallel.mesh import make_mesh

@@ -17,6 +17,7 @@ import re
 from pathlib import Path
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -80,19 +81,37 @@ def load_raw_weights(model_path: Path) -> dict[str, jnp.ndarray]:
 
 
 def dequantize_weights(
-    weights: dict[str, jnp.ndarray], quantization: dict, dtype=jnp.bfloat16
+    weights: dict[str, jnp.ndarray],
+    quantization: dict,
+    dtype=jnp.bfloat16,
+    keep_packed_layers: bool = False,
 ) -> dict[str, jnp.ndarray]:
-    """Collapse every MLX ``{weight, scales, biases}`` triple into a dense
-    weight. Mirrors the predicate the reference feeds nn.quantize — a param is
-    quantized iff its ``.scales`` sibling exists (shard/utils.py:58-63)."""
+    """Process every MLX ``{weight, scales, biases}`` triple. Default:
+    collapse to a dense weight — mirrors the predicate the reference feeds
+    nn.quantize, a param is quantized iff its ``.scales`` sibling exists
+    (shard/utils.py:58-63). With ``keep_packed_layers``, decoder-layer
+    projections stay packed as ``{q, scales, biases}`` dicts (scales/biases
+    promoted to f32) for the fused dequant-matmul path; embed/head/norms are
+    still dequantized so every engine's embed/vocab machinery is unaffected."""
     group_size = int(quantization.get("group_size", 64))
     bits = int(quantization.get("bits", 4))
-    out: dict[str, jnp.ndarray] = {}
+    out: dict = {}
     for name, value in weights.items():
         base, _, leaf = name.rpartition(".")
         if leaf in ("scales", "biases"):
             continue  # consumed alongside their .weight
         if leaf == "weight" and f"{base}.scales" in weights:
+            if keep_packed_layers and LAYER_RE.search(name):
+                # scales/biases stay in the checkpoint dtype (fp16 for
+                # published 4-bit checkpoints) — both matmul paths cast to
+                # f32 on the fly, and f32 residency would add ~11% to the
+                # weight bytes streamed per decode step for nothing
+                out[name] = {
+                    "q": value,
+                    "scales": weights[f"{base}.scales"],
+                    "biases": weights[f"{base}.biases"],
+                }
+                continue
             value = dequantize(
                 value,
                 weights[f"{base}.scales"],
@@ -138,19 +157,34 @@ def load_model(
     start_layer: Optional[int] = None,
     end_layer: Optional[int] = None,
     dtype=jnp.bfloat16,
+    keep_quantized: bool = False,
 ):
     """Full load path (ref: shard/utils.py:33-68). Returns (model, params).
-    Native (Orbax) checkpoints are detected and restored directly."""
+    Native (Orbax) checkpoints are detected and restored directly.
+    ``keep_quantized`` keeps 4-bit decoder-layer weights packed in HBM
+    (fused dequant-matmul) on architectures that support it."""
     model_path = get_model_path(path_or_repo)
     from mlx_sharding_tpu.checkpoint import is_native_checkpoint, load_native_checkpoint
 
     if is_native_checkpoint(model_path):
+        if keep_quantized:
+            raise ValueError(
+                "keep_quantized is not supported for native (Orbax) "
+                "checkpoints — they store dense weights"
+            )
         return load_native_checkpoint(model_path, start_layer, end_layer, dtype=dtype)
     config_dict = load_config(model_path, start_layer, end_layer)
     model, config = build_model(config_dict)
+    if keep_quantized and not getattr(model, "supports_packed", False):
+        raise ValueError(
+            f"keep_quantized is not supported for {type(model).__name__}"
+        )
     weights = load_raw_weights(model_path)
     if config.quantization is not None:
-        weights = dequantize_weights(weights, config.quantization, dtype)
+        weights = dequantize_weights(
+            weights, config.quantization, dtype,
+            keep_packed_layers=keep_quantized,
+        )
     weights = filter_stage_weights(weights, config)
     params = model.map_weights(weights, dtype)
     return model, params
@@ -176,11 +210,22 @@ def collect_layer_stack(
             key = f"model.layers.{i}.{hf_suffix}"
             if key not in weights:
                 key = f"layers.{i}.{hf_suffix}"
-            w = jnp.asarray(weights[key], dtype)
+            w = weights[key]
+            if isinstance(w, dict):
+                # packed {q, scales, biases} triple: keep MLX's (out, in)
+                # orientation — the fused dequant-matmul contracts against it
+                stacked[our_name].append(w)
+                continue
+            w = jnp.asarray(w, dtype)
             if transpose:
                 w = w.T
             stacked[our_name].append(w)
-    return {k: jnp.stack(v) for k, v in stacked.items()}
+    # tree-map stack: a plain array is a single-leaf tree, a packed triple
+    # stacks per leaf into {q: (L, …), scales: (L, …), biases: (L, …)}
+    return {
+        k: jax.tree.map(lambda *xs: jnp.stack(xs), *v)
+        for k, v in stacked.items()
+    }
 
 
 def first_key(weights: dict, *candidates: str):

@@ -23,12 +23,24 @@ from mlx_sharding_tpu.ops import apply_rope, causal_attention, rms_norm, rope_fr
 
 
 class LlamaModel(BaseModel):
+    # decoder-layer projections may stay 4-bit packed in HBM
+    # (loading.load_model(keep_quantized=True) → ops.quant.linear dispatch)
+    supports_packed = True
+
     def __init__(self, config: LlamaConfig):
         super().__init__(config)
         self.inv_freq = jnp.asarray(
             rope_frequencies(config.head_dim, config.rope_theta, config.rope_scaling)
         )
         self.scale = config.head_dim ** -0.5
+        q = config.quantization or {}
+        self._gs = int(q.get("group_size", 64))
+        self._bits = int(q.get("bits", 4))
+
+    def _linear(self, x, w):
+        from mlx_sharding_tpu.ops.quant import linear
+
+        return linear(x, w, self._gs, self._bits)
 
     # ------------------------------------------------------------------
     def layer_attn_inputs(self, p, h, offset):
@@ -41,9 +53,9 @@ class LlamaModel(BaseModel):
         hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
-        q = r @ p["q_proj"]
-        k = r @ p["k_proj"]
-        v = r @ p["v_proj"]
+        q = self._linear(r, p["q_proj"])
+        k = self._linear(r, p["k_proj"])
+        v = self._linear(r, p["v_proj"])
         if cfg.attention_bias:  # Qwen2-style QKV biases
             q = q + p["q_bias"]
             k = k + p["k_bias"]
@@ -59,9 +71,13 @@ class LlamaModel(BaseModel):
         """Post-attention half: output projection + SwiGLU MLP."""
         cfg = self.config
         b, t, _ = h.shape
-        h = h + attn.reshape(b, t, -1) @ p["o_proj"]
+        h = h + self._linear(attn.reshape(b, t, -1), p["o_proj"])
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
-        ff = (jax.nn.silu(r @ p["gate_proj"]) * (r @ p["up_proj"])) @ p["down_proj"]
+        ff = self._linear(
+            jax.nn.silu(self._linear(r, p["gate_proj"]))
+            * self._linear(r, p["up_proj"]),
+            p["down_proj"],
+        )
         return h + ff
 
     def _layer(self, h, p, k_buf, v_buf, offset):

@@ -47,6 +47,67 @@ def dequantize(
     return (grouped * scales + biases).reshape(out_dim, in_dim).astype(dtype)
 
 
+def is_quantized(w) -> bool:
+    """True for a packed ``{q, scales, biases}`` param (kept-packed load
+    mode); False for a dense array."""
+    return isinstance(w, dict) and "q" in w
+
+
+def linear(x: jax.Array, w, group_size: int = 64, bits: int = 4) -> jax.Array:
+    """``x @ w`` that transparently serves packed params.
+
+    Dense path: ``w`` is the usual (in, out) array. Packed path: ``w`` is an
+    MLX-layout triple (``q`` (out, in*bits/32) uint32, ``scales``/``biases``
+    (out, in/group_size)) and the product routes through the fused Pallas
+    dequant-matmul on TPU (XLA dequant+matmul elsewhere) — the dense weight
+    never exists in HBM."""
+    if not is_quantized(w):
+        return x @ w
+    lead = x.shape[:-1]
+    in_dim = x.shape[-1]
+    x2 = x.reshape(-1, in_dim)
+    out = _quant_matmul(x2, w["q"], w["scales"], w["biases"], group_size, bits)
+    return out.reshape(*lead, -1)
+
+
+def _pallas_ok(m, in_dim, out_dim, group_size, bits) -> bool:
+    import os
+
+    if os.environ.get("MST_QMM", "1") == "0":
+        return False
+    # single source of truth for the dispatch contract: the kernel's own
+    # block defaults and min() clamping
+    from mlx_sharding_tpu.ops.quant_matmul import (
+        DEFAULT_BLOCK_IN,
+        DEFAULT_BLOCK_M,
+        DEFAULT_BLOCK_OUT,
+    )
+
+    per_word = 32 // bits
+    block_in = min(DEFAULT_BLOCK_IN, in_dim)
+    return (
+        jax.default_backend() == "tpu"
+        and m % min(DEFAULT_BLOCK_M, m) == 0
+        and out_dim % min(DEFAULT_BLOCK_OUT, out_dim) == 0
+        and in_dim % block_in == 0
+        and block_in % group_size == 0
+        and block_in % per_word == 0
+    )
+
+
+def _quant_matmul(x2, q, scales, biases, group_size, bits):
+    m, in_dim = x2.shape
+    out_dim = q.shape[0]
+    if _pallas_ok(m, in_dim, out_dim, group_size, bits):
+        from mlx_sharding_tpu.ops.quant_matmul import quant_matmul_pallas
+
+        return quant_matmul_pallas(
+            x2, q, scales, biases, group_size=group_size, bits=bits
+        )
+    w = dequantize(q, scales, biases, group_size, bits, jnp.float32)
+    return (x2 @ w.astype(x2.dtype).T).astype(x2.dtype)
+
+
 def quantize(w: np.ndarray, group_size: int = 64, bits: int = 4):
     """Inverse of :func:`dequantize` — mlx-compatible packer. Used by the
     shard-writer tool and round-trip tests; numpy (host, offline)."""

@@ -1,0 +1,127 @@
+"""Pallas fused dequant-matmul: 4-bit weights stay packed in HBM.
+
+Round 1 dequantized MLX grouped-quant checkpoints to dense bf16 at load —
+correct, but it forfeits the point of 4-bit weights on the decode path,
+which is BANDWIDTH: decode is HBM-bound, and streaming 4-bit words + one
+scale/bias pair per 64 weights moves ~4x fewer bytes than bf16 (SURVEY §7
+"hard part (a)"; ROADMAP r1 queue item). This kernel keeps the packed
+``{q, scales, biases}`` triple resident and fuses unpack → affine →
+matmul inside VMEM:
+
+- grid over (M tiles, OUT tiles); the reduction dim streams through a
+  ``fori_loop`` in ``block_in`` slices,
+- each slice loads (block_out, block_in/8) uint32 words, unpacks 8 nibbles
+  per word with broadcasted shifts (VPU), applies ``q * scale + bias`` per
+  ``group_size`` column group, and feeds the MXU dot,
+- accumulation in fp32, output cast to the activation dtype.
+
+Layout contract is exactly the checkpoint's (mlx.core.quantize,
+ref shard/utils.py:54-65): ``q`` (out, in*bits/32) LSB-first nibbles,
+``scales``/``biases`` (out, in/group_size) — validated bit-exactly by
+tests/test_quant_golden.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_M = 128
+DEFAULT_BLOCK_OUT = 128
+DEFAULT_BLOCK_IN = 512
+
+
+def _kernel(
+    x_ref, q_ref, s_ref, b_ref, o_ref, *, bits, group_size, block_in, in_dim
+):
+    per_word = 32 // bits
+    mask = (1 << bits) - 1
+    words = block_in // per_word
+    groups = block_in // group_size
+    bo = q_ref.shape[0]
+    shifts = jax.lax.broadcasted_iota(jnp.uint32, (1, 1, per_word), 2) * bits
+
+    def body(ki, acc):
+        xblk = x_ref[:, pl.ds(ki * block_in, block_in)].astype(jnp.float32)
+        wq = q_ref[:, pl.ds(ki * words, words)]  # (bo, words) uint32
+        nib = (wq[:, :, None] >> shifts) & mask  # (bo, words, per_word)
+        w = nib.reshape(bo, block_in).astype(jnp.float32)
+        s = s_ref[:, pl.ds(ki * groups, groups)].astype(jnp.float32)
+        b = b_ref[:, pl.ds(ki * groups, groups)].astype(jnp.float32)
+        s = jnp.repeat(s[:, :, None], group_size, axis=2).reshape(bo, block_in)
+        b = jnp.repeat(b[:, :, None], group_size, axis=2).reshape(bo, block_in)
+        w = w * s + b
+        return acc + jax.lax.dot_general(
+            xblk, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    acc0 = jnp.zeros((x_ref.shape[0], bo), jnp.float32)
+    acc = jax.lax.fori_loop(0, in_dim // block_in, body, acc0)
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("group_size", "bits", "block_m", "block_out", "block_in",
+                     "interpret"),
+)
+def quant_matmul_pallas(
+    x: jax.Array,  # (M, IN)
+    q: jax.Array,  # (OUT, IN * bits / 32) uint32
+    scales: jax.Array,  # (OUT, IN / group_size)
+    biases: jax.Array,  # (OUT, IN / group_size)
+    *,
+    group_size: int = 64,
+    bits: int = 4,
+    block_m: int = DEFAULT_BLOCK_M,
+    block_out: int = DEFAULT_BLOCK_OUT,
+    block_in: int = DEFAULT_BLOCK_IN,
+    interpret: bool = False,
+) -> jax.Array:
+    """x @ dequant(q, scales, biases).T without materializing the dense
+    weight. M and OUT must divide by their block sizes; IN by block_in."""
+    m, in_dim = x.shape
+    out_dim = q.shape[0]
+    per_word = 32 // bits
+    block_m = min(block_m, m)
+    block_out = min(block_out, out_dim)
+    block_in = min(block_in, in_dim)
+    if block_in % group_size or block_in % per_word:
+        raise ValueError(
+            f"block_in {block_in} must be a multiple of group_size "
+            f"{group_size} and {per_word}"
+        )
+    if m % block_m or out_dim % block_out or in_dim % block_in:
+        raise ValueError(
+            f"shapes (M={m}, OUT={out_dim}, IN={in_dim}) must divide block "
+            f"sizes ({block_m}, {block_out}, {block_in})"
+        )
+
+    grid = (m // block_m, out_dim // block_out)
+    return pl.pallas_call(
+        functools.partial(
+            _kernel, bits=bits, group_size=group_size, block_in=block_in,
+            in_dim=in_dim,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, in_dim), lambda mi, oi: (mi, 0)),
+            pl.BlockSpec(
+                (block_out, in_dim // per_word), lambda mi, oi: (oi, 0)
+            ),
+            pl.BlockSpec(
+                (block_out, in_dim // group_size), lambda mi, oi: (oi, 0)
+            ),
+            pl.BlockSpec(
+                (block_out, in_dim // group_size), lambda mi, oi: (oi, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_out), lambda mi, oi: (mi, oi)),
+        out_shape=jax.ShapeDtypeStruct((m, out_dim), x.dtype),
+        interpret=interpret,
+    )(x, q, scales, biases)

@@ -174,6 +174,7 @@ def load_chained_pipeline(
     stage_bounds: Sequence[tuple[int, int]],
     *,
     dtype=jnp.bfloat16,
+    keep_quantized: bool = False,
     **kwargs,
 ) -> ChainedPipeline:
     """Dynamic sharding into a chained pipeline: every stage loads from the
@@ -184,7 +185,9 @@ def load_chained_pipeline(
 
     models, params = [], []
     for start, end in stage_bounds:
-        m, p = load_model(model_path, start, end, dtype=dtype)
+        m, p = load_model(
+            model_path, start, end, dtype=dtype, keep_quantized=keep_quantized
+        )
         models.append(m)
         params.append(p)
     return ChainedPipeline(models, params, **kwargs)

@@ -96,8 +96,8 @@ def split_stage_stacks(model, layer_params: dict, stage_bounds) -> tuple[dict, d
             for s, e in stage_bounds
         ]
         slots = max(hi - lo for lo, hi in rows_per_stage)
-        stacked = {}
-        for name, w in stack.items():
+
+        def split_leaf(w):
             rows = []
             for lo, hi in rows_per_stage:
                 part = w[lo:hi]
@@ -105,7 +105,12 @@ def split_stage_stacks(model, layer_params: dict, stage_bounds) -> tuple[dict, d
                     pad = [(0, slots - (hi - lo))] + [(0, 0)] * (w.ndim - 1)
                     part = jnp.pad(part, pad)
                 rows.append(part)
-            stacked[name] = jnp.stack(rows)
+            return jnp.stack(rows)
+
+        # tree-map: plain arrays and packed {q, scales, biases} triples alike
+        stacked = {
+            name: jax.tree.map(split_leaf, w) for name, w in stack.items()
+        }
         mask = np.zeros((S, slots), bool)
         for si, (lo, hi) in enumerate(rows_per_stage):
             mask[si, : hi - lo] = True

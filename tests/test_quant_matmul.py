@@ -1,0 +1,179 @@
+"""Fused dequant-matmul: packed 4-bit weights through the whole stack.
+
+VERDICT r1 item 10: quantized checkpoints should decode with the weights
+STILL PACKED in HBM (4x capacity + bandwidth). Kernel parity runs in Pallas
+interpret mode; the end-to-end path loads a quantized tiny-llama checkpoint
+with keep_quantized=True and must match the dequantize-at-load path.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_sharding_tpu.ops.quant import dequantize, is_quantized, linear, quantize
+from mlx_sharding_tpu.ops.quant_matmul import quant_matmul_pallas
+
+
+@pytest.mark.parametrize(
+    "m,in_dim,out_dim,gs,bits",
+    [
+        (128, 512, 128, 64, 4),
+        (1, 512, 256, 64, 4),  # decode-shaped: one row
+        (64, 1024, 128, 128, 4),
+        (8, 512, 128, 64, 8),
+    ],
+)
+def test_pallas_kernel_matches_dense(m, in_dim, out_dim, gs, bits):
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(out_dim, in_dim)).astype(np.float32)
+    q, s, b = quantize(w, group_size=gs, bits=bits)
+    dense = np.asarray(
+        dequantize(q, s, b, group_size=gs, bits=bits, dtype=jnp.float32)
+    )
+    x = rng.normal(size=(m, in_dim)).astype(np.float32)
+    want = x @ dense.T
+
+    got = quant_matmul_pallas(
+        jnp.asarray(x), jnp.asarray(q), jnp.asarray(s, jnp.float32),
+        jnp.asarray(b, jnp.float32), group_size=gs, bits=bits,
+        block_m=64, block_out=64, block_in=256, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_linear_dispatch_packed_vs_dense():
+    """ops.quant.linear must produce the same numbers whether the weight is
+    a dense (in, out) array or the packed MLX triple."""
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(96, 128)).astype(np.float32)  # (out, in)
+    q, s, b = quantize(w, group_size=64, bits=4)
+    dense = np.asarray(dequantize(q, s, b, dtype=jnp.float32))
+
+    x = jnp.asarray(rng.normal(size=(2, 5, 128)), jnp.float32)
+    want = np.asarray(x @ jnp.asarray(dense.T))
+    packed = {
+        "q": jnp.asarray(q),
+        "scales": jnp.asarray(s, jnp.float32),
+        "biases": jnp.asarray(b, jnp.float32),
+    }
+    assert is_quantized(packed) and not is_quantized(jnp.asarray(dense))
+    got = np.asarray(linear(x, packed, 64, 4))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def _quantized_tiny_llama(tmp_path: Path):
+    """Write a tiny llama checkpoint whose decoder projections are MLX-style
+    4-bit triples (config.quantization present)."""
+    from safetensors.numpy import save_file
+
+    cfg = dict(
+        model_type="llama", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False,
+        quantization={"group_size": 64, "bits": 4},
+    )
+    rng = np.random.default_rng(7)
+    tensors = {}
+
+    def dense(name, shape):
+        tensors[name] = (rng.normal(size=shape) * 0.05).astype(np.float32)
+
+    def quant(name, out_d, in_d):
+        w = (rng.normal(size=(out_d, in_d)) * 0.05).astype(np.float32)
+        q, s, b = quantize(w, group_size=64, bits=4)
+        tensors[name] = q
+        tensors[name.replace(".weight", ".scales")] = s
+        tensors[name.replace(".weight", ".biases")] = b
+
+    dense("model.embed_tokens.weight", (128, 64))
+    dense("model.norm.weight", (64,))
+    dense("lm_head.weight", (128, 64))
+    for i in range(2):
+        p = f"model.layers.{i}"
+        dense(f"{p}.input_layernorm.weight", (64,))
+        dense(f"{p}.post_attention_layernorm.weight", (64,))
+        quant(f"{p}.self_attn.q_proj.weight", 64, 64)
+        quant(f"{p}.self_attn.k_proj.weight", 32, 64)
+        quant(f"{p}.self_attn.v_proj.weight", 32, 64)
+        quant(f"{p}.self_attn.o_proj.weight", 64, 64)
+        quant(f"{p}.mlp.gate_proj.weight", 128, 64)
+        quant(f"{p}.mlp.up_proj.weight", 128, 64)
+        quant(f"{p}.mlp.down_proj.weight", 64, 128)
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps(cfg))
+    return tmp_path
+
+
+def _leaf_bytes(tree):
+    return sum(x.nbytes for x in jax.tree.leaves(tree))
+
+
+def test_keep_quantized_end_to_end(tmp_path):
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.loading import load_model
+
+    path = _quantized_tiny_llama(tmp_path)
+    model_d, params_d = load_model(str(path), dtype=jnp.float32)
+    model_p, params_p = load_model(
+        str(path), dtype=jnp.float32, keep_quantized=True
+    )
+    # packed layers really are packed (and much smaller)
+    assert is_quantized(
+        jax.tree.map(
+            lambda x: x, params_p["layers"]["q_proj"], is_leaf=is_quantized
+        )
+    )
+    assert _leaf_bytes(params_p["layers"]) < _leaf_bytes(params_d["layers"]) / 2
+
+    prompt = [3, 17, 42, 9, 77]
+    ref = Generator(
+        model_d, params_d, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    gen = Generator(
+        model_p, params_p, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=10)]
+    got = [t for t, _ in gen.generate_step(prompt, max_tokens=10)]
+    assert got == want
+
+
+def test_keep_quantized_fused_pipeline(tmp_path):
+    """Packed params ride the fused SPMD engine (tree-aware stage split)."""
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.loading import load_model
+    from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+    path = _quantized_tiny_llama(tmp_path)
+    model, params = load_model(str(path), dtype=jnp.float32, keep_quantized=True)
+    ref = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    want = [t for t, _ in ref.generate_step([5, 9, 2], max_tokens=8)]
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(2), max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    got = [t for t, _ in eng.generate_step([5, 9, 2], max_tokens=8)]
+    assert got == want
+
+
+def test_keep_quantized_unsupported_arch(tmp_path):
+    from mlx_sharding_tpu.loading import load_model
+    import transformers
+    import torch
+
+    cfg = transformers.Gemma2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, sliding_window=8, query_pre_attn_scalar=8,
+    )
+    m = transformers.Gemma2ForCausalLM(cfg)
+    m.save_pretrained(tmp_path, safe_serialization=True)
+    with pytest.raises(ValueError, match="keep_quantized"):
+        load_model(str(tmp_path), dtype=jnp.float32, keep_quantized=True)
