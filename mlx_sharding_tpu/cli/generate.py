@@ -38,6 +38,9 @@ def main(argv=None):
                         help="pipeline engine for --stage-bounds: 'fused' runs all "
                         "stages as one SPMD program per token (default); 'chained' "
                         "uses per-stage programs with D2D hand-off")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel width within each pipeline "
+                        "stage (Llama family)")
     parser.add_argument("--sp", type=int, default=None,
                         help="sequence-parallel prefill over N devices (ring "
                         "attention); prompts longer than one prefill chunk "
@@ -50,6 +53,8 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if args.engine == "chained" and not args.stage_bounds:
         parser.error("--engine chained requires --stage-bounds")
+    if args.tp > 1 and args.engine == "chained" and args.stage_bounds:
+        parser.error("--tp requires the fused engine")
     if args.sp and (args.stage_bounds or args.num_stages):
         parser.error("--sp applies to the single-stage generator only")
 
@@ -70,8 +75,8 @@ def main(argv=None):
             prefill_chunk=args.prefill_chunk,
             keep_quantized=args.keep_quantized,
         )
-    elif args.stage_bounds or (args.num_stages and args.num_stages > 1):
-        from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+    elif args.stage_bounds or (args.num_stages and args.num_stages > 1) or args.tp > 1:
+        from mlx_sharding_tpu.parallel.mesh import make_mesh
         from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
         bounds = None
@@ -86,7 +91,8 @@ def main(argv=None):
         )
         generator = PipelineEngine(
             model, params,
-            pipeline_mesh(len(bounds) if bounds else args.num_stages),
+            make_mesh(pp=len(bounds) if bounds else (args.num_stages or 1),
+                      tp=args.tp),
             stage_bounds=bounds,
             max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         )

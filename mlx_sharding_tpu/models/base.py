@@ -101,6 +101,13 @@ class BaseModel:
         this — engines must use it instead of config.num_key_value_heads."""
         return self.config.num_key_value_heads
 
+    def tp_layer_axes(self) -> dict:
+        """{layer_param_name: per-layer dim index (after the stacked-L axis)
+        sharded over tp, or None for replicated}. Empty dict → the
+        architecture has no tensor-parallel wiring yet and engines must
+        reject tp > 1."""
+        return {}
+
     # -- layer structure ---------------------------------------------------
     def layer_group_ranges(self) -> dict:
         """Global-layer ranges of structurally distinct layer groups.

@@ -185,7 +185,7 @@ class DeepseekV2Model(BaseModel):
         )
         return n_dense, cfg.num_local_layers - n_dense
 
-    def run_layers(self, layer_params, h, k, v, offset, mask=None):
+    def run_layers(self, layer_params, h, k, v, offset, mask=None, tp_axis=None):
         """Two scans (dense prefix, MoE suffix) over structurally distinct
         param stacks. The group sizes come from the param stacks themselves
         (not the config bounds), so the fused engine's padded uniform stacks
@@ -193,6 +193,10 @@ class DeepseekV2Model(BaseModel):
         matching {group: (L,) bool} dict for padded slots."""
         from mlx_sharding_tpu.models.base import scan_layers
 
+        if tp_axis is not None:
+            raise NotImplementedError(
+                f"tensor parallelism is not wired for {type(self).__name__}"
+            )
         n_dense = (
             next(iter(layer_params["dense"].values())).shape[0]
             if "dense" in layer_params

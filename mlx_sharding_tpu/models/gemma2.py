@@ -67,7 +67,11 @@ class Gemma2Model(BaseModel):
         h = h + rms_norm(ff, p["post_ffw_norm"], eps, offset=1.0)
         return h, k_buf, v_buf
 
-    def run_layers(self, layer_params, h, k, v, offset, mask=None):
+    def run_layers(self, layer_params, h, k, v, offset, mask=None, tp_axis=None):
+        if tp_axis is not None:
+            raise NotImplementedError(
+                f"tensor parallelism is not wired for {type(self).__name__}"
+            )
         # The GLOBAL layer index travels inside the param stack
         # ("layer_idx", added by map_weights/init_params): window alternation
         # follows it, so arbitrary stage slices — including the fused SPMD

@@ -47,10 +47,14 @@ class LlamaModel(BaseModel):
         """Pre-attention half of a decoder layer: norm + QKV + RoPE at
         absolute positions ``offset..offset+T``. Split out so the sequence-
         parallel prefill path (parallel/sp_prefill.py) can swap the attention
-        op (ring over ``sp``) while reusing the exact projection math."""
+        op (ring over ``sp``) while reusing the exact projection math.
+
+        Head counts are derived from the projection OUTPUT shapes, not the
+        config — under tensor parallelism each device's param shard carries
+        heads/tp heads and this same code runs unchanged on the slice."""
         cfg = self.config
         b, t, _ = h.shape
-        hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        d = cfg.head_dim
 
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
         q = self._linear(r, p["q_proj"])
@@ -60,45 +64,67 @@ class LlamaModel(BaseModel):
             q = q + p["q_bias"]
             k = k + p["k_bias"]
             v = v + p["v_bias"]
-        q = q.reshape(b, t, hq, d)
-        k = k.reshape(b, t, hkv, d)
-        v = v.reshape(b, t, hkv, d)
+        q = q.reshape(b, t, q.shape[-1] // d, d)
+        k = k.reshape(b, t, k.shape[-1] // d, d)
+        v = v.reshape(b, t, v.shape[-1] // d, d)
         q = apply_rope(q, self.inv_freq, offset)
         k = apply_rope(k, self.inv_freq, offset)
         return q, k, v
 
-    def layer_finish(self, p, h, attn):
-        """Post-attention half: output projection + SwiGLU MLP."""
+    def layer_finish(self, p, h, attn, tp_axis=None):
+        """Post-attention half: output projection + SwiGLU MLP. Under TP the
+        O and down projections contract over sharded dims, so their partial
+        products psum over ``tp_axis`` — exactly two collectives per layer
+        (Megatron-style column/row split), riding ICI."""
         cfg = self.config
         b, t, _ = h.shape
-        h = h + self._linear(attn.reshape(b, t, -1), p["o_proj"])
+        attn_out = self._linear(attn.reshape(b, t, -1), p["o_proj"])
+        if tp_axis is not None:
+            attn_out = jax.lax.psum(attn_out, tp_axis)
+        h = h + attn_out
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
         ff = self._linear(
             jax.nn.silu(self._linear(r, p["gate_proj"]))
             * self._linear(r, p["up_proj"]),
             p["down_proj"],
         )
+        if tp_axis is not None:
+            ff = jax.lax.psum(ff, tp_axis)
         return h + ff
 
-    def _layer(self, h, p, k_buf, v_buf, offset):
+    def _layer(self, h, p, k_buf, v_buf, offset, tp_axis=None):
         q, k, v = self.layer_attn_inputs(p, h, offset)
         k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
         attn = causal_attention(q, k_buf, v_buf, offset, self.scale)
-        return self.layer_finish(p, h, attn), k_buf, v_buf
+        return self.layer_finish(p, h, attn, tp_axis), k_buf, v_buf
 
-    def run_layers(self, layer_params, h, k, v, offset, mask=None):
+    def run_layers(self, layer_params, h, k, v, offset, mask=None, tp_axis=None):
         """The stage body: scan the (local) stacked layers, threading the
         full-capacity K/V buffers (L, B, S, H, D) through as scan xs/ys.
         This is the piece the SPMD pipeline executes per tick; ``__call__``
         wraps it with embed/head for the single-program path. ``mask`` is an
         optional (L,) bool marking active layers — padding slots in the fused
-        engine's uniform per-stage stacks scan as no-ops."""
+        engine's uniform per-stage stacks scan as no-ops. ``tp_axis`` names
+        the mesh axis attention heads / MLP columns are sharded over."""
         from mlx_sharding_tpu.models.base import scan_layers
 
         def body(h, p, k_buf, v_buf):
-            return self._layer(h, p, k_buf, v_buf, offset)
+            return self._layer(h, p, k_buf, v_buf, offset, tp_axis)
 
         return scan_layers(body, h, layer_params, k, v, mask)
+
+    def tp_layer_axes(self) -> dict:
+        """Per-layer-param dim (counted after the stacked-L axis) sharded
+        over tp: column-parallel QKV/gate/up (output dim), row-parallel
+        O/down (contracting dim); norms replicated."""
+        axes = {
+            "input_norm": None, "post_norm": None,
+            "q_proj": 1, "k_proj": 1, "v_proj": 1, "o_proj": 0,
+            "gate_proj": 1, "up_proj": 1, "down_proj": 0,
+        }
+        if self.config.attention_bias:
+            axes.update({"q_bias": 0, "k_bias": 0, "v_bias": 0})
+        return axes
 
     def head_input(self, params, h):
         """Final norm before the (tied-embedding aware) LM head — ref

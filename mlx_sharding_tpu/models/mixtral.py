@@ -55,7 +55,11 @@ class MixtralModel(BaseModel):
         moe = apply_experts(flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"])
         return h + moe.reshape(b, t, hidden), k_buf, v_buf
 
-    def run_layers(self, layer_params, h, k, v, offset, mask=None):
+    def run_layers(self, layer_params, h, k, v, offset, mask=None, tp_axis=None):
+        if tp_axis is not None:
+            raise NotImplementedError(
+                f"tensor parallelism is not wired for {type(self).__name__}"
+            )
         from mlx_sharding_tpu.models.base import scan_layers
 
         def body(h, p, k_buf, v_buf):

@@ -38,7 +38,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mlx_sharding_tpu.cache import KVCache
-from mlx_sharding_tpu.parallel.mesh import AXIS_PP
+from mlx_sharding_tpu.ops.quant import is_quantized
+from mlx_sharding_tpu.parallel.mesh import AXIS_PP, AXIS_TP
 from mlx_sharding_tpu.sample import (
     SamplerParams,
     init_recent_tokens,
@@ -167,6 +168,7 @@ class PipelineEngine:
         self.model = model
         self.mesh = mesh
         self.num_stages = mesh.shape[AXIS_PP]
+        self.tp = mesh.shape.get(AXIS_TP, 1)
         self.microbatches = microbatches
         self.batch = batch
         # chunk-multiple capacity: padded prefill writes stay in bounds
@@ -178,6 +180,18 @@ class PipelineEngine:
         stage_sharding = NamedSharding(mesh, P(AXIS_PP))
         replicated = NamedSharding(mesh, P())
 
+        tp_axes = model.tp_layer_axes()
+        if self.tp > 1:
+            if not tp_axes:
+                raise ValueError(
+                    f"tensor parallelism is not wired for {type(model).__name__}"
+                )
+            if model.cache_num_heads() % self.tp:
+                raise ValueError(
+                    f"tp={self.tp} must divide the {model.cache_num_heads()} "
+                    "KV heads"
+                )
+
         if stage_bounds is None:
             stage_bounds = balanced_stage_bounds(cfg.num_hidden_layers, S)
         elif len(stage_bounds) != S:
@@ -185,8 +199,43 @@ class PipelineEngine:
                 f"{len(stage_bounds)} stage bounds for a {S}-stage pp mesh"
             )
         self.stage_bounds = [tuple(b) for b in stage_bounds]
+        # under TP the KV heads axis is sharded too: each (pp, tp) device
+        # holds its stage's cache for its own heads only
+        self._kv_spec = (
+            P(AXIS_PP, None, None, None, None, AXIS_TP)
+            if self.tp > 1 else P(AXIS_PP)
+        )
         split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
-        self.layer_params = jax.device_put(split, stage_sharding)
+
+        if self.tp == 1:
+            self.layer_specs = jax.tree.map(lambda _: P(AXIS_PP), split)
+        else:
+            # homogeneous (llama-family) stacks only — guaranteed by the
+            # tp_axes guard above. (S, L, …) array → tp on the model-declared
+            # per-layer dim, offset by the two leading stack axes.
+            def param_spec(name, w):
+                if is_quantized(w):
+                    raise ValueError(
+                        "tensor parallelism over packed 4-bit weights is not "
+                        "supported — load without keep_quantized"
+                    )
+                ax = tp_axes.get(name)
+                if ax is None:
+                    return P(AXIS_PP)
+                dims = [AXIS_PP, None] + [None] * (w.ndim - 2)
+                dims[2 + ax] = AXIS_TP
+                return P(*dims)
+
+            self.layer_specs = {
+                name: param_spec(name, w) for name, w in split.items()
+            }
+        self.layer_params = jax.device_put(
+            split,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self.layer_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            ),
+        )
         self.layer_masks = jax.device_put(masks, stage_sharding)
         self.layers_per_stage = slots
 
@@ -245,7 +294,7 @@ class PipelineEngine:
             self.batch,
         )
         shape = (S, L, M + 1, B, self.max_seq, self.model.cache_num_heads())
-        sharding = NamedSharding(self.mesh, P(AXIS_PP))
+        sharding = NamedSharding(self.mesh, self._kv_spec)
         # offset is PER MICROBATCH SLOT: continuous batching runs a different
         # request (at a different sequence position) in every slot
         return KVCache(
@@ -285,6 +334,7 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     def _build_step(self, t_len: int, with_sampling: bool):
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
+        tp_axis = AXIS_TP if self.tp > 1 else None
 
         def body(layer_params, masks, vparts, shared, tokens, k, v, offsets, active, n_valid):
             # Per-device views: layer_params (1, L, …) → (L, …); k/v
@@ -321,7 +371,8 @@ class PipelineEngine:
                 k_m = jax.lax.dynamic_index_in_dim(k, m_write, 1, keepdims=False)
                 v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
                 h_out, k_m, v_m = model.run_layers(
-                    layer_params, h_in, k_m, v_m, offset, mask=masks
+                    layer_params, h_in, k_m, v_m, offset, mask=masks,
+                    tp_axis=tp_axis,
                 )
                 k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
                 v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
@@ -352,18 +403,18 @@ class PipelineEngine:
             body,
             mesh=self.mesh,
             in_specs=(
-                jax.tree.map(lambda _: spec_stage, self.layer_params),
+                self.layer_specs,
                 jax.tree.map(lambda _: spec_stage, self.layer_masks),
                 jax.tree.map(lambda _: spec_stage, self.vocab_parts),
                 jax.tree.map(lambda _: spec_rep, self.shared_params),
                 spec_rep,  # tokens
-                spec_stage,  # k
-                spec_stage,  # v
+                self._kv_spec,  # k
+                self._kv_spec,  # v
                 spec_rep,  # offsets (M,)
                 spec_rep,  # active (M,)
                 spec_rep,  # n_valid
             ),
-            out_specs=(spec_rep, spec_stage, spec_stage),
+            out_specs=(spec_rep, self._kv_spec, self._kv_spec),
             check_vma=False,
         )
         if t_len == 1:
@@ -442,6 +493,7 @@ class PipelineEngine:
         slice ``slot`` at that slot's offset, last stage banks the
         last-valid-position logits."""
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
+        tp_axis = AXIS_TP if self.tp > 1 else None
         t_len = self.prefill_chunk
 
         def body(layer_params, masks, vparts, shared, tokens, slot, k, v, offsets, n_valid):
@@ -464,7 +516,8 @@ class PipelineEngine:
                 k_m = jax.lax.dynamic_index_in_dim(k, m_write, 1, keepdims=False)
                 v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
                 h_out, k_m, v_m = model.run_layers(
-                    layer_params, h_in, k_m, v_m, offset, mask=masks
+                    layer_params, h_in, k_m, v_m, offset, mask=masks,
+                    tp_axis=tp_axis,
                 )
                 k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
                 v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
@@ -489,18 +542,18 @@ class PipelineEngine:
             body,
             mesh=self.mesh,
             in_specs=(
-                jax.tree.map(lambda _: spec_stage, self.layer_params),
+                self.layer_specs,
                 jax.tree.map(lambda _: spec_stage, self.layer_masks),
                 jax.tree.map(lambda _: spec_stage, self.vocab_parts),
                 jax.tree.map(lambda _: spec_rep, self.shared_params),
                 spec_rep,  # tokens (B, T)
                 spec_rep,  # slot
-                spec_stage,  # k
-                spec_stage,  # v
+                self._kv_spec,  # k
+                self._kv_spec,  # v
                 spec_rep,  # offsets
                 spec_rep,  # n_valid
             ),
-            out_specs=(spec_rep, spec_stage, spec_stage),
+            out_specs=(spec_rep, self._kv_spec, self._kv_spec),
             check_vma=False,
         )
 

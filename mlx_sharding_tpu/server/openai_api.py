@@ -97,6 +97,7 @@ class ModelProvider:
         engine: str = "fused",
         concurrent: int = 1,
         multihost: bool = False,
+        tp: int = 1,
         max_seq: int = 4096,
         prefill_chunk: int = 256,
         cache_dtype=None,
@@ -112,6 +113,7 @@ class ModelProvider:
         self.engine = engine
         self.concurrent = max(1, concurrent)
         self.multihost = multihost
+        self.tp = max(1, tp)
         self.max_seq = max_seq
         self.prefill_chunk = prefill_chunk
         self.cache_dtype = cache_dtype
@@ -176,12 +178,12 @@ class ModelProvider:
                     len(self.stage_bounds) if self.stage_bounds
                     else (self.num_stages or 1)
                 )
-                if stages > 1 or self.concurrent > 1:
-                    from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+                if stages > 1 or self.concurrent > 1 or self.tp > 1:
+                    from mlx_sharding_tpu.parallel.mesh import make_mesh
                     from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
                     generator = PipelineEngine(
-                        model, params, pipeline_mesh(stages),
+                        model, params, make_mesh(pp=stages, tp=self.tp),
                         stage_bounds=self.stage_bounds,
                         microbatches=self.concurrent,
                         max_seq=self.max_seq, cache_dtype=cache_dtype,
@@ -672,6 +674,9 @@ def main(argv=None):
                         help="pipeline engine for --stage-bounds: fused SPMD "
                         "(one program per token, default) or chained per-stage "
                         "programs")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel width within each pipeline "
+                        "stage (Llama family)")
     parser.add_argument("--concurrent", type=int, default=1,
                         help="continuous-batching slots: serve up to N "
                         "requests interleaved in one fused engine (N>1 "
@@ -695,6 +700,8 @@ def main(argv=None):
         parser.error("--engine chained requires --stage-bounds")
     if args.concurrent > 1 and args.engine == "chained":
         parser.error("--concurrent requires the fused engine")
+    if args.tp > 1 and args.engine == "chained" and args.stage_bounds:
+        parser.error("--tp requires the fused engine")
     if args.coordinator and (args.num_processes or 1) > 1:
         if args.concurrent > 1:
             parser.error("--concurrent is not yet supported with multi-host "
@@ -727,6 +734,7 @@ def main(argv=None):
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
         num_stages=args.num_stages, stage_bounds=stage_bounds,
         engine=args.engine, concurrent=args.concurrent, multihost=multihost,
+        tp=args.tp,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         chat_template=chat_template,
     )

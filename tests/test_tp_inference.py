@@ -1,0 +1,112 @@
+"""Tensor parallelism in the fused inference engine — heads/MLP columns
+sharded over tp WITHIN each pipeline stage (Megatron column/row split, two
+psums per layer over ICI). The reference has no TP at all (SURVEY §2.3)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.generate import Generator
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.parallel.mesh import make_mesh
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+TINY = dict(
+    vocab_size=256,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=4,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    return model, params
+
+
+def _ref(model, params, prompt, **kw):
+    gen = Generator(model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8)
+    return [t for t, _ in gen.generate_step(prompt, **kw)]
+
+
+def test_pp2_tp2_matches_single_device(model_and_params):
+    model, params = model_and_params
+    prompt = [3, 17, 42, 9]
+    want = _ref(model, params, prompt, max_tokens=10)
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=2, tp=2), max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    got = [t for t, _ in eng.generate_step(prompt, max_tokens=10)]
+    assert got == want
+
+
+def test_pp1_tp2_seeded_sampling(model_and_params):
+    model, params = model_and_params
+    prompt = [5, 9, 2, 7]
+    kw = dict(temperature=0.9, top_p=0.85, seed=31, max_tokens=8)
+    want = _ref(model, params, prompt, **kw)
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=1, tp=2), max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    assert [t for t, _ in eng.generate_step(prompt, **kw)] == want
+
+
+def test_tp_with_uneven_pp_and_microbatches(model_and_params):
+    model, params = model_and_params
+    prompt = list(range(1, 14))
+    want = _ref(model, params, prompt, max_tokens=6)
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=2, tp=2), stage_bounds=[(0, 3), (3, 4)],
+        microbatches=2, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    assert [t for t, _ in eng.generate_step(prompt, max_tokens=6)] == want
+
+
+def test_tp_cache_is_head_sharded(model_and_params):
+    model, params = model_and_params
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=2, tp=2), max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    cache = eng.init_cache()
+    shard = cache.k.sharding.shard_shape(cache.k.shape)
+    assert shard[0] == 1  # stage-local
+    assert shard[5] == TINY["num_key_value_heads"] // 2  # head-sharded
+    # q_proj columns sharded, norms replicated
+    qs = eng.layer_params["q_proj"].sharding.shard_shape(
+        eng.layer_params["q_proj"].shape
+    )
+    assert qs[-1] == eng.layer_params["q_proj"].shape[-1] // 2
+    ns = eng.layer_params["input_norm"].sharding.shard_shape(
+        eng.layer_params["input_norm"].shape
+    )
+    assert ns[-1] == eng.layer_params["input_norm"].shape[-1]
+
+
+def test_tp_unsupported_arch_raises():
+    from mlx_sharding_tpu.config import DeepseekV2Config
+    from mlx_sharding_tpu.models.deepseek_v2 import DeepseekV2Model
+
+    model = DeepseekV2Model(
+        DeepseekV2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=16, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=16,
+            q_lora_rank=None, qk_rope_head_dim=8, qk_nope_head_dim=16,
+            v_head_dim=12, n_routed_experts=4, n_shared_experts=1,
+            num_experts_per_tok=2, first_k_dense_replace=1,
+        )
+    )
+    params = model.init_params(jax.random.PRNGKey(1), jnp.float32)
+    with pytest.raises(ValueError, match="tensor parallelism"):
+        PipelineEngine(
+            model, params, make_mesh(pp=1, tp=2), max_seq=32,
+            cache_dtype=jnp.float32, prefill_chunk=8,
+        )
