@@ -41,6 +41,9 @@ def main(argv=None):
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel width within each pipeline "
                         "stage (Llama family)")
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel width within each pipeline "
+                        "stage (MoE models)")
     parser.add_argument("--sp", type=int, default=None,
                         help="sequence-parallel prefill over N devices (ring "
                         "attention); prompts longer than one prefill chunk "
@@ -53,9 +56,9 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if args.engine == "chained" and not args.stage_bounds:
         parser.error("--engine chained requires --stage-bounds")
-    if args.tp > 1 and args.engine == "chained" and args.stage_bounds:
-        parser.error("--tp requires the fused engine")
-    if args.sp and (args.stage_bounds or args.num_stages):
+    if (args.tp > 1 or args.ep > 1) and args.engine == "chained":
+        parser.error("--tp/--ep require the fused engine")
+    if args.sp and (args.stage_bounds or args.num_stages or args.tp > 1 or args.ep > 1):
         parser.error("--sp applies to the single-stage generator only")
 
     import jax.numpy as jnp
@@ -75,7 +78,7 @@ def main(argv=None):
             prefill_chunk=args.prefill_chunk,
             keep_quantized=args.keep_quantized,
         )
-    elif args.stage_bounds or (args.num_stages and args.num_stages > 1) or args.tp > 1:
+    elif args.stage_bounds or (args.num_stages and args.num_stages > 1) or args.tp > 1 or args.ep > 1:
         from mlx_sharding_tpu.parallel.mesh import make_mesh
         from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
@@ -92,7 +95,7 @@ def main(argv=None):
         generator = PipelineEngine(
             model, params,
             make_mesh(pp=len(bounds) if bounds else (args.num_stages or 1),
-                      tp=args.tp),
+                      tp=args.tp, ep=args.ep),
             stage_bounds=bounds,
             max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         )

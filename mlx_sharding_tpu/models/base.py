@@ -108,6 +108,12 @@ class BaseModel:
         reject tp > 1."""
         return {}
 
+    def ep_layer_axes(self) -> dict:
+        """Same shape as :meth:`tp_layer_axes` for the expert-parallel axis:
+        which per-layer dims hold the expert stacks. Empty dict → the
+        architecture has no EP wiring and engines must reject ep > 1."""
+        return {}
+
     # -- layer structure ---------------------------------------------------
     def layer_group_ranges(self) -> dict:
         """Global-layer ranges of structurally distinct layer groups.

@@ -31,7 +31,7 @@ class MixtralModel(BaseModel):
         self.scale = config.head_dim**-0.5
 
     # ------------------------------------------------------------------
-    def _layer(self, h, p, k_buf, v_buf, offset):
+    def _layer(self, h, p, k_buf, v_buf, offset, ep_axis=None):
         cfg = self.config
         b, t, hidden = h.shape
         hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -52,10 +52,16 @@ class MixtralModel(BaseModel):
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
         flat = r.reshape(b * t, hidden)
         weights, idx = mixtral_routing(flat, p["router"], cfg.num_experts_per_tok)
-        moe = apply_experts(flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"])
+        moe = apply_experts(
+            flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"],
+            ep_axis=ep_axis,
+        )
         return h + moe.reshape(b, t, hidden), k_buf, v_buf
 
-    def run_layers(self, layer_params, h, k, v, offset, mask=None, tp_axis=None):
+    def run_layers(
+        self, layer_params, h, k, v, offset, mask=None, tp_axis=None,
+        ep_axis=None,
+    ):
         if tp_axis is not None:
             raise NotImplementedError(
                 f"tensor parallelism is not wired for {type(self).__name__}"
@@ -63,9 +69,14 @@ class MixtralModel(BaseModel):
         from mlx_sharding_tpu.models.base import scan_layers
 
         def body(h, p, k_buf, v_buf):
-            return self._layer(h, p, k_buf, v_buf, offset)
+            return self._layer(h, p, k_buf, v_buf, offset, ep_axis=ep_axis)
 
         return scan_layers(body, h, layer_params, k, v, mask)
+
+    def ep_layer_axes(self) -> dict:
+        """Expert stacks shard their leading (E) dim over ep; everything
+        else replicates across ep devices."""
+        return {"w_gate": 0, "w_up": 0, "w_down": 0}
 
     def head_input(self, params, h):
         return rms_norm(h, params["final_norm"]["weight"], self.config.rms_norm_eps)

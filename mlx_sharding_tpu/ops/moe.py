@@ -73,10 +73,21 @@ def deepseek_routing(
     return topv * routed_scaling_factor, topi
 
 
-def apply_experts(x, weights, idx, w_gate, w_up, w_down):
+def apply_experts(x, weights, idx, w_gate, w_up, w_down, ep_axis=None):
     """SwiGLU expert application. x (N, H); w_* stacked (E, H, I)/(E, I, H);
-    weights/idx (N, K). Returns (N, H)."""
+    weights/idx (N, K). Returns (N, H).
+
+    ``ep_axis``: inside shard_map with the expert stacks sharded over that
+    mesh axis, each device holds E/ep experts whose GLOBAL ids start at
+    ``axis_index * E_local``; routing (weights/idx, global ids) is replicated,
+    each device accumulates only its residents' contribution, and one psum
+    combines — no all-to-all, no capacity factor, no token dropping."""
     n = x.shape[0]
+    if ep_axis is not None:
+        e_local = w_gate.shape[0]
+        base = jax.lax.axis_index(ep_axis) * e_local
+        acc = _apply_scan(x, weights, idx - base, w_gate, w_up, w_down)
+        return jax.lax.psum(acc, ep_axis)
     if n <= GATHER_PATH_MAX_TOKENS:
         return _apply_gather(x, weights, idx, w_gate, w_up, w_down)
     return _apply_scan(x, weights, idx, w_gate, w_up, w_down)

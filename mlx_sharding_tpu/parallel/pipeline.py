@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mlx_sharding_tpu.cache import KVCache
 from mlx_sharding_tpu.ops.quant import is_quantized
-from mlx_sharding_tpu.parallel.mesh import AXIS_PP, AXIS_TP
+from mlx_sharding_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_TP
 from mlx_sharding_tpu.sample import (
     SamplerParams,
     init_recent_tokens,
@@ -191,6 +191,17 @@ class PipelineEngine:
                     f"tp={self.tp} must divide the {model.cache_num_heads()} "
                     "KV heads"
                 )
+        self.ep = mesh.shape.get(AXIS_EP, 1)
+        if self.ep > 1 and not model.ep_layer_axes():
+            raise ValueError(
+                f"expert parallelism is not wired for {type(model).__name__}"
+            )
+        # run_layers parallelism kwargs, shared by every step body
+        self._rl_kwargs = {}
+        if self.tp > 1:
+            self._rl_kwargs["tp_axis"] = AXIS_TP
+        if self.ep > 1:
+            self._rl_kwargs["ep_axis"] = AXIS_EP
 
         if stage_bounds is None:
             stage_bounds = balanced_stage_bounds(cfg.num_hidden_layers, S)
@@ -207,23 +218,38 @@ class PipelineEngine:
         )
         split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
 
-        if self.tp == 1:
+        # per-name shard axes: tp (heads/MLP columns) and ep (expert stacks)
+        axes_by_name: dict = {}
+        if self.tp > 1:
+            axes_by_name.update(
+                {n: (ax, AXIS_TP) for n, ax in tp_axes.items() if ax is not None}
+            )
+        if self.ep > 1:
+            axes_by_name.update(
+                {n: (ax, AXIS_EP) for n, ax in model.ep_layer_axes().items()}
+            )
+        if not axes_by_name:
             self.layer_specs = jax.tree.map(lambda _: P(AXIS_PP), split)
         else:
-            # homogeneous (llama-family) stacks only — guaranteed by the
-            # tp_axes guard above. (S, L, …) array → tp on the model-declared
-            # per-layer dim, offset by the two leading stack axes.
+            # homogeneous (single-group) stacks only — guaranteed by the
+            # guards above. (S, L, …) array → the model-declared per-layer
+            # dim shards over its mesh axis, offset by the two stack axes.
             def param_spec(name, w):
+                if name not in axes_by_name:
+                    return P(AXIS_PP)
                 if is_quantized(w):
                     raise ValueError(
-                        "tensor parallelism over packed 4-bit weights is not "
-                        "supported — load without keep_quantized"
+                        "tp/ep over packed 4-bit weights is not supported — "
+                        "load without keep_quantized"
                     )
-                ax = tp_axes.get(name)
-                if ax is None:
-                    return P(AXIS_PP)
+                ax, axis_name = axes_by_name[name]
+                if w.shape[2 + ax] % mesh.shape[axis_name]:
+                    raise ValueError(
+                        f"{name} dim {w.shape[2 + ax]} not divisible over "
+                        f"{axis_name}={mesh.shape[axis_name]}"
+                    )
                 dims = [AXIS_PP, None] + [None] * (w.ndim - 2)
-                dims[2 + ax] = AXIS_TP
+                dims[2 + ax] = axis_name
                 return P(*dims)
 
             self.layer_specs = {
@@ -334,7 +360,7 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     def _build_step(self, t_len: int, with_sampling: bool):
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
-        tp_axis = AXIS_TP if self.tp > 1 else None
+        rl_kwargs = self._rl_kwargs
 
         def body(layer_params, masks, vparts, shared, tokens, k, v, offsets, active, n_valid):
             # Per-device views: layer_params (1, L, …) → (L, …); k/v
@@ -372,7 +398,7 @@ class PipelineEngine:
                 v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
                 h_out, k_m, v_m = model.run_layers(
                     layer_params, h_in, k_m, v_m, offset, mask=masks,
-                    tp_axis=tp_axis,
+                    **rl_kwargs,
                 )
                 k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
                 v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
@@ -493,7 +519,7 @@ class PipelineEngine:
         slice ``slot`` at that slot's offset, last stage banks the
         last-valid-position logits."""
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
-        tp_axis = AXIS_TP if self.tp > 1 else None
+        rl_kwargs = self._rl_kwargs
         t_len = self.prefill_chunk
 
         def body(layer_params, masks, vparts, shared, tokens, slot, k, v, offsets, n_valid):
@@ -517,7 +543,7 @@ class PipelineEngine:
                 v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
                 h_out, k_m, v_m = model.run_layers(
                     layer_params, h_in, k_m, v_m, offset, mask=masks,
-                    tp_axis=tp_axis,
+                    **rl_kwargs,
                 )
                 k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
                 v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)

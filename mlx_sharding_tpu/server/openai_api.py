@@ -98,6 +98,7 @@ class ModelProvider:
         concurrent: int = 1,
         multihost: bool = False,
         tp: int = 1,
+        ep: int = 1,
         max_seq: int = 4096,
         prefill_chunk: int = 256,
         cache_dtype=None,
@@ -114,6 +115,7 @@ class ModelProvider:
         self.concurrent = max(1, concurrent)
         self.multihost = multihost
         self.tp = max(1, tp)
+        self.ep = max(1, ep)
         self.max_seq = max_seq
         self.prefill_chunk = prefill_chunk
         self.cache_dtype = cache_dtype
@@ -178,12 +180,12 @@ class ModelProvider:
                     len(self.stage_bounds) if self.stage_bounds
                     else (self.num_stages or 1)
                 )
-                if stages > 1 or self.concurrent > 1 or self.tp > 1:
+                if stages > 1 or self.concurrent > 1 or self.tp > 1 or self.ep > 1:
                     from mlx_sharding_tpu.parallel.mesh import make_mesh
                     from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
                     generator = PipelineEngine(
-                        model, params, make_mesh(pp=stages, tp=self.tp),
+                        model, params, make_mesh(pp=stages, tp=self.tp, ep=self.ep),
                         stage_bounds=self.stage_bounds,
                         microbatches=self.concurrent,
                         max_seq=self.max_seq, cache_dtype=cache_dtype,
@@ -677,6 +679,9 @@ def main(argv=None):
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel width within each pipeline "
                         "stage (Llama family)")
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel width within each pipeline "
+                        "stage (MoE models)")
     parser.add_argument("--concurrent", type=int, default=1,
                         help="continuous-batching slots: serve up to N "
                         "requests interleaved in one fused engine (N>1 "
@@ -700,8 +705,8 @@ def main(argv=None):
         parser.error("--engine chained requires --stage-bounds")
     if args.concurrent > 1 and args.engine == "chained":
         parser.error("--concurrent requires the fused engine")
-    if args.tp > 1 and args.engine == "chained" and args.stage_bounds:
-        parser.error("--tp requires the fused engine")
+    if (args.tp > 1 or args.ep > 1) and args.engine == "chained":
+        parser.error("--tp/--ep require the fused engine")
     if args.coordinator and (args.num_processes or 1) > 1:
         if args.concurrent > 1:
             parser.error("--concurrent is not yet supported with multi-host "
@@ -734,7 +739,7 @@ def main(argv=None):
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
         num_stages=args.num_stages, stage_bounds=stage_bounds,
         engine=args.engine, concurrent=args.concurrent, multihost=multihost,
-        tp=args.tp,
+        tp=args.tp, ep=args.ep,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         chat_template=chat_template,
     )

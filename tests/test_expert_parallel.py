@@ -33,3 +33,59 @@ def test_expert_parallel_rejects_uneven():
     wg = jnp.zeros((6, 8, 8))  # 6 experts over ep=4
     with pytest.raises(ValueError, match="not divisible"):
         expert_parallel_apply(x, w, idx, wg, wg, jnp.zeros((6, 8, 8)), mesh)
+
+
+def test_mixtral_fused_engine_with_ep():
+    """EP inside the MODEL FORWARD: Mixtral's expert stacks shard over the
+    ep mesh axis within the fused engine (each device computes its resident
+    experts for all tokens + one psum) — exact parity with single-device."""
+    import jax.numpy as jnp
+
+    from mlx_sharding_tpu.config import MixtralConfig
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.models.mixtral import MixtralModel
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+    )
+    model = MixtralModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(1), jnp.float32)
+    prompt = [5, 9, 2, 44]
+    ref = Generator(model, params, max_seq=32, cache_dtype=jnp.float32, prefill_chunk=8)
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=6)]
+
+    for pp, ep in ((2, 2), (1, 4)):
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=pp, ep=ep), max_seq=32,
+            cache_dtype=jnp.float32, prefill_chunk=8,
+        )
+        got = [t for t, _ in eng.generate_step(prompt, max_tokens=6)]
+        assert got == want, f"pp={pp} ep={ep} diverged"
+        wg = eng.layer_params["w_gate"]
+        assert wg.sharding.shard_shape(wg.shape)[2] == 4 // ep  # expert-sharded
+
+
+def test_ep_unsupported_arch_raises():
+    import jax.numpy as jnp
+
+    from mlx_sharding_tpu.config import LlamaConfig
+    from mlx_sharding_tpu.models.llama import LlamaModel
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+    model = LlamaModel(
+        LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        )
+    )
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    with pytest.raises(ValueError, match="expert parallelism"):
+        PipelineEngine(
+            model, params, make_mesh(pp=1, ep=2), max_seq=32,
+            cache_dtype=jnp.float32, prefill_chunk=8,
+        )
