@@ -98,6 +98,11 @@ class LlamaConfig(BaseConfig):
 
 
 @dataclass
+class Qwen3Config(LlamaConfig):
+    model_type: str = "qwen3"
+
+
+@dataclass
 class Gemma2Config(BaseConfig):
     """Gemma-2: softcapped logits/attention, tied embeddings, alternating
     sliding/global attention (ref: shard/server/model/gemma2.py)."""
@@ -169,6 +174,7 @@ MODEL_REMAPPING = {
 
 CONFIG_REGISTRY: dict[str, type] = {
     "llama": LlamaConfig,
+    "qwen3": Qwen3Config,
     "gemma2": Gemma2Config,
     "deepseek_v2": DeepseekV2Config,
     "mixtral": MixtralConfig,

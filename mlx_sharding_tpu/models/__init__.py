@@ -15,6 +15,7 @@ from mlx_sharding_tpu.config import config_from_dict, resolve_model_type
 # model_type -> (module, class). Keys must match config.CONFIG_REGISTRY.
 MODEL_REGISTRY: dict[str, tuple[str, str]] = {
     "llama": ("mlx_sharding_tpu.models.llama", "LlamaModel"),
+    "qwen3": ("mlx_sharding_tpu.models.qwen3", "Qwen3Model"),
     "gemma2": ("mlx_sharding_tpu.models.gemma2", "Gemma2Model"),
     "deepseek_v2": ("mlx_sharding_tpu.models.deepseek_v2", "DeepseekV2Model"),
     "mixtral": ("mlx_sharding_tpu.models.mixtral", "MixtralModel"),

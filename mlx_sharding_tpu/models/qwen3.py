@@ -1,0 +1,58 @@
+"""Qwen3 decoder — Llama structure with per-head Q/K RMSNorm.
+
+Beyond the reference's model set (it ships Llama/Gemma-2/DeepSeek-V2 and
+aliases Mistral, /root/reference/shard/utils.py:14-17); Qwen3 is the current
+generation of the Qwen2 family the reference serves through its Llama alias.
+Differences from Llama, per HF ``Qwen3Attention``:
+
+- RMSNorm over each head's query/key vector (weight shape (head_dim,)),
+  applied after the projection reshape and BEFORE RoPE;
+- no QKV biases (Qwen2 had them);
+- ``head_dim`` set explicitly in the config, decoupled from
+  hidden_size / num_heads.
+
+Everything else (SwiGLU MLP, GQA, tied-embedding option, stage placement,
+TP axes, packed-quant linear dispatch) is inherited from LlamaModel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.ops import apply_rope, rms_norm
+
+
+class Qwen3Model(LlamaModel):
+    HF_LAYER_MAP = {
+        **LlamaModel.HF_LAYER_MAP,
+        "self_attn.q_norm.weight": ("q_norm", False),
+        "self_attn.k_norm.weight": ("k_norm", False),
+    }
+
+    def layer_attn_inputs(self, p, h, offset):
+        cfg = self.config
+        b, t, _ = h.shape
+        d = cfg.head_dim
+
+        r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
+        q = self._linear(r, p["q_proj"]).reshape(b, t, -1, d)
+        k = self._linear(r, p["k_proj"]).reshape(b, t, -1, d)
+        v = self._linear(r, p["v_proj"]).reshape(b, t, -1, d)
+        # per-head q/k norm before RoPE (HF Qwen3Attention)
+        q = rms_norm(q, p["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, p["k_norm"], cfg.rms_norm_eps)
+        q = apply_rope(q, self.inv_freq, offset)
+        k = apply_rope(k, self.inv_freq, offset)
+        return q, k, v
+
+    def tp_layer_axes(self) -> dict:
+        # q/k norms are (head_dim,) — shared across heads, replicated over tp
+        return {**super().tp_layer_axes(), "q_norm": None, "k_norm": None}
+
+    def init_params(self, key, dtype=jnp.bfloat16):
+        params = super().init_params(key, dtype)
+        nl, d = self.config.num_local_layers, self.config.head_dim
+        params["layers"]["q_norm"] = jnp.ones((nl, d), dtype)
+        params["layers"]["k_norm"] = jnp.ones((nl, d), dtype)
+        return params
