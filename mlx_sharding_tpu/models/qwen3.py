@@ -36,9 +36,16 @@ class Qwen3Model(LlamaModel):
         d = cfg.head_dim
 
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
-        q = self._linear(r, p["q_proj"]).reshape(b, t, -1, d)
-        k = self._linear(r, p["k_proj"]).reshape(b, t, -1, d)
-        v = self._linear(r, p["v_proj"]).reshape(b, t, -1, d)
+        q = self._linear(r, p["q_proj"])
+        k = self._linear(r, p["k_proj"])
+        v = self._linear(r, p["v_proj"])
+        if cfg.attention_bias:  # supported by HF Qwen3Config
+            q = q + p["q_bias"]
+            k = k + p["k_bias"]
+            v = v + p["v_bias"]
+        q = q.reshape(b, t, -1, d)
+        k = k.reshape(b, t, -1, d)
+        v = v.reshape(b, t, -1, d)
         # per-head q/k norm before RoPE (HF Qwen3Attention)
         q = rms_norm(q, p["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, p["k_norm"], cfg.rms_norm_eps)

@@ -24,6 +24,7 @@ def llama_param_specs(tp: str | None = "tp", layers: str | None = None) -> dict:
     col = P(layers, None, tp)  # (L, in, out) — split output dim
     row = P(layers, tp, None)  # (L, in, out) — split contracting dim
     norm = P(layers, None)
+    bias = P(layers, tp)  # (L, out) — follows its column-split projection
     return {
         "layers": {
             "input_norm": norm,
@@ -35,6 +36,13 @@ def llama_param_specs(tp: str | None = "tp", layers: str | None = None) -> dict:
             "gate_proj": col,
             "up_proj": col,
             "down_proj": row,
+            # Qwen2-style QKV biases and Qwen3 per-head q/k norms — present
+            # only for those variants; prune_specs drops unused entries
+            "q_bias": bias,
+            "k_bias": bias,
+            "v_bias": bias,
+            "q_norm": norm,
+            "k_norm": norm,
         },
         "embed": {"weight": P(None, None)},
         "final_norm": {"weight": P(None)},

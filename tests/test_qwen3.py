@@ -93,3 +93,41 @@ def test_fused_pipeline_and_tp(hf_checkpoint):
     )
     got = [t for t, _ in eng.generate_step(prompt, max_tokens=8)]
     assert got == want
+
+
+def test_attention_bias_variant(tmp_path):
+    """Qwen3 fine-tunes may ship attention_bias=true — biases must be
+    APPLIED, not just loaded."""
+    torch.manual_seed(5)
+    cfg = transformers.Qwen3Config(**{**TINY, "attention_bias": True})
+    hf = transformers.Qwen3ForCausalLM(cfg)
+    # make the biases material so an unapplied-bias bug changes logits
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(std=0.5)
+    hf.eval()
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    tokens = [[4, 9, 2, 91]]
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    model, params = load_model(str(tmp_path), dtype=jnp.float32)
+    assert "q_bias" in params["layers"]
+    got, _ = model(
+        params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 8, jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-3, atol=3e-3)
+
+
+def test_qwen3_training_specs():
+    """llama_param_specs must cover the q/k norm params for the GSPMD
+    training path (prune_specs would KeyError otherwise)."""
+    from mlx_sharding_tpu.config import Qwen3Config
+    from mlx_sharding_tpu.models.qwen3 import Qwen3Model
+    from mlx_sharding_tpu.parallel.tp import llama_param_specs, prune_specs
+
+    model = Qwen3Model(Qwen3Config(**{**TINY, "model_type": "qwen3"}))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    specs = prune_specs(llama_param_specs(), params)
+    assert "q_norm" in specs["layers"] and "k_norm" in specs["layers"]
