@@ -88,6 +88,11 @@ class DeepseekV2Model(BaseModel):
             out["moe"] = (fk, cfg.num_hidden_layers)
         return out
 
+    def ep_layer_axes(self) -> dict:
+        """Nested (per-group) map: only the moe group's routed expert
+        stacks shard over ep; shared experts/router/attention replicate."""
+        return {"moe": {"w_gate": 0, "w_up": 0, "w_down": 0}}
+
     # ------------------------------------------------------------------
     def _attention(self, h, p, k_buf, v_buf, offset):
         cfg = self.config
@@ -156,12 +161,14 @@ class DeepseekV2Model(BaseModel):
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
         return h + self._swiglu(r, p["gate_proj"], p["up_proj"], p["down_proj"]), k_buf, v_buf
 
-    def _moe_layer(self, h, p, k_buf, v_buf, offset):
+    def _moe_layer(self, h, p, k_buf, v_buf, offset, ep_axis=None):
         cfg = self.config
         b, t, hidden = h.shape
         h, k_buf, v_buf = self._attention(h, p, k_buf, v_buf, offset)
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
         flat = r.reshape(b * t, hidden)
+        # routing is replicated over ep (router weights replicated, global
+        # expert ids); only the expert stacks shard
         weights, idx = deepseek_routing(
             flat, p["router"], cfg.num_experts_per_tok,
             norm_topk_prob=cfg.norm_topk_prob,
@@ -170,7 +177,12 @@ class DeepseekV2Model(BaseModel):
             n_group=cfg.n_group,
             topk_group=cfg.topk_group,
         )
-        routed = apply_experts(flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"])
+        routed = apply_experts(
+            flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"],
+            ep_axis=ep_axis,
+        )
+        # shared experts are always-on and replicated across ep — their
+        # contribution must NOT enter the ep psum
         shared = self._swiglu(
             flat, p["shared_gate"], p["shared_up"], p["shared_down"]
         )
@@ -185,7 +197,10 @@ class DeepseekV2Model(BaseModel):
         )
         return n_dense, cfg.num_local_layers - n_dense
 
-    def run_layers(self, layer_params, h, k, v, offset, mask=None, tp_axis=None):
+    def run_layers(
+        self, layer_params, h, k, v, offset, mask=None, tp_axis=None,
+        ep_axis=None,
+    ):
         """Two scans (dense prefix, MoE suffix) over structurally distinct
         param stacks. The group sizes come from the param stacks themselves
         (not the config bounds), so the fused engine's padded uniform stacks
@@ -213,7 +228,9 @@ class DeepseekV2Model(BaseModel):
             vs.append(vd)
         if "moe" in layer_params:
             h, km, vm = scan_layers(
-                lambda h, p, kb, vb: self._moe_layer(h, p, kb, vb, offset),
+                lambda h, p, kb, vb: self._moe_layer(
+                    h, p, kb, vb, offset, ep_axis=ep_axis
+                ),
                 h, layer_params["moe"], k[n_dense:], v[n_dense:],
                 None if mask is None else mask["moe"],
             )

@@ -218,43 +218,61 @@ class PipelineEngine:
         )
         split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
 
-        # per-name shard axes: tp (heads/MLP columns) and ep (expert stacks)
+        # Per-name shard axes: tp (heads/MLP columns) and ep (expert stacks).
+        # Models declare flat maps (homogeneous stacks) or nested
+        # {group: {name: dim}} maps (DeepSeek's moe group). Values are
+        # (per-layer dim, mesh axis name).
+        def _merge(out, axes_map, axis_name):
+            for n, ax in axes_map.items():
+                if isinstance(ax, dict):
+                    out.setdefault(n, {})
+                    _merge(out[n], ax, axis_name)
+                elif ax is not None:
+                    out[n] = (ax, axis_name)
+
         axes_by_name: dict = {}
         if self.tp > 1:
-            axes_by_name.update(
-                {n: (ax, AXIS_TP) for n, ax in tp_axes.items() if ax is not None}
-            )
+            _merge(axes_by_name, tp_axes, AXIS_TP)
         if self.ep > 1:
-            axes_by_name.update(
-                {n: (ax, AXIS_EP) for n, ax in model.ep_layer_axes().items()}
-            )
+            _merge(axes_by_name, model.ep_layer_axes(), AXIS_EP)
+
+        def param_spec(entry, name, w):
+            # (S, L, …) array → the model-declared per-layer dim shards over
+            # its mesh axis, offset by the two leading stack axes
+            if entry is None:
+                return P(AXIS_PP)
+            if is_quantized(w):
+                raise ValueError(
+                    "tp/ep over packed 4-bit weights is not supported — "
+                    "load without keep_quantized"
+                )
+            ax, axis_name = entry
+            if w.shape[2 + ax] % mesh.shape[axis_name]:
+                raise ValueError(
+                    f"{name} dim {w.shape[2 + ax]} not divisible over "
+                    f"{axis_name}={mesh.shape[axis_name]}"
+                )
+            dims = [AXIS_PP, None] + [None] * (w.ndim - 2)
+            dims[2 + ax] = axis_name
+            return P(*dims)
+
+        def build_specs(stack, axes):
+            out = {}
+            for name, w in stack.items():
+                entry = axes.get(name)
+                if isinstance(w, dict) and not is_quantized(w):
+                    out[name] = build_specs(w, entry or {})
+                elif is_quantized(w):
+                    spec = param_spec(entry, name, w)
+                    out[name] = jax.tree.map(lambda _: spec, w)
+                else:
+                    out[name] = param_spec(entry, name, w)
+            return out
+
         if not axes_by_name:
             self.layer_specs = jax.tree.map(lambda _: P(AXIS_PP), split)
         else:
-            # homogeneous (single-group) stacks only — guaranteed by the
-            # guards above. (S, L, …) array → the model-declared per-layer
-            # dim shards over its mesh axis, offset by the two stack axes.
-            def param_spec(name, w):
-                if name not in axes_by_name:
-                    return P(AXIS_PP)
-                if is_quantized(w):
-                    raise ValueError(
-                        "tp/ep over packed 4-bit weights is not supported — "
-                        "load without keep_quantized"
-                    )
-                ax, axis_name = axes_by_name[name]
-                if w.shape[2 + ax] % mesh.shape[axis_name]:
-                    raise ValueError(
-                        f"{name} dim {w.shape[2 + ax]} not divisible over "
-                        f"{axis_name}={mesh.shape[axis_name]}"
-                    )
-                dims = [AXIS_PP, None] + [None] * (w.ndim - 2)
-                dims[2 + ax] = axis_name
-                return P(*dims)
-
-            self.layer_specs = {
-                name: param_spec(name, w) for name, w in split.items()
-            }
+            self.layer_specs = build_specs(split, axes_by_name)
         self.layer_params = jax.device_put(
             split,
             jax.tree.map(

@@ -89,3 +89,43 @@ def test_ep_unsupported_arch_raises():
             model, params, make_mesh(pp=1, ep=2), max_seq=32,
             cache_dtype=jnp.float32, prefill_chunk=8,
         )
+
+
+def test_deepseek_fused_engine_with_ep():
+    """DeepSeek grouped stacks: only the moe group's routed experts shard
+    over ep (nested ep_layer_axes); shared experts/router/attention
+    replicate. Exact parity incl. an uneven dense/moe split."""
+    import jax.numpy as jnp
+
+    from mlx_sharding_tpu.config import DeepseekV2Config
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.models.deepseek_v2 import DeepseekV2Model
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+    cfg = DeepseekV2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=16, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=16,
+        q_lora_rank=None, qk_rope_head_dim=8, qk_nope_head_dim=16,
+        v_head_dim=12, n_routed_experts=4, n_shared_experts=1,
+        num_experts_per_tok=2, first_k_dense_replace=1,
+    )
+    model = DeepseekV2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(3), jnp.float32)
+    prompt = [7, 3, 99, 12]
+    ref = Generator(model, params, max_seq=32, cache_dtype=jnp.float32, prefill_chunk=8)
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=6)]
+
+    for pp, ep, bounds in ((2, 2, None), (1, 4, None), (2, 2, [(0, 3), (3, 4)])):
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=pp, ep=ep), stage_bounds=bounds,
+            max_seq=32, cache_dtype=jnp.float32, prefill_chunk=8,
+        )
+        got = [t for t, _ in eng.generate_step(prompt, max_tokens=6)]
+        assert got == want, f"pp={pp} ep={ep} bounds={bounds} diverged"
+        wg = eng.layer_params["moe"]["w_gate"]
+        assert wg.sharding.shard_shape(wg.shape)[2] == 4 // ep
+        # shared experts: stage-sharded (pp) but fully replicated across ep
+        sg = eng.layer_params["moe"]["shared_gate"]
+        assert sg.sharding.shard_shape(sg.shape) == (1, *sg.shape[1:])
