@@ -36,19 +36,21 @@ class Gemma2Model(BaseModel):
         self.scale = config.query_pre_attn_scalar**-0.5
 
     # ------------------------------------------------------------------
-    def _layer(self, h, p, k_buf, v_buf, offset, layer_idx):
+    def _layer(self, h, p, k_buf, v_buf, offset, layer_idx, tp_axis=None):
         cfg = self.config
         b, t, _ = h.shape
-        hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        d = cfg.head_dim
         eps = cfg.rms_norm_eps
 
         # sliding window on even layers, global on odd (HF Gemma-2 layout)
         window = jnp.where(layer_idx % 2 == 0, cfg.sliding_window, _GLOBAL_WINDOW)
 
+        # head counts derive from the projection shards, so the same code
+        # runs the full model and any tp slice (heads split over tp)
         r = rms_norm(h, p["input_norm"], eps, offset=1.0)
-        q = (r @ p["q_proj"]).reshape(b, t, hq, d)
-        k = (r @ p["k_proj"]).reshape(b, t, hkv, d)
-        v = (r @ p["v_proj"]).reshape(b, t, hkv, d)
+        q = (r @ p["q_proj"]).reshape(b, t, -1, d)
+        k = (r @ p["k_proj"]).reshape(b, t, -1, d)
+        v = (r @ p["v_proj"]).reshape(b, t, -1, d)
         q = apply_rope(q, self.inv_freq, offset)
         k = apply_rope(k, self.inv_freq, offset)
         k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
@@ -58,20 +60,22 @@ class Gemma2Model(BaseModel):
             sliding_window=window,
         )
         attn_out = attn.reshape(b, t, -1) @ p["o_proj"]
+        if tp_axis is not None:
+            # the post-attention norm is NONLINEAR: partial row-parallel
+            # products must be summed BEFORE it, unlike Llama's plain residual
+            attn_out = jax.lax.psum(attn_out, tp_axis)
         h = h + rms_norm(attn_out, p["post_attn_norm"], eps, offset=1.0)
 
         r = rms_norm(h, p["pre_ffw_norm"], eps, offset=1.0)
         ff = (
             jax.nn.gelu(r @ p["gate_proj"], approximate=True) * (r @ p["up_proj"])
         ) @ p["down_proj"]
+        if tp_axis is not None:
+            ff = jax.lax.psum(ff, tp_axis)
         h = h + rms_norm(ff, p["post_ffw_norm"], eps, offset=1.0)
         return h, k_buf, v_buf
 
     def run_layers(self, layer_params, h, k, v, offset, mask=None, tp_axis=None):
-        if tp_axis is not None:
-            raise NotImplementedError(
-                f"tensor parallelism is not wired for {type(self).__name__}"
-            )
         # The GLOBAL layer index travels inside the param stack
         # ("layer_idx", added by map_weights/init_params): window alternation
         # follows it, so arbitrary stage slices — including the fused SPMD
@@ -80,9 +84,17 @@ class Gemma2Model(BaseModel):
         from mlx_sharding_tpu.models.base import scan_layers
 
         def body(h, p, k_buf, v_buf):
-            return self._layer(h, p, k_buf, v_buf, offset, p["layer_idx"])
+            return self._layer(h, p, k_buf, v_buf, offset, p["layer_idx"], tp_axis)
 
         return scan_layers(body, h, layer_params, k, v, mask)
+
+    def tp_layer_axes(self) -> dict:
+        return {
+            "input_norm": None, "post_attn_norm": None, "pre_ffw_norm": None,
+            "post_ffw_norm": None, "layer_idx": None,
+            "q_proj": 1, "k_proj": 1, "v_proj": 1, "o_proj": 0,
+            "gate_proj": 1, "up_proj": 1, "down_proj": 0,
+        }
 
     def embed_transform(self, h):
         # embedding scaled by sqrt(hidden) (ref gemma2.py:42-43)

@@ -110,3 +110,29 @@ def test_tp_unsupported_arch_raises():
             model, params, make_mesh(pp=1, tp=2), max_seq=32,
             cache_dtype=jnp.float32, prefill_chunk=8,
         )
+
+
+def test_gemma2_pp2_tp2_matches_single_device():
+    """Gemma-2 TP: the post-attention/post-ffw norms are nonlinear, so the
+    row-parallel partial products must psum BEFORE them — exact parity
+    proves the placement (and the alternating window survives head
+    sharding)."""
+    from mlx_sharding_tpu.config import Gemma2Config
+    from mlx_sharding_tpu.models.gemma2 import Gemma2Model
+
+    cfg = Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, sliding_window=4, query_pre_attn_scalar=8,
+    )
+    model = Gemma2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    prompt = list(range(2, 12))  # > sliding_window so the window matters
+    ref = Generator(model, params, max_seq=32, cache_dtype=jnp.float32, prefill_chunk=16)
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=6)]
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=2, tp=2), max_seq=32,
+        cache_dtype=jnp.float32, prefill_chunk=16,
+    )
+    got = [t for t, _ in eng.generate_step(prompt, max_tokens=6)]
+    assert got == want
