@@ -154,6 +154,15 @@ class ContinuousBatcher:
         finally:
             req.cancelled = True  # scheduler reclaims the slot next tick
 
+    def stats(self) -> tuple[int, int, int]:
+        """(total slots, active slots, queued requests) — the /metrics
+        contract, kept here so scheduler internals can change freely."""
+        return (
+            self.M,
+            sum(1 for r in self._slots if r is not None),
+            self._submit.qsize(),
+        )
+
     def close(self):
         self._stop = True
         if self._thread is not None:

@@ -651,7 +651,11 @@ def make_server(
         {
             "provider": provider,
             "gen_lock": threading.Lock(),
-            "metrics": ServingMetrics(),
+            "metrics": ServingMetrics(
+                batcher_fn=lambda: provider.generator
+                if getattr(provider.generator, "concurrent", False)
+                else None
+            ),
             "profile_dir": profile_dir,
         },
     )

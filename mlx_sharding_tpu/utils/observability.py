@@ -66,6 +66,9 @@ class ServingMetrics:
     generation_tokens_total: int = 0
     ttft_s: _Reservoir = field(default_factory=_Reservoir)
     decode_tps: _Reservoir = field(default_factory=_Reservoir)
+    # zero-arg callable returning the live ContinuousBatcher (or None) —
+    # a callable so model hot-swaps can never leave a stale reference
+    batcher_fn: object = None
 
     def record_request(
         self,
@@ -111,4 +114,15 @@ class ServingMetrics:
                 f'mst_decode_tokens_per_second{{quantile="0.5"}} {self.decode_tps.percentile(50):.3f}',
                 f'mst_decode_tokens_per_second{{quantile="0.95"}} {self.decode_tps.percentile(95):.3f}',
             ]
+            b = self.batcher_fn() if self.batcher_fn is not None else None
+            if b is not None:
+                slots, active, queued = b.stats()
+                lines += [
+                    "# TYPE mst_batch_slots gauge",
+                    f"mst_batch_slots {slots}",
+                    "# TYPE mst_batch_slots_active gauge",
+                    f"mst_batch_slots_active {active}",
+                    "# TYPE mst_batch_queue_depth gauge",
+                    f"mst_batch_queue_depth {queued}",
+                ]
         return "\n".join(lines) + "\n"

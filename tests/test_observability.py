@@ -75,3 +75,21 @@ def test_metrics_endpoint(tmp_path):
         conn.close()
     finally:
         srv.shutdown()
+
+
+def test_metrics_expose_batcher_slots():
+    """/metrics reports slot occupancy and queue depth when the server runs
+    a ContinuousBatcher (stats() contract; the real batcher integration is
+    covered by the scheduler/server suites)."""
+    from mlx_sharding_tpu.utils.observability import ServingMetrics
+
+    class _FakeBatcher:
+        def stats(self):
+            return (2, 1, 3)
+
+    text = ServingMetrics(batcher_fn=lambda: _FakeBatcher()).render()
+    assert "mst_batch_slots 2" in text
+    assert "mst_batch_slots_active 1" in text
+    assert "mst_batch_queue_depth 3" in text
+    # and none of it when no batcher is live
+    assert "mst_batch_slots" not in ServingMetrics().render()
