@@ -142,12 +142,11 @@ def test_keep_quantized_end_to_end(tmp_path):
     assert got == want
 
 
-def test_keep_quantized_fused_pipeline(tmp_path):
-    """Packed params ride the fused SPMD engine (tree-aware stage split)."""
+def _packed_ref(tmp_path):
+    """Shared recipe: quantized checkpoint + packed load + reference tokens
+    for the canonical prompt."""
     from mlx_sharding_tpu.generate import Generator
     from mlx_sharding_tpu.loading import load_model
-    from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
-    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
     path = _quantized_tiny_llama(tmp_path)
     model, params = load_model(str(path), dtype=jnp.float32, keep_quantized=True)
@@ -155,6 +154,15 @@ def test_keep_quantized_fused_pipeline(tmp_path):
         model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
     )
     want = [t for t, _ in ref.generate_step([5, 9, 2], max_tokens=8)]
+    return path, model, params, want
+
+
+def test_keep_quantized_fused_pipeline(tmp_path):
+    """Packed params ride the fused SPMD engine (tree-aware stage split)."""
+    from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+    path, model, params, want = _packed_ref(tmp_path)
     eng = PipelineEngine(
         model, params, pipeline_mesh(2), max_seq=64,
         cache_dtype=jnp.float32, prefill_chunk=8,
@@ -177,3 +185,18 @@ def test_keep_quantized_unsupported_arch(tmp_path):
     m.save_pretrained(tmp_path, safe_serialization=True)
     with pytest.raises(ValueError, match="keep_quantized"):
         load_model(str(tmp_path), dtype=jnp.float32, keep_quantized=True)
+
+
+def test_keep_quantized_chained_pipeline(tmp_path):
+    """--engine chained with --keep-quantized: every stage loads packed."""
+    from mlx_sharding_tpu.parallel.chained import load_chained_pipeline
+
+    path, _, _, want = _packed_ref(tmp_path)
+    chain = load_chained_pipeline(
+        str(path), [(0, 1), (1, 2)], dtype=jnp.float32, keep_quantized=True,
+        max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    for stage_params in chain.params:  # EVERY stage, not just stage 0
+        assert is_quantized(stage_params["layers"]["q_proj"])
+    got = [t for t, _ in chain.generate_step([5, 9, 2], max_tokens=8)]
+    assert got == want
