@@ -236,17 +236,7 @@ class PipelineEngine:
         if self.ep > 1:
             _merge(axes_by_name, model.ep_layer_axes(), AXIS_EP)
 
-        def param_spec(entry, name, w):
-            # (S, L, …) array → the model-declared per-layer dim shards over
-            # its mesh axis, offset by the two leading stack axes
-            if entry is None:
-                return P(AXIS_PP)
-            if is_quantized(w):
-                raise ValueError(
-                    "tp/ep over packed 4-bit weights is not supported — "
-                    "load without keep_quantized"
-                )
-            ax, axis_name = entry
+        def _check_div(name, w, ax, axis_name):
             if w.shape[2 + ax] % mesh.shape[axis_name]:
                 raise ValueError(
                     f"{name} dim {w.shape[2 + ax]} not divisible over "
@@ -256,6 +246,41 @@ class PipelineEngine:
             dims[2 + ax] = axis_name
             return P(*dims)
 
+        def param_spec(entry, name, w):
+            # (S, L, …) array → the model-declared per-layer dim shards over
+            # its mesh axis, offset by the two leading stack axes
+            if entry is None:
+                return P(AXIS_PP)
+            ax, axis_name = entry
+            return _check_div(name, w, ax, axis_name)
+
+        def quant_spec(entry, name, w):
+            """Packed triples under TP. The model declares axes in the DENSE
+            (in, out) orientation, but packed leaves live in MLX's (out, X)
+            orientation — q (out, in/8), scales/biases (out, in/group) — so
+            the tp dim flips: column-parallel (dense ax 1) shards dim 0 of
+            every leaf, row-parallel (dense ax 0) shards dim 1. Per-leaf
+            divisibility checks double as nibble-word and quant-group
+            alignment guards (scales' in/group dim dividing tp ⇔ the in
+            split lands on group boundaries)."""
+            if entry is None:
+                spec = P(AXIS_PP)
+                return jax.tree.map(lambda _: spec, w)
+            ax, axis_name = entry
+            if axis_name != AXIS_TP or any(a.ndim != 4 for a in w.values()):
+                # the orientation flip is only meaningful for 2-D TP
+                # projections; ep-sharded (expert-stack) packed weights would
+                # shard the wrong dim silently — keep the old loud failure
+                raise ValueError(
+                    f"{axis_name} over packed 4-bit weights is not supported "
+                    f"for {name} — load without keep_quantized"
+                )
+            axq = 1 - ax
+            return {
+                leaf: _check_div(f"{name}.{leaf}", arr, axq, axis_name)
+                for leaf, arr in w.items()
+            }
+
         def build_specs(stack, axes):
             out = {}
             for name, w in stack.items():
@@ -263,8 +288,7 @@ class PipelineEngine:
                 if isinstance(w, dict) and not is_quantized(w):
                     out[name] = build_specs(w, entry or {})
                 elif is_quantized(w):
-                    spec = param_spec(entry, name, w)
-                    out[name] = jax.tree.map(lambda _: spec, w)
+                    out[name] = quant_spec(entry, name, w)
                 else:
                     out[name] = param_spec(entry, name, w)
             return out

@@ -65,7 +65,7 @@ def test_linear_dispatch_packed_vs_dense():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
-def _quantized_tiny_llama(tmp_path: Path):
+def _quantized_tiny_llama(tmp_path: Path, group_size: int = 64):
     """Write a tiny llama checkpoint whose decoder projections are MLX-style
     4-bit triples (config.quantization present)."""
     from safetensors.numpy import save_file
@@ -75,7 +75,7 @@ def _quantized_tiny_llama(tmp_path: Path):
         intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
         num_key_value_heads=2, max_position_embeddings=256,
         rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False,
-        quantization={"group_size": 64, "bits": 4},
+        quantization={"group_size": group_size, "bits": 4},
     )
     rng = np.random.default_rng(7)
     tensors = {}
@@ -85,7 +85,7 @@ def _quantized_tiny_llama(tmp_path: Path):
 
     def quant(name, out_d, in_d):
         w = (rng.normal(size=(out_d, in_d)) * 0.05).astype(np.float32)
-        q, s, b = quantize(w, group_size=64, bits=4)
+        q, s, b = quantize(w, group_size=group_size, bits=4)
         tensors[name] = q
         tensors[name.replace(".weight", ".scales")] = s
         tensors[name.replace(".weight", ".biases")] = b
@@ -200,3 +200,52 @@ def test_keep_quantized_chained_pipeline(tmp_path):
         assert is_quantized(stage_params["layers"]["q_proj"])
     got = [t for t, _ in chain.generate_step([5, 9, 2], max_tokens=8)]
     assert got == want
+
+
+def test_keep_quantized_with_tensor_parallelism(tmp_path):
+    """TP over packed 4-bit weights: column-parallel shards dim 0 of the
+    (out, in/8) packed layout, row-parallel shards the packed in dim — the
+    per-leaf divisibility checks guarantee nibble-word and quant-group
+    alignment. Exact token parity at pp1xtp2 and pp2xtp2.
+
+    group_size=32 so the row-parallel in-split (64/2=32) lands on a group
+    boundary; gs=64 is the rejection test below."""
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.loading import load_model
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+    path = _quantized_tiny_llama(tmp_path, group_size=32)
+    model, params = load_model(str(path), dtype=jnp.float32, keep_quantized=True)
+    ref = Generator(model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8)
+    want = [t for t, _ in ref.generate_step([5, 9, 2], max_tokens=8)]
+
+    for pp, tp in ((1, 2), (2, 2)):
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=pp, tp=tp), max_seq=64,
+            cache_dtype=jnp.float32, prefill_chunk=8,
+        )
+        got = [t for t, _ in eng.generate_step([5, 9, 2], max_tokens=8)]
+        assert got == want, f"pp={pp} tp={tp} diverged"
+        # column-parallel q_proj: packed dim 0 (out) sharded
+        qp = eng.layer_params["q_proj"]["q"]
+        assert qp.sharding.shard_shape(qp.shape)[2] == qp.shape[2] // tp
+        # row-parallel o_proj: packed dim 1 (in/8) sharded
+        op = eng.layer_params["o_proj"]["q"]
+        assert op.sharding.shard_shape(op.shape)[3] == op.shape[3] // tp
+
+
+def test_keep_quantized_tp_group_misalignment_rejected(tmp_path):
+    """gs=64 with in=64 and tp=2 would split a quant group in half — the
+    scales divisibility check must reject it loudly."""
+    from mlx_sharding_tpu.loading import load_model
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+
+    path = _quantized_tiny_llama(tmp_path)  # gs=64, o_proj in=64
+    model, params = load_model(str(path), dtype=jnp.float32, keep_quantized=True)
+    with pytest.raises(ValueError, match="not divisible"):
+        PipelineEngine(
+            model, params, make_mesh(pp=1, tp=2), max_seq=64,
+            cache_dtype=jnp.float32, prefill_chunk=8,
+        )
