@@ -85,6 +85,7 @@ def dequantize_weights(
     quantization: dict,
     dtype=jnp.bfloat16,
     keep_packed_layers: bool = False,
+    keep_dense_re: str | None = None,
 ) -> dict[str, jnp.ndarray]:
     """Process every MLX ``{weight, scales, biases}`` triple. Default:
     collapse to a dense weight — mirrors the predicate the reference feeds
@@ -92,16 +93,24 @@ def dequantize_weights(
     (shard/utils.py:58-63). With ``keep_packed_layers``, decoder-layer
     projections stay packed as ``{q, scales, biases}`` dicts (scales/biases
     promoted to f32) for the fused dequant-matmul path; embed/head/norms are
-    still dequantized so every engine's embed/vocab machinery is unaffected."""
+    still dequantized so every engine's embed/vocab machinery is unaffected.
+    ``keep_dense_re`` (model.packed_keep_dense_re) names layer weights that
+    are consumed as tensors, not matmul operands — those dequantize even in
+    packed mode (MoE routers, MLA kv_b under the compressed cache)."""
     group_size = int(quantization.get("group_size", 64))
     bits = int(quantization.get("bits", 4))
+    dense_re = re.compile(keep_dense_re) if keep_dense_re else None
     out: dict = {}
     for name, value in weights.items():
         base, _, leaf = name.rpartition(".")
         if leaf in ("scales", "biases"):
             continue  # consumed alongside their .weight
         if leaf == "weight" and f"{base}.scales" in weights:
-            if keep_packed_layers and LAYER_RE.search(name):
+            if (
+                keep_packed_layers
+                and LAYER_RE.search(name)
+                and not (dense_re and dense_re.search(name))
+            ):
                 # scales/biases stay in the checkpoint dtype (fp16 for
                 # published 4-bit checkpoints) — both matmul paths cast to
                 # f32 on the fly, and f32 residency would add ~11% to the
@@ -184,6 +193,7 @@ def load_model(
         weights = dequantize_weights(
             weights, config.quantization, dtype,
             keep_packed_layers=keep_quantized,
+            keep_dense_re=model.packed_keep_dense_re(),
         )
     weights = filter_stage_weights(weights, config)
     params = model.map_weights(weights, dtype)
@@ -194,6 +204,26 @@ def load_model(
 # Helpers for the per-model weight mappers
 
 
+def fetch_weight(weights: dict, key: str, dtype, transpose: bool = True):
+    """One checkpoint tensor, packed-or-dense: a packed ``{q, scales,
+    biases}`` triple passes through untouched (it keeps MLX's (out, in)
+    orientation — the fused dequant-matmul contracts against it); a dense
+    array is cast and, for projections, transposed to (in, out) for
+    ``x @ W``. The single fetch convention for every model's weight mapper."""
+    w = weights[key]
+    if isinstance(w, dict):
+        return w
+    w = jnp.asarray(w, dtype)
+    return w.T if transpose else w
+
+
+def stack_tree(items: list):
+    """Stack a list of same-structure packed-or-dense entries on a new
+    leading axis: a plain array is a single-leaf tree, a packed triple
+    stacks per leaf into {q: (N, …), scales: (N, …), biases: (N, …)}."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *items)
+
+
 def collect_layer_stack(
     weights: dict[str, jnp.ndarray],
     config,
@@ -202,30 +232,15 @@ def collect_layer_stack(
 ) -> dict[str, jnp.ndarray]:
     """{hf_suffix → (our_name, transpose)} applied across the stage's layer
     range and stacked on a leading axis (global HF indices
-    start_layer..end_layer map to stack rows 0..L). Projection weights arrive
-    (out, in) and are transposed to (in, out) for ``x @ W``."""
+    start_layer..end_layer map to stack rows 0..L)."""
     stacked: dict[str, list] = {our: [] for our, _ in per_layer_names.values()}
     for i in range(config.start_layer, config.end_layer):
         for hf_suffix, (our_name, transpose) in per_layer_names.items():
             key = f"model.layers.{i}.{hf_suffix}"
             if key not in weights:
                 key = f"layers.{i}.{hf_suffix}"
-            w = weights[key]
-            if isinstance(w, dict):
-                # packed {q, scales, biases} triple: keep MLX's (out, in)
-                # orientation — the fused dequant-matmul contracts against it
-                stacked[our_name].append(w)
-                continue
-            w = jnp.asarray(w, dtype)
-            if transpose:
-                w = w.T
-            stacked[our_name].append(w)
-    # tree-map stack: a plain array is a single-leaf tree, a packed triple
-    # stacks per leaf into {q: (L, …), scales: (L, …), biases: (L, …)}
-    return {
-        k: jax.tree.map(lambda *xs: jnp.stack(xs), *v)
-        for k, v in stacked.items()
-    }
+            stacked[our_name].append(fetch_weight(weights, key, dtype, transpose))
+    return {k: stack_tree(v) for k, v in stacked.items()}
 
 
 def first_key(weights: dict, *candidates: str):

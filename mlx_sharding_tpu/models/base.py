@@ -78,8 +78,30 @@ class BaseModel:
     stage models (shard/server/model/llama.py:39-62).
     """
 
+    #: decoder-layer projections may stay 4-bit packed in HBM
+    #: (loading.load_model(keep_quantized=True) → ops.quant.linear dispatch)
+    supports_packed = False
+
     def __init__(self, config):
         self.config = config
+        q = getattr(config, "quantization", None) or {}
+        self._gs = int(q.get("group_size", 64))
+        self._bits = int(q.get("bits", 4))
+
+    def _linear(self, x, w):
+        """``x @ w`` that transparently serves packed 4-bit params
+        (ops.quant.linear dispatch); dense arrays go straight to the MXU."""
+        from mlx_sharding_tpu.ops.quant import linear
+
+        return linear(x, w, self._gs, self._bits)
+
+    def packed_keep_dense_re(self) -> str | None:
+        """Regex over HF weight names that must stay DENSE under
+        ``keep_quantized`` (their triples are dequantized on load). Used for
+        weights consumed as tensors rather than matmul operands — e.g. MoE
+        routers feeding the fp32 routing einsum, or MLA's kv_b when the
+        compressed-latent cache absorbs it into einsums."""
+        return None
 
     # -- cache ------------------------------------------------------------
     def make_cache(self, batch: int, max_seq: int, dtype=jnp.bfloat16) -> KVCache:
@@ -100,6 +122,14 @@ class BaseModel:
         from plain GQA (e.g. MLA's single compressed-latent head) override
         this — engines must use it instead of config.num_key_value_heads."""
         return self.config.num_key_value_heads
+
+    def cache_tp_replicated(self) -> bool:
+        """True when the KV cache is head-count INDEPENDENT and must
+        replicate over tp rather than head-shard (MLA's shared compressed
+        latent). A genuine MQA model (num_key_value_heads == 1) is NOT
+        that — its single head cannot be split, so tp > 1 must still be
+        rejected by the divisibility check."""
+        return False
 
     def tp_layer_axes(self) -> dict:
         """{layer_param_name: per-layer dim index (after the stacked-L axis)

@@ -39,6 +39,12 @@ from mlx_sharding_tpu.ops.rope import (
 
 
 class DeepseekV2Model(BaseModel):
+    # MLA projections and the (E, …) expert stacks may stay 4-bit packed in
+    # HBM; the router (fp32 routing einsum) and — in compressed cache mode —
+    # kv_b (absorbed into einsums as a tensor) load dense via
+    # packed_keep_dense_re.
+    supports_packed = True
+
     def __init__(self, config: DeepseekV2Config):
         super().__init__(config)
         scaling = config.rope_scaling
@@ -78,6 +84,12 @@ class DeepseekV2Model(BaseModel):
         cfg = self.config
         return 1 if cfg.mla_cache_mode == "compressed" else cfg.num_attention_heads
 
+    def cache_tp_replicated(self) -> bool:
+        # the compressed-latent cache stores ONE shared latent "head" whose
+        # writes are computed from tp-replicated projections — identical on
+        # every tp device, so the buffer replicates while q heads shard
+        return self.config.mla_cache_mode == "compressed"
+
     def layer_group_ranges(self) -> dict:
         cfg = self.config
         fk = min(max(cfg.first_k_dense_replace, 0), cfg.num_hidden_layers)
@@ -93,24 +105,74 @@ class DeepseekV2Model(BaseModel):
         stacks shard over ep; shared experts/router/attention replicate."""
         return {"moe": {"w_gate": 0, "w_up": 0, "w_down": 0}}
 
+    def packed_keep_dense_re(self) -> str | None:
+        # router feeds the fp32 routing einsum; kv_b is consumed as a raw
+        # (rank, heads, nope+v) tensor by the absorbed compressed-cache
+        # einsums — per-token dequant there would cost more HBM traffic
+        # than dense residency saves
+        if self.config.mla_cache_mode == "compressed":
+            return r"mlp\.gate\.weight$|self_attn\.kv_b_proj\.weight$"
+        return r"mlp\.gate\.weight$"
+
+    def tp_layer_axes(self) -> dict:
+        """MLA tensor parallelism (per-group nested map; dims counted after
+        the stacked-L axis). Per-head projections shard: q/q_b and kv_b
+        column-parallel (whole heads per device — the output dim is
+        (heads, head_dim) flattened, so a contiguous heads/tp split is
+        head-aligned), o_proj row-parallel. The low-rank latent path
+        (q_a/kv_a + norms) and the router replicate. Expert stacks shard
+        their intermediate dim over tp (overridden to the E dim by
+        ep_layer_axes when a tp x ep mesh is in play — the engine merges
+        ep after tp); shared experts split column/row like a dense MLP."""
+        attn = {
+            "input_norm": None, "post_norm": None,
+            "kv_a_proj": None, "kv_a_norm": None,
+            "kv_b_proj": 1, "o_proj": 0,
+        }
+        if self.config.q_lora_rank is None:
+            attn["q_proj"] = 1
+        else:
+            attn.update({"q_a_proj": None, "q_a_norm": None, "q_b_proj": 1})
+        out = {}
+        if "dense" in self.layer_group_ranges():
+            out["dense"] = {
+                **attn, "gate_proj": 1, "up_proj": 1, "down_proj": 0,
+            }
+        if "moe" in self.layer_group_ranges():
+            out["moe"] = {
+                **attn, "router": None,
+                "shared_gate": 1, "shared_up": 1, "shared_down": 0,
+                "w_gate": 2, "w_up": 2, "w_down": 1,
+            }
+        return out
+
     # ------------------------------------------------------------------
-    def _attention(self, h, p, k_buf, v_buf, offset):
+    def _attention(self, h, p, k_buf, v_buf, offset, tp_axis=None):
+        """MLA under tensor parallelism: the low-rank latent path
+        (kv_a_proj / kv_a_norm and the single rope head) is REPLICATED —
+        it is head-count independent — while the per-head projections
+        (q/q_b, kv_b, o) shard over tp. Head counts derive from the
+        projection shard shapes, so this code runs the full model and any
+        tp slice unchanged; one psum after o_proj completes the row-parallel
+        output projection."""
         cfg = self.config
         b, t, _ = h.shape
-        heads = cfg.num_attention_heads
         nope, rope_d, v_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
         rank = cfg.kv_lora_rank
 
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
         if cfg.q_lora_rank is None:
-            q = r @ p["q_proj"]
+            q = self._linear(r, p["q_proj"])
         else:
-            q = rms_norm(r @ p["q_a_proj"], p["q_a_norm"], cfg.rms_norm_eps) @ p["q_b_proj"]
-        q = q.reshape(b, t, heads, nope + rope_d)
+            q = self._linear(
+                rms_norm(self._linear(r, p["q_a_proj"]), p["q_a_norm"], cfg.rms_norm_eps),
+                p["q_b_proj"],
+            )
+        q = q.reshape(b, t, -1, nope + rope_d)
         q_nope, q_pe = q[..., :nope], q[..., nope:]
         q_pe = apply_rope_interleaved(q_pe, self.inv_freq, offset, self.rope_scale)
 
-        ckv = r @ p["kv_a_proj"]  # (B, T, rank + rope_d)
+        ckv = self._linear(r, p["kv_a_proj"])  # (B, T, rank + rope_d)
         compressed, k_pe_raw = ckv[..., :rank], ckv[..., rank:]
         latent = rms_norm(compressed, p["kv_a_norm"], cfg.rms_norm_eps)
         k_pe = apply_rope_interleaved(
@@ -122,7 +184,7 @@ class DeepseekV2Model(BaseModel):
             # rank + rope_d numbers, independent of head count. kv_b is
             # absorbed into the query (scores) and output (values) sides, so
             # the math is identical to the decompressed path.
-            w_b = p["kv_b_proj"].reshape(rank, heads, nope + v_d)
+            w_b = p["kv_b_proj"].reshape(rank, -1, nope + v_d)
             w_bk, w_bv = w_b[..., :nope], w_b[..., nope:]
             q_lat = jnp.einsum(
                 "bthn,rhn->bthr", q_nope, w_bk, preferred_element_type=jnp.float32
@@ -140,7 +202,7 @@ class DeepseekV2Model(BaseModel):
                 "bthr,rhv->bthv", out_lat, w_bv, preferred_element_type=jnp.float32
             ).astype(h.dtype)
         else:
-            kv = (latent @ p["kv_b_proj"]).reshape(b, t, heads, nope + v_d)
+            kv = self._linear(latent, p["kv_b_proj"]).reshape(b, t, -1, nope + v_d)
             k_nope, v = kv[..., :nope], kv[..., nope:]
             k = jnp.concatenate(
                 [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], rope_d))],
@@ -149,22 +211,29 @@ class DeepseekV2Model(BaseModel):
             q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
             k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
             attn = causal_attention(q_full, k_buf, v_buf, offset, self.scale)
-        return h + attn.reshape(b, t, -1) @ p["o_proj"], k_buf, v_buf
+        attn_out = self._linear(attn.reshape(b, t, -1), p["o_proj"])
+        if tp_axis is not None:
+            attn_out = jax.lax.psum(attn_out, tp_axis)
+        return h + attn_out, k_buf, v_buf
 
-    @staticmethod
-    def _swiglu(r, gate, up, down):
-        return (jax.nn.silu(r @ gate) * (r @ up)) @ down
+    def _swiglu(self, r, gate, up, down):
+        return self._linear(
+            jax.nn.silu(self._linear(r, gate)) * self._linear(r, up), down
+        )
 
-    def _dense_layer(self, h, p, k_buf, v_buf, offset):
+    def _dense_layer(self, h, p, k_buf, v_buf, offset, tp_axis=None):
         cfg = self.config
-        h, k_buf, v_buf = self._attention(h, p, k_buf, v_buf, offset)
+        h, k_buf, v_buf = self._attention(h, p, k_buf, v_buf, offset, tp_axis)
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
-        return h + self._swiglu(r, p["gate_proj"], p["up_proj"], p["down_proj"]), k_buf, v_buf
+        ff = self._swiglu(r, p["gate_proj"], p["up_proj"], p["down_proj"])
+        if tp_axis is not None:
+            ff = jax.lax.psum(ff, tp_axis)
+        return h + ff, k_buf, v_buf
 
-    def _moe_layer(self, h, p, k_buf, v_buf, offset, ep_axis=None):
+    def _moe_layer(self, h, p, k_buf, v_buf, offset, tp_axis=None, ep_axis=None):
         cfg = self.config
         b, t, hidden = h.shape
-        h, k_buf, v_buf = self._attention(h, p, k_buf, v_buf, offset)
+        h, k_buf, v_buf = self._attention(h, p, k_buf, v_buf, offset, tp_axis)
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
         flat = r.reshape(b * t, hidden)
         # routing is replicated over ep (router weights replicated, global
@@ -179,14 +248,26 @@ class DeepseekV2Model(BaseModel):
         )
         routed = apply_experts(
             flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"],
-            ep_axis=ep_axis,
+            ep_axis=ep_axis, group_size=self._gs, bits=self._bits,
         )
         # shared experts are always-on and replicated across ep — their
         # contribution must NOT enter the ep psum
         shared = self._swiglu(
             flat, p["shared_gate"], p["shared_up"], p["shared_down"]
         )
-        return h + (routed + shared).reshape(b, t, hidden), k_buf, v_buf
+        if tp_axis is not None:
+            if ep_axis is None:
+                # experts shard their intermediate dim over tp: routed AND
+                # shared are both partial products — one combined psum
+                combined = jax.lax.psum(routed + shared, tp_axis)
+            else:
+                # tp x ep: expert stacks shard over ep (full after the ep
+                # psum inside apply_experts, replicated across tp); only the
+                # tp-sharded shared experts need the tp psum
+                combined = routed + jax.lax.psum(shared, tp_axis)
+        else:
+            combined = routed + shared
+        return h + combined.reshape(b, t, hidden), k_buf, v_buf
 
     # ------------------------------------------------------------------
     def _layer_split(self) -> tuple[int, int]:
@@ -208,19 +289,18 @@ class DeepseekV2Model(BaseModel):
         matching {group: (L,) bool} dict for padded slots."""
         from mlx_sharding_tpu.models.base import scan_layers
 
-        if tp_axis is not None:
-            raise NotImplementedError(
-                f"tensor parallelism is not wired for {type(self).__name__}"
-            )
         n_dense = (
-            next(iter(layer_params["dense"].values())).shape[0]
+            # tree.leaves: group values may be packed {q, scales, biases}
+            jax.tree.leaves(layer_params["dense"])[0].shape[0]
             if "dense" in layer_params
             else 0
         )
         ks, vs = [], []
         if "dense" in layer_params:
             h, kd, vd = scan_layers(
-                lambda h, p, kb, vb: self._dense_layer(h, p, kb, vb, offset),
+                lambda h, p, kb, vb: self._dense_layer(
+                    h, p, kb, vb, offset, tp_axis=tp_axis
+                ),
                 h, layer_params["dense"], k[:n_dense], v[:n_dense],
                 None if mask is None else mask["dense"],
             )
@@ -229,7 +309,7 @@ class DeepseekV2Model(BaseModel):
         if "moe" in layer_params:
             h, km, vm = scan_layers(
                 lambda h, p, kb, vb: self._moe_layer(
-                    h, p, kb, vb, offset, ep_axis=ep_axis
+                    h, p, kb, vb, offset, tp_axis=tp_axis, ep_axis=ep_axis
                 ),
                 h, layer_params["moe"], k[n_dense:], v[n_dense:],
                 None if mask is None else mask["moe"],
@@ -275,7 +355,7 @@ class DeepseekV2Model(BaseModel):
         """Stage-filtered HF tensors → {dense: (Ld,…), moe: (Lm,…)} stacks.
         Per-expert tensors fuse into switch stacks — the load-time version of
         the reference's sanitize stacking (deepseek_v2.py:101-112)."""
-        from mlx_sharding_tpu.loading import first_key
+        from mlx_sharding_tpu.loading import fetch_weight, first_key, stack_tree
 
         cfg = self.config
         attn_map = self._attn_map()
@@ -297,9 +377,12 @@ class DeepseekV2Model(BaseModel):
             stacked = {our: [] for our, _ in name_map.values()}
             for i in indices:
                 for suffix, (our, transpose) in name_map.items():
-                    w = jnp.asarray(weights[f"model.layers.{i}.{suffix}"], dtype)
-                    stacked[our].append(w.T if transpose else w)
-            return {k2: jnp.stack(v2) for k2, v2 in stacked.items()}
+                    stacked[our].append(
+                        fetch_weight(
+                            weights, f"model.layers.{i}.{suffix}", dtype, transpose
+                        )
+                    )
+            return {k2: stack_tree(v2) for k2, v2 in stacked.items()}
 
         dense_idx = [
             i for i in range(cfg.start_layer, cfg.end_layer)
@@ -319,14 +402,15 @@ class DeepseekV2Model(BaseModel):
                 ("w_up", "up_proj"),
                 ("w_down", "down_proj"),
             ):
-                moe[our] = jnp.stack(
+                moe[our] = stack_tree(
                     [
-                        jnp.stack(
+                        stack_tree(
                             [
-                                jnp.asarray(
-                                    weights[f"model.layers.{i}.mlp.experts.{e}.{which}.weight"],
+                                fetch_weight(
+                                    weights,
+                                    f"model.layers.{i}.mlp.experts.{e}.{which}.weight",
                                     dtype,
-                                ).T
+                                )
                                 for e in range(cfg.n_routed_experts)
                             ]
                         )

@@ -33,14 +33,6 @@ class LlamaModel(BaseModel):
             rope_frequencies(config.head_dim, config.rope_theta, config.rope_scaling)
         )
         self.scale = config.head_dim ** -0.5
-        q = config.quantization or {}
-        self._gs = int(q.get("group_size", 64))
-        self._bits = int(q.get("bits", 4))
-
-    def _linear(self, x, w):
-        from mlx_sharding_tpu.ops.quant import linear
-
-        return linear(x, w, self._gs, self._bits)
 
     # ------------------------------------------------------------------
     def layer_attn_inputs(self, p, h, offset):

@@ -23,6 +23,13 @@ from mlx_sharding_tpu.ops.moe import apply_experts, mixtral_routing
 
 
 class MixtralModel(BaseModel):
+    # attention projections and the (E, …) expert stacks may stay 4-bit
+    # packed; the router loads dense (fp32 routing matmul on a tiny weight)
+    supports_packed = True
+
+    def packed_keep_dense_re(self) -> str | None:
+        return r"block_sparse_moe\.gate\.weight$"
+
     def __init__(self, config: MixtralConfig):
         super().__init__(config)
         self.inv_freq = jnp.asarray(
@@ -31,15 +38,17 @@ class MixtralModel(BaseModel):
         self.scale = config.head_dim**-0.5
 
     # ------------------------------------------------------------------
-    def _layer(self, h, p, k_buf, v_buf, offset, ep_axis=None):
+    def _layer(self, h, p, k_buf, v_buf, offset, tp_axis=None, ep_axis=None):
         cfg = self.config
         b, t, hidden = h.shape
-        hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        d = cfg.head_dim
 
+        # head counts derive from the projection shards, so the same code
+        # runs the full model and any tp slice (heads split over tp)
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
-        q = (r @ p["q_proj"]).reshape(b, t, hq, d)
-        k = (r @ p["k_proj"]).reshape(b, t, hkv, d)
-        v = (r @ p["v_proj"]).reshape(b, t, hkv, d)
+        q = self._linear(r, p["q_proj"]).reshape(b, t, -1, d)
+        k = self._linear(r, p["k_proj"]).reshape(b, t, -1, d)
+        v = self._linear(r, p["v_proj"]).reshape(b, t, -1, d)
         q = apply_rope(q, self.inv_freq, offset)
         k = apply_rope(k, self.inv_freq, offset)
         k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
@@ -47,29 +56,36 @@ class MixtralModel(BaseModel):
             q, k_buf, v_buf, offset, self.scale,
             sliding_window=cfg.sliding_window,
         )
-        h = h + attn.reshape(b, t, -1) @ p["o_proj"]
+        attn_out = self._linear(attn.reshape(b, t, -1), p["o_proj"])
+        if tp_axis is not None:
+            attn_out = jax.lax.psum(attn_out, tp_axis)
+        h = h + attn_out
 
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
         flat = r.reshape(b * t, hidden)
         weights, idx = mixtral_routing(flat, p["router"], cfg.num_experts_per_tok)
         moe = apply_experts(
             flat, weights, idx, p["w_gate"], p["w_up"], p["w_down"],
-            ep_axis=ep_axis,
+            ep_axis=ep_axis, group_size=self._gs, bits=self._bits,
         )
+        if tp_axis is not None and ep_axis is None:
+            # experts shard their intermediate dim over tp — the down-proj
+            # outputs are partial products. Under tp x ep the expert stacks
+            # shard over ep instead (ep overrides tp in the engine's merge)
+            # and apply_experts' internal ep psum already made them full.
+            moe = jax.lax.psum(moe, tp_axis)
         return h + moe.reshape(b, t, hidden), k_buf, v_buf
 
     def run_layers(
         self, layer_params, h, k, v, offset, mask=None, tp_axis=None,
         ep_axis=None,
     ):
-        if tp_axis is not None:
-            raise NotImplementedError(
-                f"tensor parallelism is not wired for {type(self).__name__}"
-            )
         from mlx_sharding_tpu.models.base import scan_layers
 
         def body(h, p, k_buf, v_buf):
-            return self._layer(h, p, k_buf, v_buf, offset, ep_axis=ep_axis)
+            return self._layer(
+                h, p, k_buf, v_buf, offset, tp_axis=tp_axis, ep_axis=ep_axis
+            )
 
         return scan_layers(body, h, layer_params, k, v, mask)
 
@@ -77,6 +93,18 @@ class MixtralModel(BaseModel):
         """Expert stacks shard their leading (E) dim over ep; everything
         else replicates across ep devices."""
         return {"w_gate": 0, "w_up": 0, "w_down": 0}
+
+    def tp_layer_axes(self) -> dict:
+        """Megatron column/row split for attention (whole heads per tp
+        device); expert stacks shard their intermediate dim over tp, the
+        router replicates (routing computed identically on every device).
+        Dims counted after the stacked-L axis."""
+        return {
+            "input_norm": None, "post_norm": None,
+            "q_proj": 1, "k_proj": 1, "v_proj": 1, "o_proj": 0,
+            "router": None,
+            "w_gate": 2, "w_up": 2, "w_down": 1,
+        }
 
     def head_input(self, params, h):
         return rms_norm(h, params["final_norm"]["weight"], self.config.rms_norm_eps)
@@ -107,25 +135,34 @@ class MixtralModel(BaseModel):
         """Per-expert w1/w2/w3 tensors are stacked into fused (L, E, …)
         switch tensors — the same fusion the reference performs in sanitize
         (deepseek_v2.py:101-112), applied at load time."""
-        from mlx_sharding_tpu.loading import collect_layer_stack, first_key
+        from mlx_sharding_tpu.loading import (
+            collect_layer_stack,
+            fetch_weight,
+            first_key,
+            stack_tree,
+        )
 
         cfg = self.config
         layers = collect_layer_stack(weights, cfg, self.HF_LAYER_MAP, dtype)
 
         def expert_stack(which: str):
-            per_layer = []
-            for i in range(cfg.start_layer, cfg.end_layer):
-                per_expert = [
-                    jnp.asarray(
-                        weights[
-                            f"model.layers.{i}.block_sparse_moe.experts.{e}.{which}.weight"
-                        ],
-                        dtype,
-                    ).T
-                    for e in range(cfg.num_local_experts)
+            # (L, E, in, out) dense / {q,scales,biases} (L, E, out, …) packed
+            return stack_tree(
+                [
+                    stack_tree(
+                        [
+                            fetch_weight(
+                                weights,
+                                f"model.layers.{i}.block_sparse_moe."
+                                f"experts.{e}.{which}.weight",
+                                dtype,
+                            )
+                            for e in range(cfg.num_local_experts)
+                        ]
+                    )
+                    for i in range(cfg.start_layer, cfg.end_layer)
                 ]
-                per_layer.append(jnp.stack(per_expert))
-            return jnp.stack(per_layer)  # (L, E, in, out)
+            )
 
         layers["w_gate"] = expert_stack("w1")
         layers["w_up"] = expert_stack("w3")

@@ -73,8 +73,14 @@ def deepseek_routing(
     return topv * routed_scaling_factor, topi
 
 
-def apply_experts(x, weights, idx, w_gate, w_up, w_down, ep_axis=None):
-    """SwiGLU expert application. x (N, H); w_* stacked (E, H, I)/(E, I, H);
+def apply_experts(
+    x, weights, idx, w_gate, w_up, w_down, ep_axis=None,
+    group_size: int = 64, bits: int = 4,
+):
+    """SwiGLU expert application. x (N, H); w_* stacked (E, H, I)/(E, I, H)
+    dense, or packed ``{q, scales, biases}`` triples with MLX-orientation
+    leaves (E, out, in*bits/32) — 4-bit expert stacks stay resident in HBM
+    and dequantize on the fly (ref quant predicate: shard/utils.py:54-65).
     weights/idx (N, K). Returns (N, H).
 
     ``ep_axis``: inside shard_map with the expert stacks sharded over that
@@ -82,15 +88,26 @@ def apply_experts(x, weights, idx, w_gate, w_up, w_down, ep_axis=None):
     ``axis_index * E_local``; routing (weights/idx, global ids) is replicated,
     each device accumulates only its residents' contribution, and one psum
     combines — no all-to-all, no capacity factor, no token dropping."""
+    from mlx_sharding_tpu.ops.quant import is_quantized
+
     n = x.shape[0]
+    e_local = (w_gate["q"] if is_quantized(w_gate) else w_gate).shape[0]
     if ep_axis is not None:
-        e_local = w_gate.shape[0]
         base = jax.lax.axis_index(ep_axis) * e_local
-        acc = _apply_scan(x, weights, idx - base, w_gate, w_up, w_down)
+        acc = _apply_scan(
+            x, weights, idx - base, w_gate, w_up, w_down, group_size, bits
+        )
         return jax.lax.psum(acc, ep_axis)
     if n <= GATHER_PATH_MAX_TOKENS:
+        # decode path: HBM traffic is k/E of the stacks — and 4x less again
+        # when they are packed (gather the packed leaves, dequantize the
+        # gathered slice in-register)
+        if is_quantized(w_gate):
+            return _apply_gather_packed(
+                x, weights, idx, w_gate, w_up, w_down, group_size, bits
+            )
         return _apply_gather(x, weights, idx, w_gate, w_up, w_down)
-    return _apply_scan(x, weights, idx, w_gate, w_up, w_down)
+    return _apply_scan(x, weights, idx, w_gate, w_up, w_down, group_size, bits)
 
 
 def _apply_gather(x, weights, idx, w_gate, w_up, w_down):
@@ -103,13 +120,36 @@ def _apply_gather(x, weights, idx, w_gate, w_up, w_down):
     return (y * weights[..., None].astype(y.dtype)).sum(axis=1).astype(x.dtype)
 
 
-def _apply_scan(x, weights, idx, w_gate, w_up, w_down):
-    num_experts = w_gate.shape[0]
+def _apply_gather_packed(x, weights, idx, w_gate, w_up, w_down, gs, bits):
+    """Gather path over packed stacks: index the uint32/fp16 leaves by the
+    top-k expert ids (reading k/E × 1/4 of the dense bytes), then dequantize
+    just the gathered (N, K, out, in) slices. MLX orientation is (out, in),
+    so the einsums contract the LAST dim."""
+    from mlx_sharding_tpu.ops.quant import dequantize
+
+    def gathered(w):  # → (N, K, out, in) dense in x.dtype
+        return dequantize(
+            w["q"][idx], w["scales"][idx], w["biases"][idx], gs, bits, x.dtype
+        )
+
+    g = jnp.einsum("nh,nkih->nki", x, gathered(w_gate))
+    u = jnp.einsum("nh,nkih->nki", x, gathered(w_up))
+    y = jnp.einsum("nki,nkhi->nkh", jax.nn.silu(g) * u, gathered(w_down))
+    return (y * weights[..., None].astype(y.dtype)).sum(axis=1).astype(x.dtype)
+
+
+def _apply_scan(x, weights, idx, w_gate, w_up, w_down, gs=64, bits=4):
+    from mlx_sharding_tpu.ops.quant import is_quantized, linear
+
+    num_experts = (w_gate["q"] if is_quantized(w_gate) else w_gate).shape[0]
 
     def body(acc, xs):
         wg, wu, wd, e = xs
         coef = ((idx == e) * weights).sum(axis=-1)  # (N,) routing mass for e
-        y = (jax.nn.silu(x @ wg) * (x @ wu)) @ wd
+        # linear() serves dense (in, out) slices and packed (out, in)
+        # triples alike — the prefill path streams every expert's packed
+        # bytes once, full-width MXU matmuls, no sorting
+        y = linear(jax.nn.silu(linear(x, wg, gs, bits)) * linear(x, wu, gs, bits), wd, gs, bits)
         return acc + coef[:, None].astype(y.dtype) * y, None
 
     acc0 = jnp.zeros_like(x)

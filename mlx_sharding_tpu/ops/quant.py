@@ -30,21 +30,22 @@ def dequantize(
     bits: int = 4,
     dtype=jnp.bfloat16,
 ) -> jax.Array:
-    """(out, in*bits/32) packed uint32 → (out, in) dense."""
+    """(…, out, in*bits/32) packed uint32 → (…, out, in) dense. Leading
+    dims carry stacked layers / expert stacks / gathered top-k experts."""
     w_q = jnp.asarray(w_q)
     if w_q.dtype != jnp.uint32:
         raise ValueError(f"packed weight must be uint32, got {w_q.dtype}")
-    out_dim = w_q.shape[0]
+    lead = w_q.shape[:-1]
     per_word = 32 // bits
     shifts = jnp.arange(per_word, dtype=jnp.uint32) * bits
-    # (out, in/per_word, per_word) → (out, in)
+    # (…, out, in/per_word, per_word) → (…, out, in)
     vals = (w_q[..., None] >> shifts) & ((1 << bits) - 1)
-    vals = vals.reshape(out_dim, -1).astype(jnp.float32)
-    in_dim = vals.shape[1]
-    scales = jnp.asarray(scales, jnp.float32).reshape(out_dim, in_dim // group_size, 1)
-    biases = jnp.asarray(biases, jnp.float32).reshape(out_dim, in_dim // group_size, 1)
-    grouped = vals.reshape(out_dim, in_dim // group_size, group_size)
-    return (grouped * scales + biases).reshape(out_dim, in_dim).astype(dtype)
+    vals = vals.reshape(*lead, -1).astype(jnp.float32)
+    in_dim = vals.shape[-1]
+    scales = jnp.asarray(scales, jnp.float32).reshape(*lead, in_dim // group_size, 1)
+    biases = jnp.asarray(biases, jnp.float32).reshape(*lead, in_dim // group_size, 1)
+    grouped = vals.reshape(*lead, in_dim // group_size, group_size)
+    return (grouped * scales + biases).reshape(*lead, in_dim).astype(dtype)
 
 
 def is_quantized(w) -> bool:

@@ -186,7 +186,10 @@ class PipelineEngine:
                 raise ValueError(
                     f"tensor parallelism is not wired for {type(model).__name__}"
                 )
-            if model.cache_num_heads() % self.tp:
+            if (
+                not model.cache_tp_replicated()
+                and model.cache_num_heads() % self.tp
+            ):
                 raise ValueError(
                     f"tp={self.tp} must divide the {model.cache_num_heads()} "
                     "KV heads"
@@ -211,10 +214,13 @@ class PipelineEngine:
             )
         self.stage_bounds = [tuple(b) for b in stage_bounds]
         # under TP the KV heads axis is sharded too: each (pp, tp) device
-        # holds its stage's cache for its own heads only
+        # holds its stage's cache for its own heads only. A head-count-
+        # independent cache (model.cache_tp_replicated: DeepSeek's compressed
+        # shared latent) replicates over tp instead, every tp device
+        # computing identical writes from the replicated latent projections.
         self._kv_spec = (
             P(AXIS_PP, None, None, None, None, AXIS_TP)
-            if self.tp > 1 else P(AXIS_PP)
+            if self.tp > 1 and not model.cache_tp_replicated() else P(AXIS_PP)
         )
         split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
 
@@ -255,27 +261,31 @@ class PipelineEngine:
             return _check_div(name, w, ax, axis_name)
 
         def quant_spec(entry, name, w):
-            """Packed triples under TP. The model declares axes in the DENSE
-            (in, out) orientation, but packed leaves live in MLX's (out, X)
-            orientation — q (out, in/8), scales/biases (out, in/group) — so
-            the tp dim flips: column-parallel (dense ax 1) shards dim 0 of
-            every leaf, row-parallel (dense ax 0) shards dim 1. Per-leaf
-            divisibility checks double as nibble-word and quant-group
-            alignment guards (scales' in/group dim dividing tp ⇔ the in
-            split lands on group boundaries)."""
+            """Packed triples under TP/EP. The model declares axes in the
+            DENSE orientation — trailing (…, in, out) matmul dims, any
+            leading stack dims (the expert E axis) before them — but packed
+            leaves keep those two trailing dims in MLX's (out, X) layout:
+            q (out, in/8), scales/biases (out, in/group). Leading stack dims
+            are layout-identical (EP's E axis shards as declared); within
+            the matmul pair the dim flips: column-parallel (dense out)
+            shards packed dim -2, row-parallel (dense in) shards packed
+            dim -1. Per-leaf divisibility checks double as nibble-word and
+            quant-group alignment guards (scales' in/group dim dividing the
+            mesh axis ⇔ the in split lands on group boundaries)."""
             if entry is None:
                 spec = P(AXIS_PP)
                 return jax.tree.map(lambda _: spec, w)
             ax, axis_name = entry
-            if axis_name != AXIS_TP or any(a.ndim != 4 for a in w.values()):
-                # the orientation flip is only meaningful for 2-D TP
-                # projections; ep-sharded (expert-stack) packed weights would
-                # shard the wrong dim silently — keep the old loud failure
-                raise ValueError(
-                    f"{axis_name} over packed 4-bit weights is not supported "
-                    f"for {name} — load without keep_quantized"
-                )
-            axq = 1 - ax
+            ndims = {a.ndim for a in w.values()}
+            if len(ndims) != 1:
+                raise ValueError(f"ragged packed leaves for {name}")
+            nd = ndims.pop() - 2  # per-layer dims (drop the S, L stack axes)
+            if ax < nd - 2:
+                axq = ax  # leading stack dim (expert E): same position packed
+            elif ax == nd - 1:
+                axq = nd - 2  # dense out (column-parallel) → packed out dim
+            else:
+                axq = nd - 1  # dense in (row-parallel) → packed in/X dim
             return {
                 leaf: _check_div(f"{name}.{leaf}", arr, axq, axis_name)
                 for leaf, arr in w.items()

@@ -104,8 +104,10 @@ class ModelProvider:
         cache_dtype=None,
         trust_remote_paths: bool = False,
         chat_template: Optional[str] = None,
+        keep_quantized: bool = False,
     ):
         self.chat_template = chat_template
+        self.keep_quantized = keep_quantized
         self.default_model = default_model
         self.start_layer = start_layer
         self.end_layer = end_layer
@@ -171,10 +173,12 @@ class ModelProvider:
                     target, self.stage_bounds, dtype=cache_dtype,
                     max_seq=self.max_seq, cache_dtype=cache_dtype,
                     prefill_chunk=self.prefill_chunk,
+                    keep_quantized=self.keep_quantized,
                 )
             else:
                 model, params = load_model(
-                    target, self.start_layer, self.end_layer, dtype=cache_dtype
+                    target, self.start_layer, self.end_layer, dtype=cache_dtype,
+                    keep_quantized=self.keep_quantized,
                 )
                 stages = (
                     len(self.stage_bounds) if self.stage_bounds
@@ -374,6 +378,12 @@ class APIHandler(BaseHTTPRequestHandler):
                 bias = {int(k): float(v) for k, v in bias.items()}
             except (ValueError, TypeError):
                 raise ValueError("logit_bias keys must be token ids")
+            # one cap for every serving path (solo / scheduler slots /
+            # multi-host control plane all size their buffers to 512) so a
+            # request never succeeds on one deployment and 500s on another;
+            # OpenAI's documented cap is 300
+            if len(bias) > 512:
+                raise ValueError("logit_bias supports at most 512 entries")
         p["logit_bias"] = bias
         stop = body.get("stop", [])
         if isinstance(stop, str):
@@ -682,7 +692,11 @@ def main(argv=None):
                         "programs")
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel width within each pipeline "
-                        "stage (Llama family)")
+                        "stage")
+    parser.add_argument("--keep-quantized", action="store_true",
+                        help="keep 4-bit checkpoint weights packed in HBM "
+                        "(fused dequant-matmul) instead of dequantizing on "
+                        "load — 4x decode weight bandwidth")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel width within each pipeline "
                         "stage (MoE models)")
@@ -745,7 +759,7 @@ def main(argv=None):
         engine=args.engine, concurrent=args.concurrent, multihost=multihost,
         tp=args.tp, ep=args.ep,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
-        chat_template=chat_template,
+        chat_template=chat_template, keep_quantized=args.keep_quantized,
     )
     if multihost:
         import jax
