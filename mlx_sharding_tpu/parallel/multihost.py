@@ -46,7 +46,10 @@ OP_DECODE = 2
 OP_STOP_REQUEST = 3
 OP_SHUTDOWN = 4
 
-_BIAS_SLOTS = 64
+# matches the scheduler's per-slot width (scheduler.py make_sampler_params
+# min_bias_slots=512) and the HTTP-layer validation cap, so a request that
+# works single-host never fails multi-host (covers OpenAI's documented 300)
+_BIAS_SLOTS = 512
 
 
 class _Shutdown(Exception):
@@ -103,9 +106,13 @@ def _request_msg(prompt, temperature, top_p, repetition_penalty,
         bias_val[:n_bias] = [float(v) for _, v in items]
     return {
         "header": np.asarray(
-            [OP_REQUEST, prompt.size, max_tokens, seed,
+            # seed rides in two int32 fields (31 bits each) so a 62-bit user
+            # seed round-trips and multi-host reproduces the single-host
+            # stream for the same request
+            [OP_REQUEST, prompt.size, max_tokens, seed & 0x7FFFFFFF,
              repetition_context_size,
-             0 if repetition_penalty is None else 1, n_bias, 0],
+             0 if repetition_penalty is None else 1, n_bias,
+             (seed >> 31) & 0x7FFFFFFF],
             np.int32,
         ),
         "floats": np.asarray(
@@ -122,7 +129,7 @@ def _start_request(engine, msg):
     first token. Returns the rolling decode state."""
     hdr = msg["header"]
     n_prompt = int(hdr[1])
-    seed = int(hdr[3])
+    seed = int(hdr[3]) | (int(hdr[7]) << 31)
     rep_ctx = int(hdr[4])
     n_bias = int(hdr[6])
     temperature, top_p, rep_pen = (float(x) for x in msg["floats"][:3])
@@ -210,16 +217,20 @@ class MultiHostPipeline:
                 f"prompt ({prompt.size}) + max_tokens ({max_tokens}) exceeds "
                 f"KV capacity {self.engine.max_seq}"
             )
+        if seed is not None and not 0 <= int(seed) < (1 << 62):
+            raise ValueError("seed must fit in 62 bits for multi-host serving")
         msg = _request_msg(
             prompt, temperature, top_p, repetition_penalty,
             repetition_context_size, logit_bias,
-            # int32 control-plane field: mask user seeds into 31 bits
-            (int(_time.time_ns()) if seed is None else int(seed)) & 0x7FFFFFFF,
+            (int(_time.time_ns()) if seed is None else int(seed)),
             max_tokens,
         )
         self.ctrl.exchange(msg)
-        state = _start_request(self.engine, msg)
+        # everything after the OP_REQUEST broadcast sits inside the try:
+        # if prefill raises on rank 0, the finally still broadcasts STOP so
+        # workers leave the request loop instead of hanging the collective
         try:
+            state = _start_request(self.engine, msg)
             n = 0
             while True:
                 yield int(np.asarray(state["tok"]).reshape(-1)[0]), state["logprobs"]
@@ -241,25 +252,74 @@ class MultiHostPipeline:
     close = shutdown
 
 
+def _drain_to_stop(ctrl) -> bool:
+    """After a local step failure, consume broadcasts until rank 0's
+    per-request STOP (its generator ``finally`` always sends exactly one) so
+    the collective protocol stays aligned. Returns True on OP_SHUTDOWN."""
+    while True:
+        step = ctrl.exchange()
+        op = int(step["header"][0])
+        if op == OP_STOP_REQUEST:
+            return False
+        if op == OP_SHUTDOWN:
+            return True
+        if op != OP_DECODE:
+            raise RuntimeError(f"worker protocol desync while draining: op {op}")
+
+
 def serve_worker(engine) -> None:
     """Rank>0 main loop — the reference's shard-server process
     (shard/server/server.py:74-93) with the RPC surface replaced by the
-    broadcast control plane. Blocks until rank 0 publishes OP_SHUTDOWN."""
+    broadcast control plane. Blocks until rank 0 publishes OP_SHUTDOWN.
+
+    Failure discipline: step failures are DETERMINISTIC (every rank runs the
+    identical program on identical inputs), so when a local step raises this
+    worker logs it and drains to the request's STOP instead of dying — rank 0
+    raises the same error to the client and its ``finally`` broadcasts that
+    STOP, leaving all ranks aligned for the next request. Rank-0-only host
+    failures reach us as a bare STOP (handled at top level). Genuinely
+    asymmetric failures cannot be resynced over a lockstep collective plane
+    and surface as the loud desync RuntimeErrors."""
+    import logging
+
+    logger = logging.getLogger(__name__)
     ctrl = ControlPlane(max_prompt=engine.max_seq)
     while True:
         msg = ctrl.exchange()
         op = int(msg["header"][0])
         if op == OP_SHUTDOWN:
             return
-        if op != OP_REQUEST:
+        if op == OP_STOP_REQUEST:
+            # rank 0's prefill failed after OP_REQUEST but before issuing
+            # device work — its unconditional STOP resyncs us
             continue
-        state = _start_request(engine, msg)
+        if op != OP_REQUEST:
+            # a silent skip here would desync the collective protocol one
+            # exchange at a time; fail loudly instead
+            raise RuntimeError(f"worker protocol desync: unexpected op {op}")
+        try:
+            state = _start_request(engine, msg)
+        except Exception:
+            logger.exception("worker prefill failed; draining to STOP")
+            if _drain_to_stop(ctrl):
+                return
+            continue
         while True:
             step = ctrl.exchange()
             op = int(step["header"][0])
             if op == OP_DECODE:
-                state = _decode_step(engine, state)
+                try:
+                    state = _decode_step(engine, state)
+                except Exception:
+                    logger.exception("worker decode failed; draining to STOP")
+                    if _drain_to_stop(ctrl):
+                        return
+                    break
             elif op == OP_STOP_REQUEST:
                 break
             elif op == OP_SHUTDOWN:
                 return
+            else:
+                raise RuntimeError(
+                    f"worker protocol desync: unexpected op {op} mid-request"
+                )
