@@ -79,13 +79,13 @@ def _pallas_ok(m, in_dim, out_dim, group_size, bits) -> bool:
     # single source of truth for the dispatch contract: the kernel's own
     # block defaults and min() clamping
     from mlx_sharding_tpu.ops.quant_matmul import (
-        DEFAULT_BLOCK_IN,
         DEFAULT_BLOCK_M,
         DEFAULT_BLOCK_OUT,
+        pick_block_in,
     )
 
     per_word = 32 // bits
-    block_in = min(DEFAULT_BLOCK_IN, in_dim)
+    block_in = min(pick_block_in(in_dim), in_dim)
     return (
         jax.default_backend() == "tpu"
         and m % min(DEFAULT_BLOCK_M, m) == 0
@@ -107,6 +107,34 @@ def _quant_matmul(x2, q, scales, biases, group_size, bits):
         )
     w = dequantize(q, scales, biases, group_size, bits, jnp.float32)
     return (x2 @ w.astype(x2.dtype).T).astype(x2.dtype)
+
+
+def quantize_jax(w: jax.Array, group_size: int = 64, bits: int = 4):
+    """Device-side mlx-layout packer: (…, out, in) → (q (…, out, in*bits/32)
+    uint32, scales, biases (…, out, in/group_size) f32). Same math as
+    :func:`quantize`, jittable — benchmarks quantize multi-GB weight stacks
+    in place on the chip instead of round-tripping them to host."""
+    w = jnp.asarray(w, jnp.float32)
+    *lead, out_dim, in_dim = w.shape
+    if in_dim % group_size:
+        raise ValueError(f"in_dim {in_dim} not divisible by group_size {group_size}")
+    grouped = w.reshape(*lead, out_dim, in_dim // group_size, group_size)
+    w_max = grouped.max(axis=-1, keepdims=True)
+    w_min = grouped.min(axis=-1, keepdims=True)
+    n_levels = (1 << bits) - 1
+    scale = jnp.maximum((w_max - w_min) / n_levels, 1e-8)
+    q = jnp.clip(jnp.round((grouped - w_min) / scale), 0, n_levels).astype(jnp.uint32)
+    q = q.reshape(*lead, out_dim, in_dim)
+    per_word = 32 // bits
+    # (…, out, in/per_word, per_word): LSB-first nibbles within each word
+    q = q.reshape(*lead, out_dim, in_dim // per_word, per_word)
+    shifts = jnp.arange(per_word, dtype=jnp.uint32) * bits
+    packed = (q << shifts).sum(axis=-1, dtype=jnp.uint32)
+    return (
+        packed,
+        scale[..., 0].astype(jnp.float32),
+        w_min[..., 0].astype(jnp.float32),
+    )
 
 
 def quantize(w: np.ndarray, group_size: int = 64, bits: int = 4):

@@ -6,14 +6,28 @@ which is BANDWIDTH: decode is HBM-bound, and streaming 4-bit words + one
 scale/bias pair per 64 weights moves ~4x fewer bytes than bf16 (SURVEY §7
 "hard part (a)"; ROADMAP r1 queue item). This kernel keeps the packed
 ``{q, scales, biases}`` triple resident and fuses unpack → affine →
-matmul inside VMEM:
+matmul inside VMEM.
 
-- grid over (M tiles, OUT tiles); the reduction dim streams through a
-  ``fori_loop`` in ``block_in`` slices,
-- each slice loads (block_out, block_in/8) uint32 words, unpacks 8 nibbles
-  per word with broadcasted shifts (VPU), applies ``q * scale + bias`` per
-  ``group_size`` column group, and feeds the MXU dot,
-- accumulation in fp32, output cast to the activation dtype.
+Structure — shaped by what Mosaic actually compiles on a v5e (dynamic
+lane-dim slices and lane-merging reshapes are both rejected by the layout
+inference, so neither an in-kernel ``fori_loop`` over the reduction nor a
+``(out, words, 8) → (out, in)`` unpack reshape can be used):
+
+- 3-D grid (M tiles, OUT tiles, IN blocks); the IN axis is a sequential
+  reduction dimension — partials accumulate into an fp32 VMEM scratch,
+  written to the output tile on the last IN step.
+- The unpack never materializes an (out, in) tile. Each uint32 word holds 8
+  nibbles; the kernel processes 8 *nibble planes* ``(q >> 4j) & 0xF`` of
+  shape (out, words) and runs one MXU sub-dot per plane against the
+  matching activation plane. The activations arrive pre-permuted to
+  word-major order (x_r[m, j, w] = x[m, 8w + j], a cheap XLA transpose
+  traced into the surrounding program), so every sub-dot is a plain
+  lane-contraction.
+- Per-group scales/biases expand group→word lanes via a tiny iota-built
+  0/1 matrix on the MXU (E[g, w] = [w//8 == g]) — broadcast+reshape lane
+  expansion is exactly the shape cast Mosaic rejects. The bias term folds
+  into one extra sub-dot against the plane-summed activations:
+  ``out += Σ_j x_j @ (nib_j · s_w)ᵀ + (Σ_j x_j) @ b_wᵀ``.
 
 Layout contract is exactly the checkpoint's (mlx.core.quantize,
 ref shard/utils.py:54-65): ``q`` (out, in*bits/32) LSB-first nibbles,
@@ -32,37 +46,56 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_M = 128
 DEFAULT_BLOCK_OUT = 128
-DEFAULT_BLOCK_IN = 512
+# IN-blocks must keep the packed-word lane dim 128-aligned: 1024 inputs =
+# 128 uint32 words. Smaller/indivisible IN dims run as one whole block.
+DEFAULT_BLOCK_IN = 1024
 
 
-def _kernel(
-    x_ref, q_ref, s_ref, b_ref, o_ref, *, bits, group_size, block_in, in_dim
-):
+def pick_block_in(in_dim: int) -> int:
+    """Largest legal IN block: a multiple of 1024 keeps the word lanes
+    128-aligned; otherwise the whole (unpartitioned) dim is always legal."""
+    if in_dim % DEFAULT_BLOCK_IN == 0:
+        return DEFAULT_BLOCK_IN
+    return in_dim
+
+
+def _kernel(x_ref, q_ref, s_ref, b_ref, o_ref, acc_ref, *, bits, group_size):
     per_word = 32 // bits
     mask = (1 << bits) - 1
-    words = block_in // per_word
-    groups = block_in // group_size
-    bo = q_ref.shape[0]
-    shifts = jax.lax.broadcasted_iota(jnp.uint32, (1, 1, per_word), 2) * bits
+    bo, words = q_ref.shape
+    gpb = s_ref.shape[-1]
+    wpg = group_size // per_word  # words per quant group
 
-    def body(ki, acc):
-        xblk = x_ref[:, pl.ds(ki * block_in, block_in)].astype(jnp.float32)
-        wq = q_ref[:, pl.ds(ki * words, words)]  # (bo, words) uint32
-        nib = (wq[:, :, None] >> shifts) & mask  # (bo, words, per_word)
-        w = nib.reshape(bo, block_in).astype(jnp.float32)
-        s = s_ref[:, pl.ds(ki * groups, groups)].astype(jnp.float32)
-        b = b_ref[:, pl.ds(ki * groups, groups)].astype(jnp.float32)
-        s = jnp.repeat(s[:, :, None], group_size, axis=2).reshape(bo, block_in)
-        b = jnp.repeat(b[:, :, None], group_size, axis=2).reshape(bo, block_in)
-        w = w * s + b
-        return acc + jax.lax.dot_general(
-            xblk, w, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    acc0 = jnp.zeros((x_ref.shape[0], bo), jnp.float32)
-    acc = jax.lax.fori_loop(0, in_dim // block_in, body, acc0)
-    o_ref[...] = acc.astype(o_ref.dtype)
+    # group→word lane expansion on the MXU: E[g, w] = [w // wpg == g]
+    gi = jax.lax.broadcasted_iota(jnp.int32, (gpb, words), 0)
+    wi = jax.lax.broadcasted_iota(jnp.int32, (gpb, words), 1)
+    expand = (wi // wpg == gi).astype(jnp.float32)
+    dot = functools.partial(
+        jax.lax.dot_general, preferred_element_type=jnp.float32
+    )
+    contract_last = (((1,), (1,)), ((), ()))
+    s_w = dot(s_ref[0].astype(jnp.float32), expand, (((1,), (0,)), ((), ())))
+    b_w = dot(b_ref[0].astype(jnp.float32), expand, (((1,), (0,)), ((), ())))
+
+    wq = q_ref[...]  # (bo, words) uint32
+    acc = acc_ref[...]
+    x_sum = jnp.zeros((x_ref.shape[0], words), jnp.float32)
+    for j in range(per_word):
+        # nibbles are 0..15: the int32 detour is exact (no uint32→f32 cast
+        # exists in Mosaic)
+        nib = ((wq >> (j * bits)) & mask).astype(jnp.int32).astype(jnp.float32)
+        xj = x_ref[:, j, :].astype(jnp.float32)  # (bm, words)
+        acc = acc + dot(xj, nib * s_w, contract_last)
+        x_sum = x_sum + xj
+    acc_ref[...] = acc + dot(x_sum, b_w, contract_last)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _done():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -80,7 +113,7 @@ def quant_matmul_pallas(
     bits: int = 4,
     block_m: int = DEFAULT_BLOCK_M,
     block_out: int = DEFAULT_BLOCK_OUT,
-    block_in: int = DEFAULT_BLOCK_IN,
+    block_in: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """x @ dequant(q, scales, biases).T without materializing the dense
@@ -90,6 +123,8 @@ def quant_matmul_pallas(
     per_word = 32 // bits
     block_m = min(block_m, m)
     block_out = min(block_out, out_dim)
+    if block_in is None:
+        block_in = pick_block_in(in_dim)
     block_in = min(block_in, in_dim)
     if block_in % group_size or block_in % per_word:
         raise ValueError(
@@ -102,26 +137,31 @@ def quant_matmul_pallas(
             f"sizes ({block_m}, {block_out}, {block_in})"
         )
 
-    grid = (m // block_m, out_dim // block_out)
+    n_in = in_dim // block_in
+    gpb = block_in // group_size
+    words = block_in // per_word
+    # (M, IN) → word-major planes: x_r[m, j, W] = x[m, 8W + j]
+    x_r = x.reshape(m, in_dim // per_word, per_word).transpose(0, 2, 1)
+    # (OUT, G) → (n_in, OUT, groups_per_block): gives every grid step a
+    # statically-addressed scale block (lane dim = gpb, whole → legal)
+    s3 = scales.reshape(out_dim, n_in, gpb).transpose(1, 0, 2)
+    b3 = biases.reshape(out_dim, n_in, gpb).transpose(1, 0, 2)
+
+    grid = (m // block_m, out_dim // block_out, n_in)
     return pl.pallas_call(
-        functools.partial(
-            _kernel, bits=bits, group_size=group_size, block_in=block_in,
-            in_dim=in_dim,
-        ),
+        functools.partial(_kernel, bits=bits, group_size=group_size),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_m, in_dim), lambda mi, oi: (mi, 0)),
-            pl.BlockSpec(
-                (block_out, in_dim // per_word), lambda mi, oi: (oi, 0)
-            ),
-            pl.BlockSpec(
-                (block_out, in_dim // group_size), lambda mi, oi: (oi, 0)
-            ),
-            pl.BlockSpec(
-                (block_out, in_dim // group_size), lambda mi, oi: (oi, 0)
-            ),
+            pl.BlockSpec((block_m, per_word, words), lambda mi, oi, ii: (mi, 0, ii)),
+            pl.BlockSpec((block_out, words), lambda mi, oi, ii: (oi, ii)),
+            pl.BlockSpec((1, block_out, gpb), lambda mi, oi, ii: (ii, oi, 0)),
+            pl.BlockSpec((1, block_out, gpb), lambda mi, oi, ii: (ii, oi, 0)),
         ],
-        out_specs=pl.BlockSpec((block_m, block_out), lambda mi, oi: (mi, oi)),
+        out_specs=pl.BlockSpec((block_m, block_out), lambda mi, oi, ii: (mi, oi)),
         out_shape=jax.ShapeDtypeStruct((m, out_dim), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_out), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
-    )(x, q, scales, biases)
+    )(x_r, q, s3, b3)

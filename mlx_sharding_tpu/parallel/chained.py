@@ -126,6 +126,7 @@ class ChainedPipeline:
         logit_bias: Optional[dict[int, float]] = None,
         seed: Optional[int] = None,
         max_tokens: int = 256,
+        want_logprobs: bool = False,  # full (B, V) rows are always yielded
     ):
         """Same contract as generate.Generator.generate_step."""
         sp = make_sampler_params(temperature, top_p, repetition_penalty, logit_bias)

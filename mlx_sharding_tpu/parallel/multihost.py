@@ -208,6 +208,7 @@ class MultiHostPipeline:
         logit_bias: Optional[dict[int, float]] = None,
         seed: Optional[int] = None,
         max_tokens: int = 256,
+        want_logprobs: bool = False,  # full (B, V) rows are always yielded
     ):
         import time as _time
 

@@ -665,6 +665,7 @@ class PipelineEngine:
         logit_bias: Optional[dict[int, float]] = None,
         seed: Optional[int] = None,
         max_tokens: int = 256,
+        want_logprobs: bool = False,  # full (B, V) rows are always yielded
     ):
         """Same contract as generate.Generator.generate_step — tokens stream
         out one at a time; every microbatch runs the same prompt (serving

@@ -32,6 +32,7 @@ from typing import Optional
 
 import numpy as np
 
+from mlx_sharding_tpu.generate import TokenLogprobs
 from mlx_sharding_tpu.tokenizer_utils import (
     StreamingDetokenizer,
     sequence_overlap,
@@ -452,6 +453,10 @@ class APIHandler(BaseHTTPRequestHandler):
             seed=params["seed"],
             max_tokens=params["max_tokens"],
         )
+        if not params["stream"] and params["logprobs"] > 0:
+            # streaming discards logprobs (ref shard/openai_api.py:454-455),
+            # so only the non-streaming path asks the engine to compute them
+            gen_kwargs["want_logprobs"] = True
 
         # a concurrency-safe generator (ContinuousBatcher) interleaves
         # requests itself; everything else is serialized by the lock, which
@@ -494,10 +499,24 @@ class APIHandler(BaseHTTPRequestHandler):
                 break
             tokens.append(token)
             if want_logprobs > 0:
-                row = np.asarray(logprobs[0])
-                token_logprobs.append(float(row[token]))
-                top_idx = np.argsort(row)[::-1][:want_logprobs]
-                top_logprobs.append({int(i): float(row[i]) for i in top_idx})
+                if isinstance(logprobs, TokenLogprobs):
+                    # computed on device in the decode block (lax.top_k);
+                    # nothing vocab-sized ever reaches the host
+                    token_logprobs.append(logprobs.chosen)
+                    top_logprobs.append(
+                        {
+                            int(i): float(v)
+                            for i, v in zip(
+                                logprobs.top_indices[:want_logprobs],
+                                logprobs.top_values[:want_logprobs],
+                            )
+                        }
+                    )
+                else:  # engines still yielding the full (B, V) row
+                    row = np.asarray(logprobs[0])
+                    token_logprobs.append(float(row[token]))
+                    top_idx = np.argsort(row)[::-1][:want_logprobs]
+                    top_logprobs.append({int(i): float(row[i]) for i in top_idx})
             stop = stopping_criteria(tokens, stop_id_sequences, None)
             if stop.stop_met:
                 if stop.trim_length:

@@ -90,3 +90,53 @@ def test_stream_generate_stop_sequence(gen):
     # stops at the *first* occurrence of the stop token, which is itself
     # trimmed from the reported output
     assert chunks[-1].generation_tokens == toks.index(toks[2])
+
+
+def test_want_logprobs_topk(gen):
+    """TokenLogprobs summaries (device-side lax.top_k) must agree with a full
+    log-softmax recomputation: chosen == logprob of the emitted token, top-k
+    descending and containing the greedy choice."""
+    out = list(gen.generate_step([1, 2, 3], max_tokens=6, want_logprobs=True))
+    assert len(out) == 6
+    for tok, lp in out:
+        assert lp is not None
+        assert lp.top_values.shape == lp.top_indices.shape
+        vals = np.asarray(lp.top_values)
+        assert (np.diff(vals) <= 1e-6).all()  # descending
+        assert vals[0] <= 0 + 1e-6
+        # greedy decode: emitted token is the argmax -> top-1 index
+        assert int(lp.top_indices[0]) == tok
+        assert lp.chosen == pytest.approx(float(vals[0]), abs=1e-5)
+
+
+def test_want_logprobs_token_parity(gen):
+    """Asking for logprobs must not change the token stream (the summary is
+    computed from the same in-scan logits)."""
+    a = [t for t, _ in gen.generate_step([4, 5], max_tokens=9, seed=3, temperature=0.8)]
+    b = [
+        t
+        for t, _ in gen.generate_step(
+            [4, 5], max_tokens=9, seed=3, temperature=0.8, want_logprobs=True
+        )
+    ]
+    assert a == b
+
+
+def test_decode_block_sizes_agree(gen):
+    """Different decode_block sizes are pure batching — token streams must be
+    identical (greedy and seeded)."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    one = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8,
+        decode_block=1,
+    )
+    five = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8,
+        decode_block=5,
+    )
+    for kw in (dict(), dict(temperature=1.0, seed=11)):
+        want = [t for t, _ in gen.generate_step([1, 2, 3], max_tokens=10, **kw)]
+        assert [t for t, _ in one.generate_step([1, 2, 3], max_tokens=10, **kw)] == want
+        assert [t for t, _ in five.generate_step([1, 2, 3], max_tokens=10, **kw)] == want

@@ -124,3 +124,32 @@ def test_dequant_rejects_non_uint32():
             np.zeros((2, 4), np.int32), np.ones((2, 1)), np.zeros((2, 1)),
             group_size=16,
         )
+
+
+def test_quantize_jax_matches_numpy_packer():
+    """Device-side packer must produce the identical mlx-layout triple as the
+    host packer (bench and tests both rely on it)."""
+    import jax.numpy as jnp
+
+    from mlx_sharding_tpu.ops.quant import quantize, quantize_jax
+
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((16, 128)).astype(np.float32)
+    q_np, s_np, b_np = quantize(w, group_size=64, bits=4)
+    q_j, s_j, b_j = quantize_jax(jnp.asarray(w), group_size=64, bits=4)
+    np.testing.assert_array_equal(np.asarray(q_j), q_np)
+    np.testing.assert_allclose(np.asarray(s_j), s_np.astype(np.float32), rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(b_j), b_np.astype(np.float32), rtol=1e-3)
+
+
+def test_quantize_jax_roundtrip():
+    from mlx_sharding_tpu.ops.quant import dequantize, quantize_jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(6)
+    w = rng.standard_normal((3, 8, 128)).astype(np.float32)  # stacked layers
+    q, s, b = quantize_jax(jnp.asarray(w))
+    back = np.asarray(dequantize(q, s, b, dtype=jnp.float32))
+    # 4-bit grouped affine: max error is half a quantization step per group
+    step = np.asarray(s)[..., None].repeat(64, -1).reshape(w.shape)
+    assert (np.abs(back - w) <= step * 0.51 + 1e-6).all()
