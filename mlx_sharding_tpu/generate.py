@@ -58,6 +58,26 @@ class TokenLogprobs:
     top_values: np.ndarray
 
 
+def block_lp_outputs(tok_flat, logprobs):
+    """Per-step scan outputs for a decode block when logprobs are wanted:
+    ``(tokens, chosen, top_values, top_indices)``. Single source of the
+    positional convention every engine's block program emits —
+    :func:`block_token_logprobs` is its reader."""
+    chosen = jnp.take_along_axis(
+        logprobs, tok_flat.reshape(-1, 1).astype(jnp.int32), axis=-1
+    )[:, 0]
+    top_v, top_i = jax.lax.top_k(logprobs, LOGPROB_TOPK)
+    return chosen, top_v, top_i
+
+
+def block_token_logprobs(outs, j, row=0) -> TokenLogprobs:
+    """Read one (step j, batch row) TokenLogprobs from a pulled block-output
+    tuple ``(tokens, chosen, top_values, top_indices)``."""
+    return TokenLogprobs(
+        float(outs[1][j, row]), outs[3][j, row], outs[2][j, row]
+    )
+
+
 @dataclass
 class StreamChunk:
     text: str = ""
@@ -154,11 +174,7 @@ class Generator:
                 tok, logprobs = sample_token(sub, logits[:, -1], sp, recent)
                 recent = update_recent_tokens(recent, tok)
                 if want_lp:
-                    chosen = jnp.take_along_axis(
-                        logprobs, tok[:, None].astype(jnp.int32), axis=-1
-                    )[:, 0]
-                    top_v, top_i = jax.lax.top_k(logprobs, LOGPROB_TOPK)
-                    out = (tok, chosen, top_v, top_i)
+                    out = (tok, *block_lp_outputs(tok, logprobs))
                 else:
                     out = (tok,)
                 return (tok, cache, recent, key), out
@@ -236,10 +252,7 @@ class Generator:
 
         first_lp = None
         if want_logprobs:
-            chosen = jnp.take_along_axis(
-                logprobs, tok[:, None].astype(jnp.int32), axis=-1
-            )[:, 0]
-            top_v, top_i = jax.lax.top_k(logprobs, LOGPROB_TOPK)
+            chosen, top_v, top_i = block_lp_outputs(tok, logprobs)
             first_lp = TokenLogprobs(
                 float(chosen[0]), np.asarray(top_i[0]), np.asarray(top_v[0])
             )
@@ -276,13 +289,7 @@ class Generator:
             for j in range(toks.shape[0]):
                 if emitted >= remaining:
                     break
-                lp = (
-                    TokenLogprobs(
-                        float(outs[1][j, 0]), outs[3][j, 0], outs[2][j, 0]
-                    )
-                    if want_logprobs
-                    else None
-                )
+                lp = block_token_logprobs(outs, j) if want_logprobs else None
                 yield int(toks[j, 0]), lp
                 emitted += 1
 

@@ -80,16 +80,17 @@ def _pallas_ok(m, in_dim, out_dim, group_size, bits) -> bool:
     # block defaults and min() clamping
     from mlx_sharding_tpu.ops.quant_matmul import (
         DEFAULT_BLOCK_M,
-        DEFAULT_BLOCK_OUT,
         pick_block_in,
+        pick_block_out,
     )
 
     per_word = 32 // bits
     block_in = min(pick_block_in(in_dim), in_dim)
+    block_out = pick_block_out(out_dim, block_in // per_word, min(DEFAULT_BLOCK_M, m), per_word)
     return (
         jax.default_backend() == "tpu"
         and m % min(DEFAULT_BLOCK_M, m) == 0
-        and out_dim % min(DEFAULT_BLOCK_OUT, out_dim) == 0
+        and out_dim % block_out == 0
         and in_dim % block_in == 0
         and block_in % group_size == 0
         and block_in % per_word == 0

@@ -50,13 +50,45 @@ DEFAULT_BLOCK_OUT = 128
 # 128 uint32 words. Smaller/indivisible IN dims run as one whole block.
 DEFAULT_BLOCK_IN = 1024
 
+# Per-program VMEM budget for the adaptive block picker. Decode-shape
+# profiling on the v5e showed per-program overhead dominating at the old
+# 128x128x1024 blocks (a (8192, 3072) matvec = 192 programs of ~72KB of
+# packed bytes each ran 8x off the bandwidth roofline) — so blocks grow
+# until the q tile + its fp32 expansion scratch fill a healthy VMEM slice.
+_VMEM_BUDGET_BYTES = 6 * 1024 * 1024
 
-def pick_block_in(in_dim: int) -> int:
-    """Largest legal IN block: a multiple of 1024 keeps the word lanes
-    128-aligned; otherwise the whole (unpartitioned) dim is always legal."""
-    if in_dim % DEFAULT_BLOCK_IN == 0:
-        return DEFAULT_BLOCK_IN
-    return in_dim
+
+def pick_block_in(in_dim: int, cap: int = 8192) -> int:
+    """IN block: the whole (unpartitioned) dim is always lane-legal and
+    maximizes bytes per program; partition only when the dim is too large,
+    in 1024-input steps (128 uint32 word lanes)."""
+    if in_dim <= cap or in_dim % DEFAULT_BLOCK_IN:
+        return in_dim
+    best = DEFAULT_BLOCK_IN
+    d = DEFAULT_BLOCK_IN
+    while d <= cap:
+        if in_dim % d == 0:
+            best = d
+        d += DEFAULT_BLOCK_IN
+    return best
+
+
+def pick_block_out(out_dim: int, words: int, block_m: int = 1, per_word: int = 8) -> int:
+    """Largest divisor of OUT (a multiple of 128, or the whole dim) whose
+    working set fits the per-program VMEM budget: per out row ~16 bytes per
+    word lane (q 4 + s_w/b_w 8 + one nibble plane 4), plus the activation
+    tile and accumulator scaling with block_m."""
+    fixed = block_m * (words * per_word + words) * 4  # x_r tile + x_sum
+    limit = max((_VMEM_BUDGET_BYTES - fixed) // (16 * words + 4 * block_m), 128)
+    if out_dim <= limit:
+        return out_dim
+    best = None
+    d = 128
+    while d <= limit:
+        if out_dim % d == 0:
+            best = d
+        d += 128
+    return best if best is not None else min(out_dim, DEFAULT_BLOCK_OUT)
 
 
 def _kernel(x_ref, q_ref, s_ref, b_ref, o_ref, acc_ref, *, bits, group_size):
@@ -112,7 +144,7 @@ def quant_matmul_pallas(
     group_size: int = 64,
     bits: int = 4,
     block_m: int = DEFAULT_BLOCK_M,
-    block_out: int = DEFAULT_BLOCK_OUT,
+    block_out: int | None = None,
     block_in: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
@@ -122,10 +154,12 @@ def quant_matmul_pallas(
     out_dim = q.shape[0]
     per_word = 32 // bits
     block_m = min(block_m, m)
-    block_out = min(block_out, out_dim)
     if block_in is None:
         block_in = pick_block_in(in_dim)
     block_in = min(block_in, in_dim)
+    if block_out is None:
+        block_out = pick_block_out(out_dim, block_in // per_word, block_m, per_word)
+    block_out = min(block_out, out_dim)
     if block_in % group_size or block_in % per_word:
         raise ValueError(
             f"block_in {block_in} must be a multiple of group_size "

@@ -161,6 +161,7 @@ class PipelineEngine:
         max_seq: int = 4096,
         cache_dtype=jnp.bfloat16,
         prefill_chunk: int = 256,
+        decode_block: int = 16,
     ):
         cfg = model.config
         if not (cfg.is_first_stage and cfg.is_last_stage):
@@ -175,6 +176,7 @@ class PipelineEngine:
         self.max_seq = -(-max_seq // prefill_chunk) * prefill_chunk
         self.cache_dtype = cache_dtype
         self.prefill_chunk = prefill_chunk
+        self.decode_block = decode_block
 
         S = self.num_stages
         stage_sharding = NamedSharding(mesh, P(AXIS_PP))
@@ -349,11 +351,46 @@ class PipelineEngine:
         # continuous-batching programs, built on first use by the scheduler
         self._decode_cb = None
         self._prefill_slot = None
+        self._decode_blocks: dict = {}  # (k_steps, want_lp) → jitted block
 
     def decode_cb(self):
         if self._decode_cb is None:
             self._decode_cb = self._build_decode_cb()
         return self._decode_cb
+
+    def decode_block_prog(self, k_steps: int, want_lp: bool):
+        """K single-token decode steps scanned into ONE program — the host
+        pulls tokens once per block instead of once per token (see
+        generate.Generator: over a network-attached chip the per-token host
+        pull dominates the device step). Logprob summaries (chosen + top-10
+        via lax.top_k) are computed inside the scan when requested."""
+        cache_key = (k_steps, want_lp)
+        if cache_key not in self._decode_blocks:
+            step, M, B = self._decode, self.microbatches, self.batch
+            one = jnp.asarray(1, jnp.int32)
+
+            def block(layer_params, masks, vparts, shared, tok, cache, recent, key, sp):
+                def body(carry, _):
+                    tok, cache, recent, key = carry
+                    tok, logprobs, cache, recent, key = step(
+                        layer_params, masks, vparts, shared, tok[..., None],
+                        cache, recent, key, sp, one,
+                    )
+                    if want_lp:
+                        from mlx_sharding_tpu.generate import block_lp_outputs
+
+                        out = (tok, *block_lp_outputs(tok.reshape(M * B), logprobs))
+                    else:
+                        out = (tok,)
+                    return (tok, cache, recent, key), out
+
+                (tok, cache, recent, key), outs = jax.lax.scan(
+                    body, (tok, cache, recent, key), None, length=k_steps
+                )
+                return outs, tok, cache, recent, key
+
+            self._decode_blocks[cache_key] = jax.jit(block, donate_argnums=(5, 6))
+        return self._decode_blocks[cache_key]
 
     def prefill_slot(self):
         if self._prefill_slot is None:
@@ -665,11 +702,13 @@ class PipelineEngine:
         logit_bias: Optional[dict[int, float]] = None,
         seed: Optional[int] = None,
         max_tokens: int = 256,
-        want_logprobs: bool = False,  # full (B, V) rows are always yielded
+        want_logprobs: bool = False,
     ):
         """Same contract as generate.Generator.generate_step — tokens stream
         out one at a time; every microbatch runs the same prompt (serving
-        uses M=1; M>1 is the throughput path driven via raw step calls)."""
+        uses M=1; M>1 is the throughput path driven via raw step calls).
+        ``want_logprobs`` yields TokenLogprobs summaries (device-side
+        lax.top_k, pulled per block) instead of None."""
         import time as _time
 
         sp = make_sampler_params(temperature, top_p, repetition_penalty, logit_bias)
@@ -705,15 +744,48 @@ class PipelineEngine:
             )
         tok, logprobs, recent, key = self._sample(logits, recent, key, sp)
 
-        n = 0
-        one = jnp.asarray(1, jnp.int32)
-        while True:
-            next_tok, next_logprobs, cache, recent, key = self._decode(
-                self.layer_params, self.layer_masks, self.vocab_parts,
-                self.shared_params, tok[..., None], cache, recent, key, sp, one,
+        from mlx_sharding_tpu.generate import (
+            TokenLogprobs,
+            block_lp_outputs,
+            block_token_logprobs,
+        )
+
+        first_lp = None
+        if want_logprobs:
+            chosen, tv, ti = block_lp_outputs(tok.reshape(M * B), logprobs)
+            first_lp = TokenLogprobs(
+                float(chosen[0]), np.asarray(ti[0]), np.asarray(tv[0])
             )
-            yield int(tok[0, 0]), logprobs
-            n += 1
-            if n >= max_tokens:
-                break
-            tok, logprobs = next_tok, next_logprobs
+        yield int(tok[0, 0]), first_lp
+        remaining = max_tokens - 1
+        if remaining <= 0:
+            return
+
+        # blocked decode with one-block lookahead — same RTT-amortizing
+        # structure as generate.Generator (see its docstring)
+        block = self.decode_block_prog(self.decode_block, want_logprobs)
+        n_blocks = -(-remaining // self.decode_block)
+        carry = (tok, cache, recent, key)
+
+        def dispatch(carry):
+            outs, t, c, r, k = block(
+                self.layer_params, self.layer_masks, self.vocab_parts,
+                self.shared_params, carry[0], carry[1], carry[2], carry[3], sp,
+            )
+            return outs, (t, c, r, k)
+
+        pending, carry = dispatch(carry)
+        pending = [pending]
+        emitted = 0
+        for bi in range(n_blocks):
+            if bi + 1 < n_blocks:
+                nxt, carry = dispatch(carry)
+                pending.append(nxt)
+            outs = jax.device_get(pending.pop(0))
+            toks = outs[0]  # (K, M, B)
+            for j in range(toks.shape[0]):
+                if emitted >= remaining:
+                    break
+                lp = block_token_logprobs(outs, j) if want_logprobs else None
+                yield int(toks[j, 0, 0]), lp
+                emitted += 1

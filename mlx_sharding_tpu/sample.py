@@ -92,15 +92,24 @@ def apply_repetition_penalty(
 def top_p_filter(logits: jax.Array, top_p: jax.Array) -> jax.Array:
     """Mask logits outside the top-p nucleus (ref: mlx_lm top_p_sampling used
     at shard/utils.py:136). Keeps the smallest prefix of the sorted
-    distribution whose mass reaches ``top_p``; top_p >= 1 keeps everything."""
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = (cum - probs) < top_p  # token kept iff mass before it < top_p
-    min_kept = jnp.min(
-        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
-    )
-    return jnp.where(logits >= min_kept, logits, -jnp.inf)
+    distribution whose mass reaches ``top_p``; top_p >= 1 keeps everything.
+
+    The full-vocab sort costs ~1ms/token at a 128K vocab on a v5e, so the
+    whole filter sits behind a ``lax.cond`` — requests at the top_p=1
+    default never pay for it. (Under vmap — the batched scheduler sampler —
+    cond lowers to select and both branches run, same as before.)"""
+
+    def nucleus(lo):
+        sorted_logits = jnp.sort(lo, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p  # kept iff mass before it < top_p
+        min_kept = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        return jnp.where(lo >= min_kept, lo, -jnp.inf)
+
+    return jax.lax.cond(top_p < 1.0, nucleus, lambda lo: lo, logits)
 
 
 def sample_token(
@@ -109,24 +118,32 @@ def sample_token(
     params: SamplerParams,
     recent_tokens: Optional[jax.Array] = None,  # (B, W) int32, -1 padded
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (token (B,), logprobs (B, V)). Branchless: greedy and sampled
-    paths both computed, selected by ``temperature > 0`` — so one compiled
-    program covers every request's sampler settings."""
+    """Returns (token (B,), logprobs (B, V)). Temperature / top-p are
+    dynamic scalars, so one compiled program covers every request's sampler
+    settings; the sampled branch (gumbel draw + nucleus sort) sits behind a
+    ``lax.cond`` so greedy requests — the serving default — skip it."""
     logits = logits.astype(jnp.float32)
     logits = apply_logit_bias(logits, params.bias_indices, params.bias_values)
     if recent_tokens is not None:
         logits = apply_repetition_penalty(logits, recent_tokens, params.repetition_penalty)
 
     logprobs = jax.nn.log_softmax(logits, axis=-1)
-    greedy = jnp.argmax(logits, axis=-1)
-    safe_temp = jnp.maximum(params.temperature, 1e-6)
-    # Temperature first, THEN the nucleus cut — the kept set must be computed
-    # on the tempered distribution (matches mlx_lm top_p_sampling semantics
-    # used at ref shard/utils.py:136).
-    filtered = top_p_filter(logits / safe_temp, params.top_p)
-    sampled = jax.random.categorical(key, filtered, axis=-1)
-    token = jnp.where(params.temperature > 0, sampled, greedy)
-    return token.astype(jnp.int32), logprobs
+
+    def sampled_fn(lo):
+        safe_temp = jnp.maximum(params.temperature, 1e-6)
+        # Temperature first, THEN the nucleus cut — the kept set must be
+        # computed on the tempered distribution (matches mlx_lm
+        # top_p_sampling semantics used at ref shard/utils.py:136).
+        filtered = top_p_filter(lo / safe_temp, params.top_p)
+        return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+
+    token = jax.lax.cond(
+        params.temperature > 0,
+        sampled_fn,
+        lambda lo: jnp.argmax(lo, axis=-1).astype(jnp.int32),
+        logits,
+    )
+    return token, logprobs
 
 
 def stack_sampler_params(params_list: list[SamplerParams]) -> SamplerParams:

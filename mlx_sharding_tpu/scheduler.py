@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mlx_sharding_tpu.cache import KVCache
+from mlx_sharding_tpu.generate import block_lp_outputs, block_token_logprobs
 from mlx_sharding_tpu.sample import (
     SamplerParams,
     make_sampler_params,
@@ -49,6 +50,7 @@ class _Request:
     seed: int
     max_tokens: int
     rep_context: int
+    want_logprobs: bool = False
     out: queue.Queue = field(default_factory=lambda: queue.Queue())
     cancelled: bool = False
     slot: int = -1
@@ -67,12 +69,19 @@ class ContinuousBatcher:
 
     concurrent = True
 
-    def __init__(self, engine, *, repetition_window: int = 64):
+    def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
         self.engine = engine
         self.M = engine.microbatches
         self.W = repetition_window
+        # decode steps fused per scheduler tick: the host pulls tokens once
+        # per block (the per-pull round trip otherwise gates every slot —
+        # see generate.Generator). Tradeoff: admission/cancel latency grows
+        # to a block boundary, so the serving default (8) stays below the
+        # Generator's 16.
+        self.decode_block = max(1, decode_block)
+        self._decode_block_progs: dict = {}  # want_lp → jitted block
         self._submit: queue.Queue = queue.Queue()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -111,7 +120,7 @@ class ContinuousBatcher:
         logit_bias: Optional[dict[int, float]] = None,
         seed: Optional[int] = None,
         max_tokens: int = 256,
-        want_logprobs: bool = False,  # full (B, V) rows are always yielded
+        want_logprobs: bool = False,  # yields TokenLogprobs summaries
     ):
         import time as _time
 
@@ -141,6 +150,7 @@ class ContinuousBatcher:
             seed=int(_time.time_ns()) & 0x7FFFFFFF if seed is None else seed,
             max_tokens=max_tokens,
             rep_context=min(repetition_context_size, self.W),
+            want_logprobs=want_logprobs,
         )
         self._ensure_running()
         self._submit.put(req)
@@ -260,9 +270,9 @@ class ContinuousBatcher:
 
     def _emit(self, req: _Request, token: int, logprobs):
         req.produced += 1
-        # logprobs stays a LAZY (1, V) device array — same contract as the
-        # serial generate_step; the server materializes it only when the
-        # client asked for logprobs, so no per-token full-vocab transfer
+        # decode blocks emit TokenLogprobs summaries (or None); the first
+        # token of a request still carries a lazy (1, V) device row from its
+        # prefill sample — the server handles both forms
         req.out.put((token, logprobs))
         if req.produced >= req.max_tokens:
             self._finish(req)
@@ -281,20 +291,65 @@ class ContinuousBatcher:
             if req is not None and req.cancelled:
                 self._finish(req)
 
+    def _decode_block_prog(self, want_lp: bool):
+        """``decode_block`` continuous-batching steps scanned into one
+        program; the active mask is frozen for the block (a slot finishing
+        mid-block keeps computing — its extra tokens are clamp-written into
+        its own cache region and discarded host-side, so other slots'
+        streams are unaffected and serial parity holds)."""
+        if want_lp not in self._decode_block_progs:
+            eng = self.engine
+            step, M = eng.decode_cb(), self.M
+
+            def block(layer_params, masks, vparts, shared, tok, cache, active,
+                      recent, keys, sp, rep_sizes):
+                def body(carry, _):
+                    tok, cache, recent, keys = carry
+                    tok, logprobs, cache, recent, keys = step(
+                        layer_params, masks, vparts, shared, tok, cache,
+                        active, recent, keys, sp, rep_sizes,
+                    )
+                    if want_lp:
+                        out = (tok, *block_lp_outputs(tok.reshape(M), logprobs))
+                    else:
+                        out = (tok,)
+                    return (tok, cache, recent, keys), out
+
+                (tok, cache, recent, keys), outs = jax.lax.scan(
+                    body, (tok, cache, recent, keys), None,
+                    length=self.decode_block,
+                )
+                return outs, tok, cache, recent, keys
+
+            self._decode_block_progs[want_lp] = jax.jit(
+                block, donate_argnums=(5, 7, 8)
+            )
+        return self._decode_block_progs[want_lp]
+
     def _decode_once(self):
         eng = self.engine
-        decode = eng.decode_cb()
-        tok, logprobs, self.cache, self.recent, self.keys = decode(
+        # snapshot of slots active for this block, in slot order
+        live = [
+            (slot, req) for slot, req in enumerate(self._slots)
+            if req is not None and req.prefill_pos >= req.prompt.size
+        ]
+        want_lp = any(req.want_logprobs for _, req in live)
+        block = self._decode_block_prog(want_lp)
+        outs, self.last_tok, self.cache, self.recent, self.keys = block(
             eng.layer_params, eng.layer_masks, eng.vocab_parts,
             eng.shared_params, self.last_tok, self.cache, self.active,
             self.recent, self.keys, self.sp, self.rep_sizes,
         )
-        self.last_tok = tok
-        tok_host = np.asarray(tok)
-        for slot, req in enumerate(self._slots):
-            if req is None or req.prefill_pos < req.prompt.size:
-                continue
-            self._emit(req, int(tok_host[slot, 0]), logprobs[slot : slot + 1])
+        outs = jax.device_get(outs)
+        toks = outs[0]  # (K, M, 1)
+        for j in range(toks.shape[0]):
+            for slot, req in live:
+                if req.slot != slot:  # finished (max_tokens) earlier in block
+                    continue
+                lp = None
+                if want_lp and req.want_logprobs:
+                    lp = block_token_logprobs(outs, j, slot)
+                self._emit(req, int(toks[j, slot, 0]), lp)
 
     def _tick(self):
         """One scheduler iteration: reap, assign free slots, run one prefill

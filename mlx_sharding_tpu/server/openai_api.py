@@ -106,9 +106,14 @@ class ModelProvider:
         trust_remote_paths: bool = False,
         chat_template: Optional[str] = None,
         keep_quantized: bool = False,
+        decode_block: int = 16,
     ):
         self.chat_template = chat_template
         self.keep_quantized = keep_quantized
+        # decode steps fused per program launch: 16 amortizes a network-
+        # attached chip's per-pull round trip; 1 restores strict per-token
+        # streaming granularity for a locally-attached device
+        self.decode_block = max(1, decode_block)
         self.default_model = default_model
         self.start_layer = start_layer
         self.end_layer = end_layer
@@ -195,11 +200,15 @@ class ModelProvider:
                         microbatches=self.concurrent,
                         max_seq=self.max_seq, cache_dtype=cache_dtype,
                         prefill_chunk=self.prefill_chunk,
+                        decode_block=self.decode_block,
                     )
                     if self.concurrent > 1:
                         from mlx_sharding_tpu.scheduler import ContinuousBatcher
 
-                        generator = ContinuousBatcher(generator)
+                        generator = ContinuousBatcher(
+                            generator,
+                            decode_block=min(8, self.decode_block),
+                        )
                     elif self.multihost:
                         import jax
 
@@ -215,6 +224,7 @@ class ModelProvider:
                         model, params, max_seq=self.max_seq,
                         cache_dtype=cache_dtype,
                         prefill_chunk=self.prefill_chunk,
+                        decode_block=self.decode_block,
                     )
             from transformers import AutoTokenizer
 
@@ -723,6 +733,10 @@ def main(argv=None):
                         help="continuous-batching slots: serve up to N "
                         "requests interleaved in one fused engine (N>1 "
                         "replaces the per-request generation lock)")
+    parser.add_argument("--decode-block", type=int, default=16,
+                        help="decode steps fused per program launch (token "
+                             "pulls amortize over this many tokens; set 1 "
+                             "for strict per-token streaming on a local chip)")
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
     parser.add_argument("--log-level", default="INFO")
@@ -779,6 +793,7 @@ def main(argv=None):
         tp=args.tp, ep=args.ep,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         chat_template=chat_template, keep_quantized=args.keep_quantized,
+        decode_block=args.decode_block,
     )
     if multihost:
         import jax

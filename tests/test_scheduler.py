@@ -244,3 +244,28 @@ def test_oversized_repetition_context_rejected(setup):
                 max_tokens=2,
             )
         )
+
+
+def test_concurrent_logprobs_summaries(setup):
+    """want_logprobs through the batcher: TokenLogprobs summaries from the
+    decode block, a full lazy row for the first (prefill-sampled) token."""
+    import numpy as np
+
+    from mlx_sharding_tpu.generate import TokenLogprobs
+
+    batcher, _ = setup
+    out = list(
+        batcher.generate_step([3, 1, 4], max_tokens=6, want_logprobs=True)
+    )
+    assert len(out) == 6
+    first_tok, first_lp = out[0]
+    assert first_lp is not None and not isinstance(first_lp, TokenLogprobs)
+    for tok, lp in out[1:]:
+        assert isinstance(lp, TokenLogprobs)
+        vals = np.asarray(lp.top_values)
+        assert (np.diff(vals) <= 1e-6).all()
+        assert int(lp.top_indices[0]) == tok  # greedy -> argmax is chosen
+        assert lp.chosen == pytest.approx(float(vals[0]), abs=1e-5)
+    # parity with the default path's tokens
+    plain = [t for t, _ in batcher.generate_step([3, 1, 4], max_tokens=6)]
+    assert [t for t, _ in out] == plain
