@@ -48,6 +48,11 @@ def main(argv=None):
                         help="sequence-parallel prefill over N devices (ring "
                         "attention); prompts longer than one prefill chunk "
                         "shard their sequence dim")
+    parser.add_argument("--sp-decode", action="store_true",
+                        help="with --sp: keep the KV cache sequence-sharded "
+                        "for the whole generation (distributed decode "
+                        "attention) — capacity scales with the mesh instead "
+                        "of one chip's HBM")
     parser.add_argument("--keep-quantized", action="store_true",
                         help="keep 4-bit decoder weights packed in HBM "
                         "(fused dequant-matmul) instead of dequantizing at "
@@ -60,6 +65,8 @@ def main(argv=None):
         parser.error("--tp/--ep require the fused engine")
     if args.sp and (args.stage_bounds or args.num_stages or args.tp > 1 or args.ep > 1):
         parser.error("--sp applies to the single-stage generator only")
+    if args.sp_decode and not (args.sp and args.sp > 1):
+        parser.error("--sp-decode requires --sp N (N > 1)")
 
     import jax.numpy as jnp
 
@@ -112,6 +119,7 @@ def main(argv=None):
         generator = Generator(
             model, params, max_seq=args.max_seq,
             prefill_chunk=args.prefill_chunk, sp_mesh=sp_mesh,
+            sp_decode=args.sp_decode,
         )
 
     from transformers import AutoTokenizer

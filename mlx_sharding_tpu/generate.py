@@ -78,6 +78,34 @@ def block_token_logprobs(outs, j, row=0) -> TokenLogprobs:
     )
 
 
+def blocked_token_stream(dispatch, carry, remaining, block_size, want_logprobs,
+                         tok_index=(0,)):
+    """The blocked-decode host loop shared by every engine: one-BLOCK
+    lookahead — block i+1 is dispatched (chained on block i's device-side
+    carry, no host sync) before block i's tokens are pulled, so the host
+    pull's round trip overlaps the next block's compute. Per token that
+    leaves max(step_time, RTT/block_size) instead of RTT.
+
+    ``dispatch(carry) -> (block_outputs, carry)`` launches one block;
+    ``tok_index`` selects the yielded row from the (K, …) token stack."""
+    n_blocks = -(-remaining // block_size)
+    pending, carry = dispatch(carry)
+    pending = [pending]
+    emitted = 0
+    for bi in range(n_blocks):
+        if bi + 1 < n_blocks:
+            nxt, carry = dispatch(carry)
+            pending.append(nxt)
+        outs = jax.device_get(pending.pop(0))
+        toks = outs[0]
+        for j in range(toks.shape[0]):
+            if emitted >= remaining:
+                break
+            lp = block_token_logprobs(outs, j) if want_logprobs else None
+            yield int(toks[(j, *tok_index)]), lp
+            emitted += 1
+
+
 @dataclass
 class StreamChunk:
     text: str = ""
@@ -111,15 +139,22 @@ class Generator:
         cache_dtype=jnp.bfloat16,
         prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
         sp_mesh=None,
+        sp_decode: bool = False,
         decode_block: int = DEFAULT_DECODE_BLOCK,
     ):
         self.model = model
         self.params = params
         # optional sequence-parallel prefill: prompts longer than one chunk
         # are sharded over the mesh's sp axis (ring attention) instead of
-        # looping chunks on one device — see parallel/sp_prefill.py
+        # looping chunks on one device — see parallel/sp_prefill.py.
+        # sp_decode additionally keeps the KV cache sequence-sharded for the
+        # whole generation (parallel/sp_decode.py): capacity scales with the
+        # mesh instead of one chip's HBM, removing the round-2 all-gather.
         self.sp_mesh = sp_mesh
         self._sp_prefill = None
+        self._sp_decode = None
+        if sp_decode and sp_mesh is None:
+            raise ValueError("sp_decode requires sp_mesh")
         if sp_mesh is not None:
             from mlx_sharding_tpu.parallel.sp_prefill import (
                 SpPrefill,
@@ -132,11 +167,26 @@ class Generator:
                     "parallel prefill (needs layer_attn_inputs/layer_finish "
                     "on a full first+last stage)"
                 )
-            self._sp_prefill = SpPrefill(model, params, sp_mesh, prefill_chunk)
+            self._sp_prefill = SpPrefill(
+                model, params, sp_mesh, prefill_chunk, keep_sharded=sp_decode
+            )
+            if sp_decode:
+                from mlx_sharding_tpu.parallel.sp_decode import SpDecode
+
+                self._sp_decode = SpDecode(
+                    model, self._sp_prefill.params, sp_mesh,
+                    decode_block=decode_block,
+                )
         # Round capacity up to a chunk multiple: every (possibly padded)
         # prefill chunk then writes entirely inside the buffer, so padded
-        # writes can never clamp-and-corrupt valid entries.
-        self.max_seq = -(-max_seq // prefill_chunk) * prefill_chunk
+        # writes can never clamp-and-corrupt valid entries. Sharded-decode
+        # capacity must additionally split evenly across the sp devices.
+        quantum = prefill_chunk
+        if sp_decode:
+            from mlx_sharding_tpu.parallel.mesh import AXIS_SP
+
+            quantum = sp_mesh.shape[AXIS_SP] * prefill_chunk
+        self.max_seq = -(-max_seq // quantum) * quantum
         self.batch = batch
         self.cache_dtype = cache_dtype
         self.prefill_chunk = prefill_chunk
@@ -220,8 +270,14 @@ class Generator:
                 f"capacity {self.max_seq}"
             )
 
-        cache = self.model.make_cache(self.batch, self.max_seq, self.cache_dtype)
         recent = init_recent_tokens(self.batch, repetition_context_size, prompt)
+        if self._sp_decode is not None:
+            yield from self._generate_sp(
+                prompt, recent, key, sp, max_tokens, want_logprobs
+            )
+            return
+
+        cache = self.model.make_cache(self.batch, self.max_seq, self.cache_dtype)
 
         # chunked prefill (ref does whole-prompt single shot, shard/utils.py:158;
         # chunking bounds activation memory and fixes compile shapes). Capacity
@@ -261,15 +317,6 @@ class Generator:
         if remaining <= 0:
             return
 
-        # Blocked decode with one-BLOCK lookahead: block i+1 is dispatched
-        # (chained on block i's device-side carry, no host sync) before block
-        # i's tokens are pulled, so the host pull's round trip overlaps the
-        # next block's compute. Per token that leaves
-        # max(step_time, RTT/decode_block) instead of RTT.
-        k_blk = self.decode_block
-        n_blocks = -(-remaining // k_blk)
-        carry = (tok, cache, recent, key)
-
         def dispatch(carry):
             outs, t, c, r, kk = self._decode_block(
                 self.params, carry[0], carry[1], carry[2], carry[3],
@@ -277,21 +324,48 @@ class Generator:
             )
             return outs, (t, c, r, kk)
 
-        pending, carry = dispatch(carry)
-        pending = [pending]
-        emitted = 0
-        for bi in range(n_blocks):
-            if bi + 1 < n_blocks:
-                nxt, carry = dispatch(carry)
-                pending.append(nxt)
-            outs = jax.device_get(pending.pop(0))
-            toks = outs[0]  # (K, B)
-            for j in range(toks.shape[0]):
-                if emitted >= remaining:
-                    break
-                lp = block_token_logprobs(outs, j) if want_logprobs else None
-                yield int(toks[j, 0]), lp
-                emitted += 1
+        yield from blocked_token_stream(
+            dispatch, (tok, cache, recent, key), remaining,
+            self.decode_block, want_logprobs,
+        )
+
+
+    # ------------------------------------------------------------------
+    def _generate_sp(self, prompt, recent, key, sp, max_tokens, want_logprobs):
+        """Generation over an sp-sharded KV cache: sequence-parallel prefill
+        (no gather), distributed decode attention (parallel/sp_decode.py).
+        Same blocked/lookahead host loop as the dense path."""
+        spd = self._sp_decode
+        n_prompt = prompt.shape[1]
+        # capacity holds by construction: max_seq is a quantum multiple and
+        # generate_step already checked n_prompt + max_tokens <= max_seq
+        assert self._sp_prefill.padded_len(n_prompt) <= self.max_seq
+        cache = spd.make_cache(self.batch, self.max_seq, self.cache_dtype)
+        logits, ks, vs = self._sp_prefill.prefill_sharded(prompt)
+        cache = spd.write_prefill(cache, ks, vs, n_prompt)
+        tok, logprobs, recent, key = self._sample(logits, recent, key, sp)
+
+        first_lp = None
+        if want_logprobs:
+            chosen, top_v, top_i = block_lp_outputs(tok, logprobs)
+            first_lp = TokenLogprobs(
+                float(chosen[0]), np.asarray(top_i[0]), np.asarray(top_v[0])
+            )
+        yield int(tok[0]), first_lp
+        remaining = max_tokens - 1
+        if remaining <= 0:
+            return
+
+        prog = spd.block_prog(want_logprobs)
+
+        def dispatch(carry):
+            outs, tok, k, v, off, recent, key = prog(spd.params, *carry, sp)
+            return outs, (tok, k, v, off, recent, key)
+
+        yield from blocked_token_stream(
+            dispatch, (tok, cache.k, cache.v, cache.offset, recent, key),
+            remaining, spd.decode_block, want_logprobs,
+        )
 
 
 def stream_generate(

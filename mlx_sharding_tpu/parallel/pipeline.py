@@ -747,7 +747,6 @@ class PipelineEngine:
         from mlx_sharding_tpu.generate import (
             TokenLogprobs,
             block_lp_outputs,
-            block_token_logprobs,
         )
 
         first_lp = None
@@ -761,11 +760,9 @@ class PipelineEngine:
         if remaining <= 0:
             return
 
-        # blocked decode with one-block lookahead — same RTT-amortizing
-        # structure as generate.Generator (see its docstring)
+        from mlx_sharding_tpu.generate import blocked_token_stream
+
         block = self.decode_block_prog(self.decode_block, want_logprobs)
-        n_blocks = -(-remaining // self.decode_block)
-        carry = (tok, cache, recent, key)
 
         def dispatch(carry):
             outs, t, c, r, k = block(
@@ -774,18 +771,7 @@ class PipelineEngine:
             )
             return outs, (t, c, r, k)
 
-        pending, carry = dispatch(carry)
-        pending = [pending]
-        emitted = 0
-        for bi in range(n_blocks):
-            if bi + 1 < n_blocks:
-                nxt, carry = dispatch(carry)
-                pending.append(nxt)
-            outs = jax.device_get(pending.pop(0))
-            toks = outs[0]  # (K, M, B)
-            for j in range(toks.shape[0]):
-                if emitted >= remaining:
-                    break
-                lp = block_token_logprobs(outs, j) if want_logprobs else None
-                yield int(toks[j, 0, 0]), lp
-                emitted += 1
+        yield from blocked_token_stream(
+            dispatch, (tok, cache, recent, key), remaining,
+            self.decode_block, want_logprobs, tok_index=(0, 0),
+        )

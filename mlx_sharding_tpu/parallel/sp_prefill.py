@@ -10,10 +10,13 @@ with a streaming-softmax accumulator — exact, no T×T anything), and the MLP
 halves stay local. One program prefills the entire prompt with per-device
 activation memory O(T/S).
 
-The resulting per-layer K/V (already rotated) is all-gathered into the
-standard decode cache, so generation continues on the ordinary single-
-device/pipeline decode path. Contract: bit-compatible logits with the
-dense prefill (tested sp=4 vs sp=1 in tests/test_sp_prefill.py).
+The resulting per-layer K/V (already rotated) either all-gathers into the
+standard decode cache (default: generation continues on the ordinary
+single-device/pipeline decode path) or — ``keep_sharded`` — stays
+sequence-sharded and feeds ``parallel.sp_decode``'s distributed decode,
+which removes the single-chip KV bound entirely. Contract: bit-compatible
+logits with the dense prefill (tested sp=4 vs sp=1 in
+tests/test_sp_prefill.py; decode parity in tests/test_sp_decode.py).
 
 Currently wired for the Llama family (layer_attn_inputs/layer_finish
 hooks); other architectures keep the chunked path.
@@ -41,11 +44,14 @@ def supports_sp_prefill(model) -> bool:
     )
 
 
-def build_sp_prefill(model, mesh: Mesh):
+def build_sp_prefill(model, mesh: Mesh, gather: bool = True):
     """Returns ``fn(params, tokens (B, T_padded), n_valid) -> (logits (B,V),
-    ks, vs)`` where ks/vs are (L, B, T_padded, Hkv, D) full gathered K/V.
-    T_padded must divide by the sp size; positions >= n_valid are padding
-    (their K/V land in cache rows the decode loop overwrites/never attends).
+    ks, vs)`` where ks/vs are (L, B, T_padded, Hkv, D) K/V — all-gathered
+    when ``gather`` (single-device decode cache) or left sequence-sharded
+    over sp (``parallel.sp_decode`` keeps them sharded for the whole
+    generation). T_padded must divide by the sp size; positions >= n_valid
+    are padding (their K/V land in cache rows the decode loop
+    overwrites/never attends).
     """
 
     def body(params, tokens, n_valid):
@@ -69,13 +75,15 @@ def build_sp_prefill(model, mesh: Mesh):
         owner = (n_valid - 1) // t_local == idx
         logits = jax.lax.psum(jnp.where(owner, logits, 0.0), AXIS_SP)
 
-        # (L, B, T_local, H, D) -> full (L, B, T, H, D) for the decode cache
-        ks = jax.lax.all_gather(ks, AXIS_SP, axis=2, tiled=True)
-        vs = jax.lax.all_gather(vs, AXIS_SP, axis=2, tiled=True)
+        if gather:
+            # (L, B, T_local, H, D) -> full (L, B, T, H, D) for the decode cache
+            ks = jax.lax.all_gather(ks, AXIS_SP, axis=2, tiled=True)
+            vs = jax.lax.all_gather(vs, AXIS_SP, axis=2, tiled=True)
         return logits, ks, vs
 
     seq_spec = P(None, AXIS_SP)
     rep = P()
+    kv_out = rep if gather else P(None, None, AXIS_SP)
 
     def make(params_tree):
         return jax.jit(
@@ -83,7 +91,7 @@ def build_sp_prefill(model, mesh: Mesh):
                 body,
                 mesh=mesh,
                 in_specs=(jax.tree.map(lambda _: rep, params_tree), seq_spec, rep),
-                out_specs=(rep, rep, rep),
+                out_specs=(rep, kv_out, kv_out),
                 check_vma=False,
             )
         )
@@ -102,12 +110,14 @@ class SpPrefill:
     device next to the generator's own copy.
     """
 
-    def __init__(self, model, params, mesh: Mesh, prefill_chunk: int):
+    def __init__(self, model, params, mesh: Mesh, prefill_chunk: int,
+                 keep_sharded: bool = False):
         self.model = model
         self.mesh = mesh
         self.size = mesh.shape[AXIS_SP]
         self.quantum = self.size * prefill_chunk
-        self._make = build_sp_prefill(model, mesh)
+        self.keep_sharded = keep_sharded
+        self._make = build_sp_prefill(model, mesh, gather=not keep_sharded)
         self._fn = None  # shape-polymorphic jit; compiles per T_pad bucket
         self._rep = NamedSharding(mesh, P())
         self._seq = NamedSharding(mesh, P(None, AXIS_SP))
@@ -127,6 +137,20 @@ class SpPrefill:
 
     def padded_len(self, t: int) -> int:
         return -(-t // self.quantum) * self.quantum
+
+    def prefill_sharded(self, prompt: np.ndarray):
+        """Sharded-mode prefill: returns (logits (B, V) replicated, ks, vs
+        (L, B, T_pad, H, D) sequence-sharded over sp). The caller installs
+        ks/vs into an sp-sharded decode cache (SpDecode.write_prefill)."""
+        t = prompt.shape[1]
+        tokens = np.pad(prompt, ((0, 0), (0, self.padded_len(t) - t)))
+        if self._fn is None:
+            self._fn = self._make(self.params)
+        return self._fn(
+            self.params,
+            jax.device_put(jnp.asarray(tokens), self._seq),
+            jax.device_put(jnp.asarray(t, jnp.int32), self._rep),
+        )
 
     def __call__(self, prompt: np.ndarray, cache: KVCache):
         """Prefill ``prompt`` (B, T) into ``cache``; returns (logits, cache).
