@@ -162,6 +162,8 @@ class PipelineEngine:
         cache_dtype=jnp.bfloat16,
         prefill_chunk: int = 256,
         decode_block: int = 16,
+        pool_pages: Optional[int] = None,
+        page_size: Optional[int] = None,
     ):
         cfg = model.config
         if not (cfg.is_first_stage and cfg.is_last_stage):
@@ -177,6 +179,28 @@ class PipelineEngine:
         self.cache_dtype = cache_dtype
         self.prefill_chunk = prefill_chunk
         self.decode_block = decode_block
+
+        # Paged KV (continuous-batching only): slots address up to
+        # max_seq/page_size pages out of a SHARED pool of ``pool_pages``
+        # physical pages per stage, instead of each owning a dense max_seq
+        # allocation. The scheduler reserves pages at admission — mixed-
+        # length workloads pack the pool far tighter than M x max_seq.
+        self.paged = pool_pages is not None
+        self.page_size = page_size or prefill_chunk
+        self.pool_pages = pool_pages or 0
+        if self.paged:
+            if self.page_size % prefill_chunk:
+                raise ValueError(
+                    f"page_size {self.page_size} must be a multiple of the "
+                    f"prefill chunk {prefill_chunk} (chunk writes must stay "
+                    "inside one page)"
+                )
+            if self.max_seq % self.page_size:
+                raise ValueError(
+                    f"page_size {self.page_size} must divide max_seq "
+                    f"{self.max_seq}"
+                )
+        self.slot_pages = self.max_seq // self.page_size  # table width
 
         S = self.num_stages
         stage_sharding = NamedSharding(mesh, P(AXIS_PP))
@@ -420,6 +444,44 @@ class PipelineEngine:
             ),
         )
 
+    def init_cache_paged(self) -> tuple[KVCache, jax.Array]:
+        """Shared page pool + per-slot page table for continuous batching.
+
+        Pool: (S, L, pool_pages+1, B, page, H, D) per stage — the last page
+        is scratch: every unallocated table entry points there, so writes
+        from inactive ticks and past-a-request's-reservation overshoot land
+        harmlessly (the dense layout's scratch-slice trick, per page).
+        Table: (M+1, slot_pages) int32 — row M is the all-scratch row
+        garbage ticks route to. Table entries are POOL page ids; position p
+        of slot m lives at pool page table[m][p // page_size], row
+        p % page_size."""
+        if not self.paged:
+            raise ValueError("engine built without pool_pages")
+        cfg = self.model.config
+        hd = self.model.cache_head_dim()
+        k_dim, v_dim = (hd, hd) if not isinstance(hd, (tuple, list)) else hd
+        S, L, M, B = (
+            self.num_stages, self.layers_per_stage, self.microbatches,
+            self.batch,
+        )
+        shape = (
+            S, L, self.pool_pages + 1, B, self.page_size,
+            self.model.cache_num_heads(),
+        )
+        sharding = NamedSharding(self.mesh, self._kv_spec)
+        cache = KVCache(
+            k=jax.device_put(jnp.zeros((*shape, k_dim), self.cache_dtype), sharding),
+            v=jax.device_put(jnp.zeros((*shape, v_dim), self.cache_dtype), sharding),
+            offset=jax.device_put(
+                jnp.zeros((M,), jnp.int32), NamedSharding(self.mesh, P())
+            ),
+        )
+        table = jax.device_put(
+            jnp.full((M + 1, self.slot_pages), self.pool_pages, jnp.int32),
+            NamedSharding(self.mesh, P()),
+        )
+        return cache, table
+
     # ----------------------------------------------------- vocab sharding
     def _vs_embed(self, s, vparts, ids):
         """Embedding lookup against this device's vocab shard + psum to
@@ -447,11 +509,63 @@ class PipelineEngine:
         return full[..., : self.vocab_size].astype(jnp.float32)
 
     # ------------------------------------------------------------------
+    def _paged_read(self, k, v, table_row):
+        """Gather one slot's pages into the contiguous (L, B, S_virt, H, D)
+        view run_layers expects. k/v: local pool (L, P+1, B, page, H, D)."""
+        outs = []
+        for pool in (k, v):
+            g = jnp.take(pool, table_row, axis=1)  # (L, SPG, B, page, H, D)
+            g = jnp.moveaxis(g, 1, 2)  # (L, B, SPG, page, H, D)
+            outs.append(g.reshape(*g.shape[:2], -1, *g.shape[4:]))
+        return tuple(outs)
+
+    def _paged_writeback(self, pool, buf, table_row, offset):
+        """Scatter the one dirty page (the one containing ``offset``) of a
+        slot's contiguous buffer back into the pool. Chunk writes never
+        straddle pages (page_size % prefill_chunk == 0 and offsets are
+        chunk-aligned), so a single page is always enough."""
+        l, b = buf.shape[:2]
+        page = self.page_size
+        buf6 = buf.reshape(l, b, self.slot_pages, page, *buf.shape[3:])
+        pidx = offset // page
+        dirty = jax.lax.dynamic_index_in_dim(buf6, pidx, 2, keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(
+            pool, dirty.astype(pool.dtype), table_row[pidx], 1
+        )
+
+    def _kv_read(self, paged, k, v, table, m_write):
+        """One slot's contiguous KV view: page-table gather (paged) or
+        slot-axis index (dense). Returns (k_m, v_m, table_row)."""
+        if paged:
+            row = table[m_write]
+            k_m, v_m = self._paged_read(k, v, row)
+            return k_m, v_m, row
+        k_m = jax.lax.dynamic_index_in_dim(k, m_write, 1, keepdims=False)
+        v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
+        return k_m, v_m, None
+
+    def _kv_write(self, paged, k, v, k_m, v_m, row, m_write, offset):
+        """Inverse of _kv_read: scatter the dirty page back (paged) or
+        update the slot slice (dense)."""
+        if paged:
+            return (
+                self._paged_writeback(k, k_m, row, offset),
+                self._paged_writeback(v, v_m, row, offset),
+            )
+        return (
+            jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1),
+            jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1),
+        )
+
     def _build_step(self, t_len: int, with_sampling: bool):
+        smapped = self._build_smapped(t_len)
+        return self._finish_step(smapped, t_len, with_sampling)
+
+    def _build_smapped(self, t_len: int, paged: bool = False):
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
         rl_kwargs = self._rl_kwargs
 
-        def body(layer_params, masks, vparts, shared, tokens, k, v, offsets, active, n_valid):
+        def body(layer_params, masks, vparts, shared, tokens, k, v, offsets, active, n_valid, table):
             # Per-device views: layer_params (1, L, …) → (L, …); k/v
             # (1, L, M+1, B, seq, H, D) → (L, M+1, …). ``offsets`` is (M,) —
             # each slot's sequence position — and ``active`` (M,) bool marks
@@ -480,17 +594,16 @@ class PipelineEngine:
                 h_first = self._vs_embed(s, vparts, tok_m).astype(h_buf.dtype)
                 h_in = jnp.where(s == 0, h_first, h_buf)
 
-                # scratch slice M swallows non-real writes
+                # scratch slice M swallows non-real writes (paged mode:
+                # table row M routes every page to the scratch pool page)
                 m_write = jnp.where(is_real, m, M)
                 offset = offsets_pad[m_write]
-                k_m = jax.lax.dynamic_index_in_dim(k, m_write, 1, keepdims=False)
-                v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
+                k_m, v_m, row = self._kv_read(paged, k, v, table, m_write)
                 h_out, k_m, v_m = model.run_layers(
                     layer_params, h_in, k_m, v_m, offset, mask=masks,
                     **rl_kwargs,
                 )
-                k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
-                v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
+                k, v = self._kv_write(paged, k, v, k_m, v_m, row, m_write, offset)
 
                 # bank the last-valid-position hidden state on the final stage
                 last = jax.lax.dynamic_index_in_dim(h_out, n_valid - 1, 1, keepdims=False)
@@ -514,7 +627,7 @@ class PipelineEngine:
             return logits, k[None], v[None]
 
         spec_stage, spec_rep = P(AXIS_PP), P()
-        smapped = jax.shard_map(
+        inner = jax.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(
@@ -528,13 +641,28 @@ class PipelineEngine:
                 spec_rep,  # offsets (M,)
                 spec_rep,  # active (M,)
                 spec_rep,  # n_valid
+                spec_rep,  # page table (paged mode; dummy otherwise)
             ),
             out_specs=(spec_rep, self._kv_spec, self._kv_spec),
             check_vma=False,
         )
+        if paged:
+            return inner
+        dummy_table = jnp.zeros((1, 1), jnp.int32)
+
+        def smapped(layer_params, masks, vparts, shared, tokens, k, v, offsets,
+                    active, n_valid):
+            return inner(
+                layer_params, masks, vparts, shared, tokens, k, v, offsets,
+                active, n_valid, dummy_table,
+            )
+
         if t_len == 1:
             self._smapped_decode = smapped  # shared by the continuous-batching step
+        return smapped
 
+    def _finish_step(self, smapped, t_len: int, with_sampling: bool):
+        M, B = self.microbatches, self.batch
         all_active = jnp.ones((M,), bool)
 
         if with_sampling:
@@ -569,19 +697,27 @@ class PipelineEngine:
         on active slots, per-slot sampler params and PRNG keys (each slot
         reproduces the solo request with that seed), logits of inactive slots
         sampled-but-ignored. Reuses the same shard_map body as the uniform
-        decode; only the host-visible wrapper differs."""
-        smapped, M, B = self._smapped_decode, self.microbatches, self.batch
+        decode; only the host-visible wrapper differs. In paged mode the
+        step takes the page table as an extra trailing argument."""
+        M, B = self.microbatches, self.batch
         if B != 1:
             raise ValueError("continuous batching expects batch=1 per slot")
+        if self.paged:
+            inner = self._build_smapped(t_len=1, paged=True)
+        else:
+            if self._smapped_decode is None:
+                self._build_step(t_len=1, with_sampling=True)
+            dense = self._smapped_decode
+            inner = lambda *args: dense(*args[:-1])  # drop the table arg
 
         def step(
             layer_params, masks, vparts, shared, tokens, cache, active, recent,
-            keys, sp, rep_sizes,
+            keys, sp, rep_sizes, table,
         ):
             one = jnp.asarray(1, jnp.int32)
-            logits, k, v = smapped(
+            logits, k, v = inner(
                 layer_params, masks, vparts, shared, tokens, cache.k, cache.v,
-                cache.offset, active, one,
+                cache.offset, active, one, table,
             )
             split = jax.vmap(jax.random.split)(keys)  # (M, 2, 2)
             keys, subs = split[:, 0], split[:, 1]
@@ -611,7 +747,9 @@ class PipelineEngine:
         rl_kwargs = self._rl_kwargs
         t_len = self.prefill_chunk
 
-        def body(layer_params, masks, vparts, shared, tokens, slot, k, v, offsets, n_valid):
+        paged = self.paged
+
+        def body(layer_params, masks, vparts, shared, tokens, slot, k, v, offsets, n_valid, table):
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
             masks = jax.tree.map(lambda x: x[0], masks)
             vparts = jax.tree.map(lambda x: x[0], vparts)
@@ -628,14 +766,12 @@ class PipelineEngine:
                 h_in = jnp.where(s == 0, h_first, h_buf)
                 m_write = jnp.where(is_real, slot, M)
                 offset = offsets_pad[m_write]
-                k_m = jax.lax.dynamic_index_in_dim(k, m_write, 1, keepdims=False)
-                v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
+                k_m, v_m, row = self._kv_read(paged, k, v, table, m_write)
                 h_out, k_m, v_m = model.run_layers(
                     layer_params, h_in, k_m, v_m, offset, mask=masks,
                     **rl_kwargs,
                 )
-                k = jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1)
-                v = jax.lax.dynamic_update_index_in_dim(v, v_m, m_write, 1)
+                k, v = self._kv_write(paged, k, v, k_m, v_m, row, m_write, offset)
 
                 last = jax.lax.dynamic_index_in_dim(h_out, n_valid - 1, 1, keepdims=False)
                 out = jnp.where(
@@ -667,15 +803,18 @@ class PipelineEngine:
                 self._kv_spec,  # v
                 spec_rep,  # offsets
                 spec_rep,  # n_valid
+                spec_rep,  # page table (paged mode; dummy otherwise)
             ),
             out_specs=(spec_rep, self._kv_spec, self._kv_spec),
             check_vma=False,
         )
+        dummy_table = jnp.zeros((1, 1), jnp.int32)
 
-        def step(layer_params, masks, vparts, shared, tokens, slot, cache, n_valid):
+        def step(layer_params, masks, vparts, shared, tokens, slot, cache, n_valid,
+                 table=None):
             logits, k, v = smapped(
                 layer_params, masks, vparts, shared, tokens, slot, cache.k, cache.v,
-                cache.offset, n_valid,
+                cache.offset, n_valid, dummy_table if table is None else table,
             )
             offsets = cache.offset.at[slot].add(n_valid)
             return logits, KVCache(k=k, v=v, offset=offsets)

@@ -69,12 +69,21 @@ class ContinuousBatcher:
 
     concurrent = True
 
-    def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8):
+    def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
+                 policy: str = "fifo"):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
+        if policy not in ("fifo", "first_fit"):
+            raise ValueError(f"unknown admission policy {policy!r}")
         self.engine = engine
         self.M = engine.microbatches
         self.W = repetition_window
+        # Admission: "fifo" is strict arrival order (a request that doesn't
+        # fit blocks everything behind it — predictable, starvation-free);
+        # "first_fit" lets later requests that DO fit (free slot + enough
+        # pages) jump a blocked head. Only meaningful with a paged pool.
+        self.policy = policy
+        self._waiting: list[_Request] = []
         # decode steps fused per scheduler tick: the host pulls tokens once
         # per block (the per-pull round trip otherwise gates every slot —
         # see generate.Generator). Tradeoff: admission/cancel latency grows
@@ -87,8 +96,24 @@ class ContinuousBatcher:
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
 
-        # device-side per-slot state
-        self.cache: KVCache = engine.init_cache()
+        # device-side per-slot state. Paged engines share a page pool across
+        # slots: the scheduler RESERVES a request's full page need (prompt +
+        # max_tokens) at admission, so allocation can never fail mid-stream
+        # and oversubscription deadlock is impossible by construction; what
+        # paging buys is packing mixed-length requests into far less HBM
+        # than M dense max_seq allocations.
+        self.paged = getattr(engine, "paged", False)
+        if self.paged:
+            self.cache, self.table = engine.init_cache_paged()
+            self._free_pages = list(range(engine.pool_pages - 1, -1, -1))
+            self._pages_of: dict[int, list[int]] = {}  # slot → reserved pages
+            self.pages_high_water = 0
+            self._set_table_row = jax.jit(
+                lambda t, slot, row: t.at[slot].set(row)
+            )
+        else:
+            self.cache = engine.init_cache()
+            self.table = jnp.zeros((1, 1), jnp.int32)  # dummy for the step arg
         self.recent = jnp.full((self.M, self.W), -1, jnp.int32)
         self.keys = jnp.stack([jax.random.PRNGKey(0)] * self.M)
         # bias width 512 covers OpenAI's documented logit_bias cap (300);
@@ -129,6 +154,12 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_tokens ({max_tokens}) exceeds "
                 f"KV capacity {self.engine.max_seq}"
+            )
+        if self.paged and self._pages_needed(prompt.size, max_tokens) > self.engine.pool_pages:
+            raise ValueError(
+                f"request needs {self._pages_needed(prompt.size, max_tokens)} "
+                f"pages, pool has {self.engine.pool_pages} — it could never "
+                "be admitted"
             )
         sp = make_sampler_params(temperature, top_p, repetition_penalty, logit_bias)
         if sp.bias_indices.shape[0] > self.sp.bias_indices.shape[1]:
@@ -171,8 +202,20 @@ class ContinuousBatcher:
         return (
             self.M,
             sum(1 for r in self._slots if r is not None),
-            self._submit.qsize(),
+            self._submit.qsize() + len(self._waiting),
         )
+
+    def page_stats(self) -> Optional[tuple[int, int, int]]:
+        """(pool pages, pages in use, high-water mark) for /metrics — the
+        KV-HBM story of a paged pool; None on dense engines."""
+        if not self.paged:
+            return None
+        total = self.engine.pool_pages
+        return (total, total - len(self._free_pages), self.pages_high_water)
+
+    def _pages_needed(self, n_prompt: int, max_tokens: int) -> int:
+        page = self.engine.page_size
+        return -(-(n_prompt + max_tokens) // page)
 
     def close(self):
         self._stop = True
@@ -218,6 +261,20 @@ class ContinuousBatcher:
         per scheduler tick — so active slots keep decoding during admission."""
         W = self.W
         prompt = req.prompt
+        if self.paged:
+            n = self._pages_needed(prompt.size, req.max_tokens)
+            pages = [self._free_pages.pop() for _ in range(n)]
+            self._pages_of[slot] = pages
+            in_use = self.engine.pool_pages - len(self._free_pages)
+            self.pages_high_water = max(self.pages_high_water, in_use)
+            # unreserved tail entries stay at the scratch page: overshoot
+            # writes past the reservation land there harmlessly
+            row = np.full((self.engine.slot_pages,), self.engine.pool_pages,
+                          np.int32)
+            row[:n] = pages
+            self.table = self._set_table_row(
+                self.table, jnp.asarray(slot, jnp.int32), jnp.asarray(row)
+            )
         self.cache = self.cache._replace(
             offset=self.cache.offset.at[slot].set(0)
         )
@@ -241,6 +298,7 @@ class ContinuousBatcher:
             eng.layer_params, eng.layer_masks, eng.vocab_parts,
             eng.shared_params, jnp.asarray(chunk[None]), slot_arr, self.cache,
             jnp.asarray(n_valid, jnp.int32),
+            self.table if self.paged else None,
         )
         req.prefill_pos += n_valid
         if req.prefill_pos < req.prompt.size:
@@ -282,6 +340,11 @@ class ContinuousBatcher:
             self.active = self._set_active(
                 self.active, jnp.asarray(req.slot, jnp.int32), False
             )
+            if self.paged:
+                # the slot is inactive from the next block on (garbage ticks
+                # route to the scratch table row), so its pages can be
+                # reused immediately
+                self._free_pages.extend(self._pages_of.pop(req.slot, []))
             self._slots[req.slot] = None
             req.slot = -1
         req.out.put(None)
@@ -302,12 +365,12 @@ class ContinuousBatcher:
             step, M = eng.decode_cb(), self.M
 
             def block(layer_params, masks, vparts, shared, tok, cache, active,
-                      recent, keys, sp, rep_sizes):
+                      recent, keys, sp, rep_sizes, table):
                 def body(carry, _):
                     tok, cache, recent, keys = carry
                     tok, logprobs, cache, recent, keys = step(
                         layer_params, masks, vparts, shared, tok, cache,
-                        active, recent, keys, sp, rep_sizes,
+                        active, recent, keys, sp, rep_sizes, table,
                     )
                     if want_lp:
                         out = (tok, *block_lp_outputs(tok.reshape(M), logprobs))
@@ -338,7 +401,7 @@ class ContinuousBatcher:
         outs, self.last_tok, self.cache, self.recent, self.keys = block(
             eng.layer_params, eng.layer_masks, eng.vocab_parts,
             eng.shared_params, self.last_tok, self.cache, self.active,
-            self.recent, self.keys, self.sp, self.rep_sizes,
+            self.recent, self.keys, self.sp, self.rep_sizes, self.table,
         )
         outs = jax.device_get(outs)
         toks = outs[0]  # (K, M, 1)
@@ -351,21 +414,52 @@ class ContinuousBatcher:
                     lp = block_token_logprobs(outs, j, slot)
                 self._emit(req, int(toks[j, slot, 0]), lp)
 
+    def _fits(self, req: _Request) -> bool:
+        if not self.paged:
+            return True
+        return (
+            self._pages_needed(req.prompt.size, req.max_tokens)
+            <= len(self._free_pages)
+        )
+
+    def _admit_waiting(self):
+        """Admit from the waiting line into free slots under the admission
+        policy. fifo: strict order, a non-fitting head blocks the line.
+        first_fit: scan past non-fitting requests (they keep their place)."""
+        # reap dead waiters first — under fifo a non-fitting head would
+        # otherwise shadow a cancelled request behind it forever
+        for req in [r for r in self._waiting if r.cancelled]:
+            self._waiting.remove(req)
+            req.out.put(None)
+        while None in self._slots and self._waiting:
+            pick = None
+            for i, req in enumerate(self._waiting):
+                if self._fits(req):
+                    pick = i
+                    break
+                if self.policy == "fifo":
+                    return  # head of line doesn't fit; hold the line
+            if pick is None:
+                return  # first_fit: nothing waiting fits right now
+            self._assign_slot(self._waiting.pop(pick), self._slots.index(None))
+
+    def _drain_submissions(self, block: bool = False):
+        try:
+            while True:
+                req = self._submit.get(timeout=0.2) if block else self._submit.get_nowait()
+                block = False
+                if req is not None:
+                    self._waiting.append(req)
+        except queue.Empty:
+            pass
+
     def _tick(self):
-        """One scheduler iteration: reap, assign free slots, run one prefill
-        chunk per mid-admission request, one decode step for active slots."""
+        """One scheduler iteration: reap, admit waiting requests into free
+        slots (policy + page-reservation gated), run one prefill chunk per
+        mid-admission request, one decode block for active slots."""
         self._reap_cancelled()
-        while None in self._slots:
-            try:
-                req = self._submit.get_nowait()
-            except queue.Empty:
-                break
-            if req is None:
-                continue
-            if req.cancelled:
-                req.out.put(None)
-                continue
-            self._assign_slot(req, self._slots.index(None))
+        self._drain_submissions()
+        self._admit_waiting()
         prefilling = [
             r for r in self._slots
             if r is not None and r.prefill_pos < r.prompt.size
@@ -376,13 +470,8 @@ class ContinuousBatcher:
             self._decode_once()
         elif not any(self._slots):
             # idle: block until the next request arrives
-            try:
-                req = self._submit.get(timeout=0.2)
-            except queue.Empty:
-                return
-            if req is None or req.cancelled:
-                return
-            self._assign_slot(req, self._slots.index(None))
+            self._drain_submissions(block=True)
+            self._admit_waiting()
 
     def _fail_all(self, exc: BaseException):
         for slot, req in enumerate(self._slots):
@@ -391,6 +480,13 @@ class ContinuousBatcher:
                 self._slots[slot] = None
                 req.out.put(exc)
         self.active = jnp.zeros_like(self.active)
+        if self.paged:
+            for pages in self._pages_of.values():
+                self._free_pages.extend(pages)
+            self._pages_of.clear()
+        for req in self._waiting:
+            req.out.put(exc)
+        self._waiting.clear()
         while True:
             try:
                 req = self._submit.get_nowait()
@@ -410,6 +506,9 @@ class ContinuousBatcher:
         for req in list(self._slots):
             if req is not None:
                 self._finish(req)
+        for req in self._waiting:
+            req.out.put(None)
+        self._waiting.clear()
         while True:
             try:
                 req = self._submit.get_nowait()

@@ -107,6 +107,9 @@ class ModelProvider:
         chat_template: Optional[str] = None,
         keep_quantized: bool = False,
         decode_block: int = 16,
+        paged_pool: Optional[int] = None,
+        page_size: Optional[int] = None,
+        admission_policy: str = "fifo",
     ):
         self.chat_template = chat_template
         self.keep_quantized = keep_quantized
@@ -114,6 +117,11 @@ class ModelProvider:
         # attached chip's per-pull round trip; 1 restores strict per-token
         # streaming granularity for a locally-attached device
         self.decode_block = max(1, decode_block)
+        # paged KV pool (continuous batching): pages shared across slots,
+        # reservation admission — see scheduler.ContinuousBatcher
+        self.paged_pool = paged_pool
+        self.page_size = page_size
+        self.admission_policy = admission_policy
         self.default_model = default_model
         self.start_layer = start_layer
         self.end_layer = end_layer
@@ -201,6 +209,8 @@ class ModelProvider:
                         max_seq=self.max_seq, cache_dtype=cache_dtype,
                         prefill_chunk=self.prefill_chunk,
                         decode_block=self.decode_block,
+                        pool_pages=self.paged_pool if self.concurrent > 1 else None,
+                        page_size=self.page_size,
                     )
                     if self.concurrent > 1:
                         from mlx_sharding_tpu.scheduler import ContinuousBatcher
@@ -208,6 +218,7 @@ class ModelProvider:
                         generator = ContinuousBatcher(
                             generator,
                             decode_block=min(8, self.decode_block),
+                            policy=self.admission_policy,
                         )
                     elif self.multihost:
                         import jax
@@ -733,6 +744,18 @@ def main(argv=None):
                         help="continuous-batching slots: serve up to N "
                         "requests interleaved in one fused engine (N>1 "
                         "replaces the per-request generation lock)")
+    parser.add_argument("--paged-pool", type=int, default=None,
+                        help="with --concurrent: share a KV pool of N pages "
+                             "across slots (reservation admission) instead "
+                             "of dense per-slot max-seq allocations")
+    parser.add_argument("--page-size", type=int, default=None,
+                        help="KV page size in tokens (default: the prefill "
+                             "chunk); must be a chunk multiple")
+    parser.add_argument("--admission-policy", choices=("fifo", "first_fit"),
+                        default="fifo",
+                        help="waiting-line policy when a request doesn't fit "
+                             "the page pool: strict order vs let smaller "
+                             "requests jump a blocked head")
     parser.add_argument("--decode-block", type=int, default=16,
                         help="decode steps fused per program launch (token "
                              "pulls amortize over this many tokens; set 1 "
@@ -785,6 +808,12 @@ def main(argv=None):
     chat_template = args.chat_template
     if chat_template and chat_template.startswith("@"):
         chat_template = Path(chat_template[1:]).read_text()
+    if args.paged_pool and args.concurrent <= 1:
+        parser.error("--paged-pool requires --concurrent N (N > 1)")
+    if args.page_size and not args.paged_pool:
+        parser.error("--page-size requires --paged-pool")
+    if args.admission_policy != "fifo" and not args.paged_pool:
+        parser.error("--admission-policy requires --paged-pool")
     multihost = bool(args.coordinator) and (args.num_processes or 1) > 1
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
@@ -793,7 +822,8 @@ def main(argv=None):
         tp=args.tp, ep=args.ep,
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         chat_template=chat_template, keep_quantized=args.keep_quantized,
-        decode_block=args.decode_block,
+        decode_block=args.decode_block, paged_pool=args.paged_pool,
+        page_size=args.page_size, admission_policy=args.admission_policy,
     )
     if multihost:
         import jax

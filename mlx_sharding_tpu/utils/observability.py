@@ -125,4 +125,15 @@ class ServingMetrics:
                     "# TYPE mst_batch_queue_depth gauge",
                     f"mst_batch_queue_depth {queued}",
                 ]
+                pages = getattr(b, "page_stats", lambda: None)()
+                if pages is not None:
+                    total, in_use, high = pages
+                    lines += [
+                        "# TYPE mst_kv_pool_pages gauge",
+                        f"mst_kv_pool_pages {total}",
+                        "# TYPE mst_kv_pool_pages_in_use gauge",
+                        f"mst_kv_pool_pages_in_use {in_use}",
+                        "# TYPE mst_kv_pool_pages_high_water gauge",
+                        f"mst_kv_pool_pages_high_water {high}",
+                    ]
         return "\n".join(lines) + "\n"
