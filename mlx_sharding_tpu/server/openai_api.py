@@ -264,6 +264,7 @@ class APIHandler(BaseHTTPRequestHandler):
     gen_lock: threading.Lock = None
     metrics: ServingMetrics = None
     profile_dir: Optional[str] = None
+    api_key: Optional[str] = None
     protocol_version = "HTTP/1.1"
 
     # ------------------------------------------------------------- helpers
@@ -336,6 +337,19 @@ class APIHandler(BaseHTTPRequestHandler):
         }
         if route not in handlers:
             return self._error(404, f"unknown route {route}")
+        if self.api_key:
+            # the reference UI sends Authorization: Bearer <key>
+            # (ref shard/static/app.js:151) but its server never checks it;
+            # here --api-key makes the check real. Static/health/metrics
+            # stay open — only the generation endpoints are gated.
+            import hmac
+
+            auth = self.headers.get("Authorization", "")
+            if not hmac.compare_digest(auth, f"Bearer {self.api_key}"):
+                return self._json(401, {"error": {
+                    "message": "invalid or missing API key",
+                    "type": "authentication_error", "code": 401,
+                }})
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -694,6 +708,7 @@ def make_server(
     host: str = "127.0.0.1",
     port: int = 8080,
     profile_dir: Optional[str] = None,
+    api_key: Optional[str] = None,
 ):
     handler = type(
         "BoundAPIHandler",
@@ -707,6 +722,7 @@ def make_server(
                 else None
             ),
             "profile_dir": profile_dir,
+            "api_key": api_key,
         },
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -762,6 +778,9 @@ def main(argv=None):
                              "for strict per-token streaming on a local chip)")
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
+    parser.add_argument("--api-key", default=None,
+                        help="require 'Authorization: Bearer <key>' on the "
+                             "/v1/* endpoints (the web UI's API key setting)")
     parser.add_argument("--log-level", default="INFO")
     parser.add_argument("--profile-dir", default=None,
                         help="write JAX profiler traces per request here")
@@ -810,6 +829,8 @@ def main(argv=None):
         chat_template = Path(chat_template[1:]).read_text()
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
+    if args.paged_pool and args.engine == "chained":
+        parser.error("--paged-pool requires the fused engine")
     if args.page_size and not args.paged_pool:
         parser.error("--page-size requires --paged-pool")
     if args.admission_policy != "fifo" and not args.paged_pool:
@@ -837,7 +858,8 @@ def main(argv=None):
             logger.info("worker rank %d serving", jax.process_index())
             serve_worker(provider.generator)
             return
-    server = make_server(provider, args.host, args.port, profile_dir=args.profile_dir)
+    server = make_server(provider, args.host, args.port,
+                         profile_dir=args.profile_dir, api_key=args.api_key)
     logger.info("serving on http://%s:%d", args.host, args.port)
     server.serve_forever()
 

@@ -5,7 +5,7 @@
 
 const $ = (id) => document.getElementById(id);
 const messagesEl = $("messages");
-const SETTINGS_KEYS = ["endpoint", "model", "temperature", "top_p", "max_tokens", "stop"];
+const SETTINGS_KEYS = ["endpoint", "model", "api_key", "temperature", "top_p", "max_tokens", "stop"];
 
 let history = []; // {role, content}
 let aborter = null;
@@ -83,7 +83,12 @@ function render() {
     }
     meta.append(role, actions);
     const body = document.createElement("div");
-    body.textContent = m.content;
+    if (m.role === "assistant") {
+      body.className = "markdown";
+      body.append(renderMarkdown(m.content)); // DOM-built, XSS-safe
+    } else {
+      body.textContent = m.content;
+    }
     div.append(meta, body);
     messagesEl.append(div);
   });
@@ -134,9 +139,12 @@ async function send(fromComposer = true) {
   $("stop-gen").hidden = false;
   $("send").hidden = true;
   try {
+    const headers = { "Content-Type": "application/json" };
+    const apiKey = $("api_key").value.trim();
+    if (apiKey) headers["Authorization"] = `Bearer ${apiKey}`;
     const resp = await fetch($("endpoint").value, {
       method: "POST",
-      headers: { "Content-Type": "application/json" },
+      headers,
       body: JSON.stringify(payload),
       signal: aborter.signal,
     });

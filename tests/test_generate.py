@@ -3,6 +3,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from mlx_sharding_tpu.config import LlamaConfig
 from mlx_sharding_tpu.generate import Generator, stream_generate
 from mlx_sharding_tpu.models.llama import LlamaModel

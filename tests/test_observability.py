@@ -1,6 +1,10 @@
 import http.client
 import json
 
+import pytest
+
+pytestmark = pytest.mark.quick
+
 from mlx_sharding_tpu.utils.observability import ServingMetrics, _Reservoir, profile_trace
 
 

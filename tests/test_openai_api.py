@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from mlx_sharding_tpu.config import LlamaConfig
 from mlx_sharding_tpu.generate import Generator
 from mlx_sharding_tpu.models.llama import LlamaModel
@@ -203,3 +205,39 @@ def test_convert_chat_roles():
     assert "ASSISTANT's RULE: be brief" in text
     assert "USER: hi" in text
     assert text.endswith("ASSISTANT:")
+
+
+def test_api_key_auth():
+    """--api-key gates /v1/* with Bearer auth; static and health stay open."""
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    gen = Generator(model, params, max_seq=128, cache_dtype=jnp.float32, prefill_chunk=16)
+    provider = ModelProvider.__new__(ModelProvider)
+    provider.default_model = "tiny"
+    provider.trust_remote_paths = False
+    provider._key = None
+    provider._load_lock = threading.Lock()
+    provider._set("tiny", gen, ByteTokenizer())
+    srv = make_server(provider, "127.0.0.1", 0, api_key="sekrit")
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        status, _, data = _request(port, "POST", "/v1/completions",
+                                   {"prompt": "a", "max_tokens": 2})
+        assert status == 401
+        assert json.loads(data)["error"]["type"] == "authentication_error"
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": "a", "max_tokens": 2}),
+                     {"Content-Type": "application/json",
+                      "Authorization": "Bearer sekrit"})
+        assert conn.getresponse().status == 200
+        conn.close()
+
+        status, _, _ = _request(port, "GET", "/health")
+        assert status == 200  # ungated
+        status, _, _ = _request(port, "GET", "/index.html")
+        assert status == 200  # static UI must load to let the user SET a key
+    finally:
+        srv.shutdown()

@@ -3,6 +3,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from mlx_sharding_tpu.ops import apply_rope, causal_attention, rms_norm, rope_frequencies
 
 

@@ -23,6 +23,8 @@ consistent.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from mlx_sharding_tpu.ops.quant import dequantize, quantize
 
 

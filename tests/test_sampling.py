@@ -1,4 +1,7 @@
 import jax
+import pytest
+
+pytestmark = pytest.mark.quick
 import jax.numpy as jnp
 import numpy as np
 

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from mlx_sharding_tpu.config import LlamaConfig
 from mlx_sharding_tpu.generate import Generator
 from mlx_sharding_tpu.models.llama import LlamaModel

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from mlx_sharding_tpu.config import LlamaConfig
 from mlx_sharding_tpu.generate import Generator
 from mlx_sharding_tpu.models.llama import LlamaModel

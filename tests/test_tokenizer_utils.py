@@ -3,6 +3,8 @@ no network/tokenizer downloads are needed."""
 
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from mlx_sharding_tpu.tokenizer_utils import (
     StreamingDetokenizer,
     sequence_overlap,
