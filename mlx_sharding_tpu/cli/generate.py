@@ -53,6 +53,13 @@ def main(argv=None):
                         "for the whole generation (distributed decode "
                         "attention) — capacity scales with the mesh instead "
                         "of one chip's HBM")
+    parser.add_argument("--draft-model", default=None,
+                        help="speculative decoding: a small draft model "
+                        "proposes --spec-k tokens per round, the target "
+                        "verifies them in one forward — greedy streams are "
+                        "token-exact whatever the draft")
+    parser.add_argument("--spec-k", type=int, default=4,
+                        help="speculation window (with --draft-model)")
     parser.add_argument("--keep-quantized", action="store_true",
                         help="keep 4-bit decoder weights packed in HBM "
                         "(fused dequant-matmul) instead of dequantizing at "
@@ -67,6 +74,9 @@ def main(argv=None):
         parser.error("--sp applies to the single-stage generator only")
     if args.sp_decode and not (args.sp and args.sp > 1):
         parser.error("--sp-decode requires --sp N (N > 1)")
+    if args.draft_model and (args.sp or args.stage_bounds or args.num_stages
+                             or args.tp > 1 or args.ep > 1):
+        parser.error("--draft-model applies to the single-chip generator")
 
     import jax.numpy as jnp
 
@@ -116,11 +126,21 @@ def main(argv=None):
             from mlx_sharding_tpu.parallel.mesh import make_mesh
 
             sp_mesh = make_mesh(sp=args.sp)
-        generator = Generator(
-            model, params, max_seq=args.max_seq,
-            prefill_chunk=args.prefill_chunk, sp_mesh=sp_mesh,
-            sp_decode=args.sp_decode,
-        )
+        if args.draft_model:
+            from mlx_sharding_tpu.speculative import SpeculativeGenerator
+
+            draft_model, draft_params = load_model(args.draft_model)
+            generator = SpeculativeGenerator(
+                model, params, draft_model, draft_params,
+                spec_k=args.spec_k, max_seq=args.max_seq,
+                prefill_chunk=args.prefill_chunk,
+            )
+        else:
+            generator = Generator(
+                model, params, max_seq=args.max_seq,
+                prefill_chunk=args.prefill_chunk, sp_mesh=sp_mesh,
+                sp_decode=args.sp_decode,
+            )
 
     from transformers import AutoTokenizer
 

@@ -243,6 +243,24 @@ class Generator:
         self.decode_block = decode_block
 
     # ------------------------------------------------------------------
+    def run_prefill(self, prompt: np.ndarray, cache):
+        """Chunked prefill of ``prompt`` (B, T) into ``cache`` — fixed-size
+        chunks, right-padded tail (see the module docstring). Returns
+        (last_valid_logits, cache). Shared by generate_step and the
+        speculative decoder (both models prefill the same way)."""
+        c = self.prefill_chunk
+        logits = None
+        for start in range(0, prompt.shape[1], c):
+            chunk = prompt[:, start : start + c]
+            n_valid = chunk.shape[1]
+            if n_valid < c:
+                chunk = np.pad(chunk, ((0, 0), (0, c - n_valid)))
+            logits, cache = self._prefill(
+                self.params, jnp.asarray(chunk), cache,
+                jnp.asarray(n_valid, jnp.int32),
+            )
+        return logits, cache
+
     def generate_step(
         self,
         prompt_tokens: list[int] | np.ndarray,
@@ -282,11 +300,9 @@ class Generator:
         # chunked prefill (ref does whole-prompt single shot, shard/utils.py:158;
         # chunking bounds activation memory and fixes compile shapes). Capacity
         # was verified above with host arithmetic — no per-chunk device sync.
-        c = self.prefill_chunk
-        last_logits = None
         use_sp = (
             self._sp_prefill is not None
-            and n_prompt > c
+            and n_prompt > self.prefill_chunk
             # quantum padding may need more cache rows than the prompt itself;
             # fall back to the chunked path rather than fail a fitting request
             and self._sp_prefill.padded_len(n_prompt) <= cache.max_seq
@@ -294,15 +310,7 @@ class Generator:
         if use_sp:
             last_logits, cache = self._sp_prefill(prompt, cache)
         else:
-            for start in range(0, n_prompt, c):
-                chunk = prompt[:, start : start + c]
-                n_valid = chunk.shape[1]
-                if n_valid < c:
-                    chunk = np.pad(chunk, ((0, 0), (0, c - n_valid)))
-                last_logits, cache = self._prefill(
-                    self.params, jnp.asarray(chunk), cache,
-                    jnp.asarray(n_valid, jnp.int32),
-                )
+            last_logits, cache = self.run_prefill(prompt, cache)
 
         tok, logprobs, recent, key = self._sample(last_logits, recent, key, sp)
 

@@ -337,22 +337,33 @@ class APIHandler(BaseHTTPRequestHandler):
         }
         if route not in handlers:
             return self._error(404, f"unknown route {route}")
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length)  # always drain: replying with the
+            # body unread desyncs HTTP/1.1 keep-alive (the leftover bytes
+            # would parse as the next request line)
+        except (ValueError, OSError):
+            return self._error(400, "unreadable request body")
         if self.api_key:
             # the reference UI sends Authorization: Bearer <key>
             # (ref shard/static/app.js:151) but its server never checks it;
             # here --api-key makes the check real. Static/health/metrics
             # stay open — only the generation endpoints are gated.
+            # bytes compare: compare_digest rejects non-ASCII str, and
+            # header bytes are remotely controlled
             import hmac
 
-            auth = self.headers.get("Authorization", "")
-            if not hmac.compare_digest(auth, f"Bearer {self.api_key}"):
+            auth = self.headers.get("Authorization", "").encode(
+                "utf-8", "surrogateescape"
+            )
+            want = f"Bearer {self.api_key}".encode()
+            if not hmac.compare_digest(auth, want):
                 return self._json(401, {"error": {
                     "message": "invalid or missing API key",
                     "type": "authentication_error", "code": 401,
                 }})
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
+            body = json.loads(raw or b"{}")
         except json.JSONDecodeError:
             return self._error(400, "invalid JSON body")
         try:

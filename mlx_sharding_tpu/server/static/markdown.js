@@ -9,7 +9,7 @@
 function renderInline(text) {
   const frag = document.createDocumentFragment();
   // tokenize: `code`, **bold**, *italic*, [label](url)
-  const re = /(`[^`]+`)|(\*\*[^*]+\*\*)|(\*[^*\s][^*]*\*)|(\[[^\]]+\]\((?:https?:\/\/|\/)[^)\s]+\))/g;
+  const re = /(`[^`]+`)|(\*\*[^*]+\*\*)|(\*[^*\s][^*]*\*)|(\[[^\]]+\]\((?:https?:\/\/|\/(?!\/))[^)\s]+\))/g;
   let last = 0;
   for (let m; (m = re.exec(text)); ) {
     if (m.index > last) frag.append(text.slice(last, m.index));

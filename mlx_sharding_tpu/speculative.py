@@ -1,0 +1,237 @@
+"""Speculative decoding with a draft model — exact greedy acceleration.
+
+ROADMAP item: the reference has no speculation of any kind. A small draft
+model proposes ``spec_k`` tokens per round; the target model scores all of
+them in ONE T=K forward (prefill-shaped — MXU-efficient, unlike K
+sequential matvecs) and the longest prefix the target agrees with is
+emitted, plus the target's own correction token at the first divergence.
+Every emitted token is exactly what plain greedy decode would produce —
+whatever the draft's quality, only throughput changes, never content
+(tested token-exact in tests/test_speculative.py).
+
+The TPU-shaped part is the rollback: this framework's caches derive
+validity from the offset (rows past it are never attended and are
+overwritten in place), so rejecting draft tokens costs ONE scalar — set
+``offset = verified_prefix_end`` — no copying, no paging, no mask
+rebuild. The draft model keeps its own cache and rewinds the same way.
+
+Scope: greedy requests (temperature == 0 — the serving default), where
+prefix acceptance is exact. Sampled requests fall back to the normal
+blocked decode; the rejection-sampling variant for temperature > 0 is a
+future extension. Sampler transforms (logit_bias, repetition penalty)
+participate in verification — the target's choice at each position is
+computed with the same ``sample_token`` transforms and an exactly-evolved
+repetition window, so speculation composes with penalties.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_sharding_tpu.generate import (
+    REPETITION_WINDOW,
+    Generator,
+    TokenLogprobs,
+)
+from mlx_sharding_tpu.sample import (
+    init_recent_tokens,
+    make_sampler_params,
+    sample_token,
+    update_recent_tokens,
+)
+
+
+class SpeculativeGenerator:
+    """``generate_step`` contract over a (target, draft) model pair.
+
+    Holds two plain Generators (their prefill/sample programs are reused
+    verbatim) plus two speculation programs: the draft's K-step greedy
+    scan and the target's fused verify (T=K forward + transform-aware
+    acceptance)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        draft_model,
+        draft_params,
+        *,
+        spec_k: int = 4,
+        max_seq: int = 4096,
+        cache_dtype=jnp.bfloat16,
+        prefill_chunk: int = 256,
+        decode_block: int = 16,
+    ):
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        self.spec_k = spec_k
+        self.target = Generator(
+            model, params, max_seq=max_seq, cache_dtype=cache_dtype,
+            prefill_chunk=prefill_chunk, decode_block=decode_block,
+        )
+        self.draft = Generator(
+            draft_model, draft_params, max_seq=max_seq,
+            cache_dtype=cache_dtype, prefill_chunk=prefill_chunk,
+        )
+        self.max_seq = self.target.max_seq
+
+        K = spec_k
+
+        def draft_block_fn(dparams, token, dcache):
+            """K greedy draft proposals (plain argmax — transforms live on
+            the verify side where exactness is decided)."""
+
+            def step(carry, _):
+                tok, dcache = carry
+                logits, dcache = draft_model(dparams, tok[:, None], dcache)
+                tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (tok, dcache), tok
+
+            (_, dcache), drafts = jax.lax.scan(
+                step, (token, dcache), None, length=K
+            )
+            return drafts, dcache  # drafts (K, B)
+
+        def verify_fn(params, token, drafts, cache, recent, sp):
+            """One target forward over [t0, d1..d_{K-1}] scores every draft
+            position; acceptance walks the agreement prefix. Returns the
+            emitted tokens (K, B; rows past ``count`` are garbage), the
+            count, the next feed token, and state rewound to the verified
+            prefix."""
+            b = token.shape[0]
+            x = jnp.concatenate([token[:, None], drafts[:-1].T], axis=1)  # (B, K)
+            off0 = cache.offset
+            logits, cache = model(params, x, cache)  # (B, K, V)
+            zero_key = jax.random.PRNGKey(0)  # unused at temperature 0
+
+            def score(carry, i):
+                recent = carry
+                g, _ = sample_token(zero_key, logits[:, i], sp, recent)
+                recent = update_recent_tokens(recent, g)
+                return recent, g
+
+            _, gs = jax.lax.scan(score, recent, jnp.arange(K))  # (K, B)
+
+            mism = gs != drafts  # position i: target's g_i vs proposal d_{i+1}
+            any_mism = mism.any(axis=0)  # (B,)
+            first = jnp.argmax(mism, axis=0)  # first True (0 if none)
+            m = jnp.where(any_mism, first, K - 1)
+            count = (m + 1).astype(jnp.int32)  # tokens emitted this round
+
+            # recent window: replay ONLY the accepted tokens
+            def replay(carry, i):
+                recent = carry
+                upd = update_recent_tokens(recent, gs[i])
+                return jnp.where((i <= m)[:, None], upd, recent), None
+
+            recent, _ = jax.lax.scan(replay, recent, jnp.arange(K))
+
+            # offset rollback: model() advanced by K; keep the verified prefix
+            cache = cache._replace(offset=off0 + count[0])
+            next_tok = gs[m[0]]
+            return gs, count, next_tok, cache, recent
+
+        self._draft_block = jax.jit(draft_block_fn, donate_argnums=(2,))
+        self._verify = jax.jit(verify_fn, donate_argnums=(3, 4))
+        self._rewind = jax.jit(
+            lambda c, off: c._replace(offset=off), donate_argnums=(0,)
+        )
+
+    # ------------------------------------------------------------------
+    def generate_step(
+        self,
+        prompt_tokens,
+        *,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        repetition_penalty: Optional[float] = None,
+        repetition_context_size: int = REPETITION_WINDOW,
+        logit_bias: Optional[dict[int, float]] = None,
+        seed: Optional[int] = None,
+        max_tokens: int = 256,
+        want_logprobs: bool = False,
+    ) -> Iterator[tuple[int, Optional[TokenLogprobs]]]:
+        if temperature > 0 or want_logprobs:
+            # sampled requests need the rejection-sampling variant;
+            # logprobs need per-token summaries the verify path doesn't
+            # compute — both take the exact normal path
+            yield from self.target.generate_step(
+                prompt_tokens, temperature=temperature, top_p=top_p,
+                repetition_penalty=repetition_penalty,
+                repetition_context_size=repetition_context_size,
+                logit_bias=logit_bias, seed=seed, max_tokens=max_tokens,
+                want_logprobs=want_logprobs,
+            )
+            return
+
+        sp = make_sampler_params(0.0, top_p, repetition_penalty, logit_bias)
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(
+            self.target.batch, -1
+        )
+        n_prompt = prompt.shape[1]
+        if n_prompt + max_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({n_prompt}) + max_tokens ({max_tokens}) exceeds KV "
+                f"capacity {self.max_seq}"
+            )
+
+        t = self.target
+        cache = t.model.make_cache(t.batch, t.max_seq, t.cache_dtype)
+        recent = init_recent_tokens(t.batch, repetition_context_size, prompt)
+        key = jax.random.PRNGKey(0)
+
+        last_logits, cache = t.run_prefill(prompt, cache)
+        # draft prefills the same prompt into its own cache
+        d = self.draft
+        dcache = d.model.make_cache(d.batch, d.max_seq, d.cache_dtype)
+        _, dcache = d.run_prefill(prompt, dcache)
+
+        tok, logprobs, recent, key = t._sample(last_logits, recent, key, sp)
+        yield int(tok[0]), None
+        emitted = 1
+        # the first emitted token's row is in NEITHER cache: both models
+        # consume it as the next round's feed token, exactly like normal
+        # decode. ``offset`` mirrors cache.offset on host for the capacity
+        # check (it grows by the accepted count each round).
+        offset = n_prompt
+        K = self.spec_k
+        while emitted < max_tokens:
+            if offset + K > self.max_seq or max_tokens - emitted < 2:
+                # tail (or capacity edge): plain blocked decode from here
+                remaining = max_tokens - emitted
+
+                def dispatch(carry):
+                    outs, tk, ch, rc, kk = t._decode_block(
+                        t.params, carry[0], carry[1], carry[2], carry[3],
+                        sp, False,
+                    )
+                    return outs, (tk, ch, rc, kk)
+
+                from mlx_sharding_tpu.generate import blocked_token_stream
+
+                yield from blocked_token_stream(
+                    dispatch, (tok, cache, recent, key), remaining,
+                    t.decode_block, False,
+                )
+                return
+
+            drafts, dcache = self._draft_block(d.params, tok, dcache)
+            gs, count, tok, cache, recent = self._verify(
+                t.params, tok, drafts, cache, recent, sp
+            )
+            n, gs_host = int(count[0]), np.asarray(gs)
+            # draft consumed [t0, d1..d_{K-1}] = K rows; keep the verified
+            # prefix (the accepted tokens ARE the draft's inputs there)
+            dcache = self._rewind(
+                dcache, dcache.offset - K + jnp.asarray(n, jnp.int32)
+            )
+            for j in range(n):
+                if emitted >= max_tokens:
+                    break
+                yield int(gs_host[j, 0]), None
+                emitted += 1
+            offset += n

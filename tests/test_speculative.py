@@ -1,0 +1,116 @@
+"""Speculative decoding (speculative.py): greedy token streams must be
+EXACTLY the plain-decode streams whatever the draft model is — a good
+draft only changes throughput. Rollback is offset-only (rows past the
+verified prefix are never attended), so no state can leak between rounds."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.generate import Generator
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.speculative import SpeculativeGenerator
+
+TINY = dict(
+    vocab_size=300,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+
+def build(draft_seed, spec_k=4):
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    draft_cfg = LlamaConfig(**{**TINY, "num_hidden_layers": 1})
+    draft = LlamaModel(draft_cfg)
+    dparams = draft.init_params(jax.random.PRNGKey(draft_seed), jnp.float32)
+    spec = SpeculativeGenerator(
+        model, params, draft, dparams, spec_k=spec_k, max_seq=96,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    ref = Generator(
+        model, params, max_seq=96, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    return spec, ref
+
+
+@pytest.fixture(scope="module")
+def pair():
+    return build(draft_seed=1)
+
+
+def test_exact_with_unrelated_draft(pair):
+    """A randomly-initialized draft agrees with the target rarely — the
+    stream must be identical anyway (acceptance only buys speed)."""
+    spec, ref = pair
+    prompt = [3, 17, 42, 9]
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=20)]
+    got = [t for t, _ in spec.generate_step(prompt, max_tokens=20)]
+    assert got == want
+
+
+def test_exact_with_perfect_draft():
+    """Draft == target: every round accepts the full window; stream still
+    exact and the capacity-tail fallback still engages."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    spec = SpeculativeGenerator(
+        model, params, model, params, spec_k=4, max_seq=96,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    ref = Generator(
+        model, params, max_seq=96, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    prompt = [5, 9, 2]
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=30)]
+    assert [t for t, _ in spec.generate_step(prompt, max_tokens=30)] == want
+
+
+def test_exact_with_penalty_and_bias(pair):
+    """Sampler transforms participate in verification: repetition penalty
+    evolves the window token-by-token and logit_bias shifts the argmax —
+    both must match plain decode exactly."""
+    spec, ref = pair
+    kw = dict(
+        max_tokens=16, repetition_penalty=1.5, repetition_context_size=8,
+        logit_bias={7: 4.0, 11: -2.0},
+    )
+    prompt = [1, 2, 3]
+    want = [t for t, _ in ref.generate_step(prompt, **kw)]
+    assert [t for t, _ in spec.generate_step(prompt, **kw)] == want
+
+
+def test_spec_k_values(pair):
+    """Every window size produces the same stream (K=1 degenerates to
+    verify-only decode)."""
+    _, ref = pair
+    prompt = [8, 8, 4]
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=15)]
+    for k in (1, 2, 7):
+        spec, _ = build(draft_seed=2, spec_k=k)
+        assert [t for t, _ in spec.generate_step(prompt, max_tokens=15)] == want
+
+
+def test_sampled_requests_fall_back(pair):
+    spec, ref = pair
+    kw = dict(temperature=0.8, seed=42, max_tokens=10)
+    want = [t for t, _ in ref.generate_step([4, 5], **kw)]
+    assert [t for t, _ in spec.generate_step([4, 5], **kw)] == want
+
+
+def test_capacity_edge(pair):
+    """Generation that fills the cache to the brim: the spec loop must hand
+    off to the blocked tail without overrunning capacity."""
+    spec, ref = pair
+    prompt = list(range(1, 60))  # 59 tokens, capacity 96
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=37)]
+    assert [t for t, _ in spec.generate_step(prompt, max_tokens=37)] == want
