@@ -45,6 +45,12 @@ OP_REQUEST = 1
 OP_DECODE = 2
 OP_STOP_REQUEST = 3
 OP_SHUTDOWN = 4
+# continuous-batching ops (the batched protocol below)
+OP_B_ASSIGN = 10
+OP_B_PREFILL = 11
+OP_B_DECODE = 12
+OP_B_CANCEL = 13
+OP_B_FAIL = 14
 
 # matches the scheduler's per-slot width (scheduler.py make_sampler_params
 # min_bias_slots=512) and the HTTP-layer validation cap, so a request that
@@ -56,16 +62,58 @@ class _Shutdown(Exception):
     pass
 
 
+# Shared wire encoding — the single-stream protocol (_request_msg /
+# _start_request) and the batched one (_assign_msg / _req_from_msg) must
+# never drift apart on these.
+
+def _pack_seed(seed: int) -> tuple[int, int]:
+    """62-bit seed into two int32-safe halves — a full user seed round-trips
+    so multi-host reproduces the single-host stream for the same request."""
+    return seed & 0x7FFFFFFF, (seed >> 31) & 0x7FFFFFFF
+
+
+def _unpack_seed(lo, hi) -> int:
+    return int(lo) | (int(hi) << 31)
+
+
+def _pack_bias(logit_bias) -> tuple[np.ndarray, np.ndarray, int]:
+    bias_idx = np.zeros((_BIAS_SLOTS,), np.int32)
+    bias_val = np.zeros((_BIAS_SLOTS,), np.float32)
+    n_bias = 0
+    if logit_bias:
+        if len(logit_bias) > _BIAS_SLOTS:
+            # silent truncation would make multi-host output diverge from
+            # the same request served single-host
+            raise ValueError(
+                f"logit_bias with {len(logit_bias)} entries exceeds the "
+                f"multi-host control-plane width {_BIAS_SLOTS}"
+            )
+        items = list(logit_bias.items())
+        n_bias = len(items)
+        bias_idx[:n_bias] = [int(k) for k, _ in items]
+        bias_val[:n_bias] = [float(v) for _, v in items]
+    return bias_idx, bias_val, n_bias
+
+
+def _unpack_bias(bias_idx, bias_val, n_bias: int):
+    return {
+        int(i): float(v)
+        for i, v in zip(bias_idx[:n_bias], bias_val[:n_bias])
+    } or None
+
+
 class ControlPlane:
     """Fixed-shape broadcast buffers; rank 0 publishes, all ranks receive the
     same pytree (broadcast_one_to_all ignores non-zero ranks' inputs)."""
+
+    header_size = 8
 
     def __init__(self, max_prompt: int):
         self.max_prompt = max_prompt
 
     def _zeros(self):
         return {
-            "header": np.zeros((8,), np.int32),
+            "header": np.zeros((self.header_size,), np.int32),
             "floats": np.zeros((4,), np.float32),
             "tokens": np.zeros((self.max_prompt,), np.int32),
             "bias_idx": np.zeros((_BIAS_SLOTS,), np.int32),
@@ -89,30 +137,13 @@ class ControlPlane:
 def _request_msg(prompt, temperature, top_p, repetition_penalty,
                  repetition_context_size, logit_bias, seed, max_tokens):
     prompt = np.asarray(prompt, np.int32).reshape(-1)
-    bias_idx = np.zeros((_BIAS_SLOTS,), np.int32)
-    bias_val = np.zeros((_BIAS_SLOTS,), np.float32)
-    n_bias = 0
-    if logit_bias:
-        if len(logit_bias) > _BIAS_SLOTS:
-            # silent truncation would make multi-host output diverge from the
-            # same request served single-host
-            raise ValueError(
-                f"logit_bias with {len(logit_bias)} entries exceeds the "
-                f"multi-host control-plane width {_BIAS_SLOTS}"
-            )
-        items = list(logit_bias.items())
-        n_bias = len(items)
-        bias_idx[:n_bias] = [int(k) for k, _ in items]
-        bias_val[:n_bias] = [float(v) for _, v in items]
+    bias_idx, bias_val, n_bias = _pack_bias(logit_bias)
+    seed_lo, seed_hi = _pack_seed(seed)
     return {
         "header": np.asarray(
-            # seed rides in two int32 fields (31 bits each) so a 62-bit user
-            # seed round-trips and multi-host reproduces the single-host
-            # stream for the same request
-            [OP_REQUEST, prompt.size, max_tokens, seed & 0x7FFFFFFF,
+            [OP_REQUEST, prompt.size, max_tokens, seed_lo,
              repetition_context_size,
-             0 if repetition_penalty is None else 1, n_bias,
-             (seed >> 31) & 0x7FFFFFFF],
+             0 if repetition_penalty is None else 1, n_bias, seed_hi],
             np.int32,
         ),
         "floats": np.asarray(
@@ -129,14 +160,11 @@ def _start_request(engine, msg):
     first token. Returns the rolling decode state."""
     hdr = msg["header"]
     n_prompt = int(hdr[1])
-    seed = int(hdr[3]) | (int(hdr[7]) << 31)
+    seed = _unpack_seed(hdr[3], hdr[7])
     rep_ctx = int(hdr[4])
     n_bias = int(hdr[6])
     temperature, top_p, rep_pen = (float(x) for x in msg["floats"][:3])
-    bias = {
-        int(i): float(v)
-        for i, v in zip(msg["bias_idx"][:n_bias], msg["bias_val"][:n_bias])
-    } or None
+    bias = _unpack_bias(msg["bias_idx"], msg["bias_val"], n_bias)
     sp = make_sampler_params(
         temperature, top_p, rep_pen if hdr[5] else None, bias
     )
@@ -266,6 +294,212 @@ def _drain_to_stop(ctrl) -> bool:
             return True
         if op != OP_DECODE:
             raise RuntimeError(f"worker protocol desync while draining: op {op}")
+
+
+# --------------------------------------------------------------------------
+# Continuous batching over the multi-host control plane.
+#
+# The scheduler's HOST decisions (which request gets which slot, when a
+# prefill chunk runs, when a decode block runs, when a consumer cancels) are
+# the only non-deterministic inputs — everything downstream of the op stream
+# is deterministic: page allocation pops a mirrored free list, max_tokens
+# finishes count mirrored emit loops, sampling is replicated PRNG. So rank 0
+# runs the real ContinuousBatcher and broadcasts one tiny op message before
+# each DEVICE op; every worker applies the same op to an identical mirror
+# batcher and stays in lockstep. (The reference cannot express any of this —
+# its serving is one request at a time over RPC-chained shards.)
+
+
+class BatchControlPlane(ControlPlane):
+    """ControlPlane with room for the batched ops' header fields."""
+
+    header_size = 12
+
+
+def _assign_msg(req, slot: int) -> dict:
+    """OP_B_ASSIGN message: the request verbatim, so a worker rebuilds an
+    identical _Request (sampler params, seed chain, page need)."""
+    prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+    bias_idx, bias_val, n_bias = _pack_bias(req.logit_bias)
+    seed_lo, seed_hi = _pack_seed(int(req.seed))
+    return {
+        "header": np.asarray(
+            [OP_B_ASSIGN, slot, prompt.size, req.max_tokens,
+             seed_lo, seed_hi, req.rep_context,
+             0 if req.repetition_penalty is None else 1, n_bias,
+             1 if req.want_logprobs else 0, 0, 0],
+            np.int32,
+        ),
+        "floats": np.asarray(
+            [req.temperature, req.top_p, req.repetition_penalty or 1.0, 0.0],
+            np.float32,
+        ),
+        "tokens": prompt,
+        "bias_idx": bias_idx,
+        "bias_val": bias_val,
+    }
+
+
+class _DiscardQueue:
+    """Worker-side _Request.out: tokens are computed redundantly on every
+    rank; only rank 0 has consumers. Dropping keeps device rows from
+    accumulating."""
+
+    def put(self, item):
+        pass
+
+
+def _req_from_msg(msg):
+    from mlx_sharding_tpu.scheduler import _Request
+
+    hdr = msg["header"]
+    n_prompt, max_tokens = int(hdr[2]), int(hdr[3])
+    seed = _unpack_seed(hdr[4], hdr[5])
+    rep_ctx, has_pen, n_bias = int(hdr[6]), int(hdr[7]), int(hdr[8])
+    temperature, top_p, rep_pen = (float(x) for x in msg["floats"][:3])
+    bias = _unpack_bias(msg["bias_idx"], msg["bias_val"], n_bias)
+    return _Request(
+        prompt=np.asarray(msg["tokens"][:n_prompt], np.int32),
+        sp=make_sampler_params(
+            temperature, top_p, rep_pen if has_pen else None, bias
+        ),
+        seed=seed,
+        max_tokens=max_tokens,
+        rep_context=rep_ctx,
+        want_logprobs=bool(hdr[9]),
+        out=_DiscardQueue(),
+        temperature=temperature,
+        top_p=top_p,
+        repetition_penalty=rep_pen if has_pen else None,
+        logit_bias=bias,
+    )
+
+
+def _make_multihost_batcher():
+    """Deferred subclassing keeps scheduler import out of this module's
+    import time (the class is only needed on serving ranks)."""
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    class MultiHostBatcher(ContinuousBatcher):
+        """Rank-0 continuous batcher that broadcasts each device op before
+        applying it, so `serve_worker_batched` mirrors stay in lockstep.
+        `--concurrent N` under `--coordinator` builds this."""
+
+        def __init__(self, engine, **kw):
+            super().__init__(engine, **kw)
+            self.ctrl = BatchControlPlane(max_prompt=engine.max_seq)
+            self._shut = False
+
+        def generate_step(self, prompt_tokens, *, seed=None, **kw):
+            if seed is not None and not 0 <= int(seed) < (1 << 62):
+                raise ValueError(
+                    "seed must fit in 62 bits for multi-host serving"
+                )
+            return super().generate_step(prompt_tokens, seed=seed, **kw)
+
+        def _bcast(self, *header):
+            self.ctrl.exchange({"header": np.asarray(header, np.int32)})
+
+        def _assign_slot(self, req, slot):
+            self.ctrl.exchange(_assign_msg(req, slot))
+            super()._assign_slot(req, slot)
+
+        def _prefill_one_chunk(self, req):
+            self._bcast(OP_B_PREFILL, req.slot)
+            super()._prefill_one_chunk(req)
+
+        def _decode_once(self):
+            self._bcast(OP_B_DECODE)
+            super()._decode_once()
+
+        def _reap_cancelled(self):
+            # cancellation is the one finish the workers cannot derive
+            # (max_tokens finishes they count themselves)
+            for req in list(self._slots):
+                if req is not None and req.cancelled:
+                    self._bcast(OP_B_CANCEL, req.slot)
+                    self._finish(req)
+
+        def _fail_all(self, exc):
+            import logging
+
+            try:
+                self._bcast(OP_B_FAIL)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "failed to broadcast scheduler failure"
+                )
+            super()._fail_all(exc)
+
+        def close(self):
+            super().close()  # joins the scheduler thread first: no
+            # broadcast can race the shutdown one
+            if self._thread is not None and self._thread.is_alive():
+                # join timed out (e.g. mid-compile tick): the scheduler
+                # thread may still broadcast ops — a SHUTDOWN from here
+                # would interleave with them and strand a worker collective.
+                # Skip it; process teardown is the backstop.
+                return
+            if not self._shut:
+                self._shut = True  # workers exit on the first SHUTDOWN; a
+                # second broadcast would hang awaiting departed peers
+                self._bcast(OP_SHUTDOWN)
+
+        shutdown = close
+
+    return MultiHostBatcher
+
+
+def make_multihost_batcher(engine, **kw):
+    """Build the rank-0 batcher for multi-host continuous batching."""
+    return _make_multihost_batcher()(engine, **kw)
+
+
+def serve_worker_batched(engine, *, decode_block: int = 8,
+                         repetition_window: int = 64) -> None:
+    """Rank>0 loop for multi-host continuous batching: apply rank 0's op
+    stream to a mirror ContinuousBatcher. ``decode_block`` must match
+    rank 0's (it sets the scanned block program length).
+
+    Failure discipline matches :func:`serve_worker`: device-op failures are
+    deterministic, so rank 0 hits the same error, fails its consumers and
+    broadcasts OP_B_FAIL — which resets this mirror too. An op code outside
+    the protocol is a desync and raises."""
+    import logging
+
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    logger = logging.getLogger(__name__)
+    batcher = ContinuousBatcher(
+        engine, decode_block=decode_block, repetition_window=repetition_window
+    )
+    ctrl = BatchControlPlane(max_prompt=engine.max_seq)
+    while True:
+        msg = ctrl.exchange()
+        hdr = msg["header"]
+        op = int(hdr[0])
+        if op == OP_SHUTDOWN:
+            return
+        if op == OP_B_FAIL:
+            batcher._fail_all(RuntimeError("rank 0 scheduler failure"))
+            continue
+        if op not in (OP_B_ASSIGN, OP_B_PREFILL, OP_B_DECODE, OP_B_CANCEL):
+            raise RuntimeError(f"worker protocol desync: unexpected op {op}")
+        try:
+            if op == OP_B_ASSIGN:
+                batcher._assign_slot(_req_from_msg(msg), int(hdr[1]))
+            elif op == OP_B_PREFILL:
+                batcher._prefill_one_chunk(batcher._slots[int(hdr[1])])
+            elif op == OP_B_DECODE:
+                batcher._decode_once()
+            else:  # OP_B_CANCEL
+                req = batcher._slots[int(hdr[1])]
+                if req is not None:
+                    batcher._finish(req)
+        except Exception:
+            # deterministic failure: rank 0's identical op fails the same
+            # way and OP_B_FAIL arrives next to reset this mirror
+            logger.exception("worker batched op %d failed", op)
 
 
 def serve_worker(engine) -> None:

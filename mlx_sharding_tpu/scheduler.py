@@ -38,7 +38,6 @@ from mlx_sharding_tpu.sample import (
     SamplerParams,
     make_sampler_params,
     sample_token_batched,
-    set_sampler_slot,
     stack_sampler_params,
 )
 
@@ -56,6 +55,12 @@ class _Request:
     slot: int = -1
     produced: int = 0
     prefill_pos: int = 0  # next prompt index to prefill; admission is chunked
+    # raw sampler request, kept so multi-host serving can broadcast the
+    # request verbatim and workers rebuild an identical SamplerParams
+    temperature: float = 0.0
+    top_p: float = 1.0
+    repetition_penalty: Optional[float] = None
+    logit_bias: Optional[dict] = None
 
 
 class ContinuousBatcher:
@@ -96,6 +101,30 @@ class ContinuousBatcher:
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
 
+        # Multi-controller discipline (multi-host serving mirrors this
+        # scheduler on every rank): host-built inputs must be committed as
+        # REPLICATED global arrays before entering a jitted program over the
+        # global mesh, and state transitions must run inside jit — eager ops
+        # on process-spanning arrays are not executable. Single-host, _put is
+        # the identity and the jitted setters behave exactly like the eager
+        # .at[].set they replace.
+        self._multi = jax.process_count() > 1
+        if self._multi:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(engine.mesh, P())
+            self._put = lambda x: jax.device_put(x, rep)
+        else:
+            self._put = lambda x: x
+        self._row_set = jax.jit(lambda arr, slot, val: arr.at[slot].set(val))
+        self._sp_set = jax.jit(
+            lambda batched, one, slot: jax.tree.map(
+                lambda full, x: full.at[slot].set(x), batched, one
+            )
+        )
+        self._set_last = jax.jit(lambda lt, slot, tok: lt.at[slot, 0].set(tok))
+        self._zeros_like = jax.jit(jnp.zeros_like)
+
         # device-side per-slot state. Paged engines share a page pool across
         # slots: the scheduler RESERVES a request's full page need (prompt +
         # max_tokens) at admission, so allocation can never fail mid-stream
@@ -108,30 +137,28 @@ class ContinuousBatcher:
             self._free_pages = list(range(engine.pool_pages - 1, -1, -1))
             self._pages_of: dict[int, list[int]] = {}  # slot → reserved pages
             self.pages_high_water = 0
-            self._set_table_row = jax.jit(
-                lambda t, slot, row: t.at[slot].set(row)
-            )
         else:
             self.cache = engine.init_cache()
-            self.table = jnp.zeros((1, 1), jnp.int32)  # dummy for the step arg
-        self.recent = jnp.full((self.M, self.W), -1, jnp.int32)
-        self.keys = jnp.stack([jax.random.PRNGKey(0)] * self.M)
+            # dummy for the step arg
+            self.table = self._put(jnp.zeros((1, 1), jnp.int32))
+        self.recent = self._put(jnp.full((self.M, self.W), -1, jnp.int32))
+        self.keys = self._put(jnp.stack([jax.random.PRNGKey(0)] * self.M))
         # bias width 512 covers OpenAI's documented logit_bias cap (300);
         # larger requests are rejected on the submitting thread
-        self.sp = stack_sampler_params(
-            [make_sampler_params(min_bias_slots=512) for _ in range(self.M)]
+        self.sp = jax.tree.map(
+            self._put,
+            stack_sampler_params(
+                [make_sampler_params(min_bias_slots=512) for _ in range(self.M)]
+            ),
         )
-        self.rep_sizes = jnp.full((self.M,), self.W, jnp.int32)
-        self.active = jnp.zeros((self.M,), bool)
-        self.last_tok = jnp.zeros((self.M, 1), jnp.int32)
+        self.rep_sizes = self._put(jnp.full((self.M,), self.W, jnp.int32))
+        self.active = self._put(jnp.zeros((self.M,), bool))
+        self.last_tok = self._put(jnp.zeros((self.M, 1), jnp.int32))
 
         # host-side slot table
         self._slots: list[Optional[_Request]] = [None] * self.M
 
         self._first_sample = jax.jit(self._first_sample_fn)
-        self._set_active = jax.jit(
-            lambda active, slot, val: active.at[slot].set(val)
-        )
 
     # ------------------------------------------------------------- public
     def generate_step(
@@ -182,6 +209,10 @@ class ContinuousBatcher:
             max_tokens=max_tokens,
             rep_context=min(repetition_context_size, self.W),
             want_logprobs=want_logprobs,
+            temperature=temperature,
+            top_p=top_p,
+            repetition_penalty=repetition_penalty,
+            logit_bias=logit_bias,
         )
         self._ensure_running()
         self._submit.put(req)
@@ -236,7 +267,9 @@ class ContinuousBatcher:
     def _first_sample_fn(self, logits, keys, sp, recent, rep_sizes, slot):
         """Sample the first token of the request in ``slot`` from its prefill
         logits, using the same split-then-sample key chain as the decode
-        step, leaving other slots' keys untouched."""
+        step, leaving other slots' keys untouched. ``logits`` is the (1, V)
+        prefill output; the returned logprobs keep that shape (indexing a
+        global array must stay inside this jit)."""
         split = jax.random.split(keys[slot])
         key_new, sub = split[0], split[1]
         row = jnp.arange(self.W) >= self.W - rep_sizes[slot]
@@ -251,7 +284,7 @@ class ContinuousBatcher:
         recent = recent.at[slot].set(
             jnp.concatenate([recent[slot, 1:], tok.astype(jnp.int32)])
         )
-        return tok[0], logprobs[0], keys, recent
+        return tok[0], logprobs, keys, recent
 
     def _assign_slot(self, req: _Request, slot: int):
         """Claim ``slot`` for ``req`` and reset its device-side state: offset
@@ -259,8 +292,8 @@ class ContinuousBatcher:
         init_recent_tokens in the serial path), the request's sampler params
         and PRNG key. Prefill happens incrementally in the loop — one chunk
         per scheduler tick — so active slots keep decoding during admission."""
-        W = self.W
         prompt = req.prompt
+        slot_arr = self._put(jnp.asarray(slot, jnp.int32))
         if self.paged:
             n = self._pages_needed(prompt.size, req.max_tokens)
             pages = [self._free_pages.pop() for _ in range(n)]
@@ -272,14 +305,30 @@ class ContinuousBatcher:
             row = np.full((self.engine.slot_pages,), self.engine.pool_pages,
                           np.int32)
             row[:n] = pages
-            self.table = self._set_table_row(
-                self.table, jnp.asarray(slot, jnp.int32), jnp.asarray(row)
+            self.table = self._row_set(
+                self.table, slot_arr, self._put(jnp.asarray(row))
             )
         self.cache = self.cache._replace(
-            offset=self.cache.offset.at[slot].set(0)
+            offset=self._row_set(
+                self.cache.offset, slot_arr,
+                self._put(jnp.asarray(0, jnp.int32)),
+            )
         )
-        self.sp = set_sampler_slot(self.sp, slot, req.sp)
-        self.rep_sizes = self.rep_sizes.at[slot].set(req.rep_context)
+        # pad the request's sampler params to the batched width host-side,
+        # then write its row inside jit (set_sampler_slot is eager)
+        width = self.sp.bias_indices.shape[1]
+        one = req.sp
+        n_bias = one.bias_indices.shape[0]
+        if n_bias < width:
+            one = one._replace(
+                bias_indices=jnp.pad(one.bias_indices, (0, width - n_bias)),
+                bias_values=jnp.pad(one.bias_values, (0, width - n_bias)),
+            )
+        self.sp = self._sp_set(self.sp, jax.tree.map(self._put, one), slot_arr)
+        self.rep_sizes = self._row_set(
+            self.rep_sizes, slot_arr,
+            self._put(jnp.asarray(req.rep_context, jnp.int32)),
+        )
         self._slots[slot] = req
         req.slot = slot
         req.prefill_pos = 0
@@ -289,15 +338,15 @@ class ContinuousBatcher:
         chunk, sample the first token and activate the slot for decode."""
         eng = self.engine
         c = eng.prefill_chunk
-        slot_arr = jnp.asarray(req.slot, jnp.int32)
+        slot_arr = self._put(jnp.asarray(req.slot, jnp.int32))
         chunk = req.prompt[req.prefill_pos : req.prefill_pos + c]
         n_valid = chunk.size
         if n_valid < c:
             chunk = np.pad(chunk, (0, c - n_valid))
         logits, self.cache = eng.prefill_slot()(
             eng.layer_params, eng.layer_masks, eng.vocab_parts,
-            eng.shared_params, jnp.asarray(chunk[None]), slot_arr, self.cache,
-            jnp.asarray(n_valid, jnp.int32),
+            eng.shared_params, self._put(jnp.asarray(chunk[None])), slot_arr,
+            self.cache, self._put(jnp.asarray(n_valid, jnp.int32)),
             self.table if self.paged else None,
         )
         req.prefill_pos += n_valid
@@ -316,15 +365,21 @@ class ContinuousBatcher:
         )
         if tail.size:
             row[W - tail.size:] = tail
-        self.recent = self.recent.at[req.slot].set(jnp.asarray(row))
-        self.keys = self.keys.at[req.slot].set(jax.random.PRNGKey(req.seed))
+        self.recent = self._row_set(
+            self.recent, slot_arr, self._put(jnp.asarray(row))
+        )
+        self.keys = self._row_set(
+            self.keys, slot_arr, self._put(jax.random.PRNGKey(req.seed))
+        )
 
         tok, logprobs, self.keys, self.recent = self._first_sample(
-            logits[0], self.keys, self.sp, self.recent, self.rep_sizes, slot_arr
+            logits, self.keys, self.sp, self.recent, self.rep_sizes, slot_arr
         )
-        self.last_tok = self.last_tok.at[req.slot, 0].set(tok)
-        self.active = self._set_active(self.active, slot_arr, True)
-        self._emit(req, int(tok), logprobs[None])
+        self.last_tok = self._set_last(self.last_tok, slot_arr, tok)
+        self.active = self._row_set(
+            self.active, slot_arr, self._put(jnp.asarray(True))
+        )
+        self._emit(req, int(tok), logprobs)
 
     def _emit(self, req: _Request, token: int, logprobs):
         req.produced += 1
@@ -337,8 +392,9 @@ class ContinuousBatcher:
 
     def _finish(self, req: _Request):
         if req.slot >= 0:
-            self.active = self._set_active(
-                self.active, jnp.asarray(req.slot, jnp.int32), False
+            self.active = self._row_set(
+                self.active, self._put(jnp.asarray(req.slot, jnp.int32)),
+                self._put(jnp.asarray(False)),
             )
             if self.paged:
                 # the slot is inactive from the next block on (garbage ticks
@@ -479,7 +535,7 @@ class ContinuousBatcher:
                 req.slot = -1
                 self._slots[slot] = None
                 req.out.put(exc)
-        self.active = jnp.zeros_like(self.active)
+        self.active = self._zeros_like(self.active)
         if self.paged:
             for pages in self._pages_of.values():
                 self._free_pages.extend(pages)
@@ -502,10 +558,15 @@ class ContinuousBatcher:
             except Exception as exc:  # noqa: BLE001 — a dead scheduler thread
                 # would hang every consumer; surface the error to them instead
                 self._fail_all(exc)
-        # graceful shutdown: end every in-flight and queued request's stream
-        for req in list(self._slots):
+        # graceful shutdown: end every in-flight and queued request's stream.
+        # Host-side only — no device ops here: the engine is being dropped,
+        # and in multi-host serving a device op after the final broadcast
+        # would be a one-rank collective entry (a hang, not a cleanup).
+        for slot, req in enumerate(self._slots):
             if req is not None:
-                self._finish(req)
+                self._slots[slot] = None
+                req.slot = -1
+                req.out.put(None)
         for req in self._waiting:
             req.out.put(None)
         self._waiting.clear()

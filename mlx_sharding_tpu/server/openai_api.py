@@ -213,13 +213,32 @@ class ModelProvider:
                         page_size=self.page_size,
                     )
                     if self.concurrent > 1:
-                        from mlx_sharding_tpu.scheduler import ContinuousBatcher
+                        import jax
 
-                        generator = ContinuousBatcher(
-                            generator,
-                            decode_block=min(8, self.decode_block),
-                            policy=self.admission_policy,
-                        )
+                        if self.multihost and jax.process_index() > 0:
+                            # raw engine: serve_worker_batched wraps it in
+                            # its own mirror batcher
+                            pass
+                        elif self.multihost:
+                            from mlx_sharding_tpu.parallel.multihost import (
+                                make_multihost_batcher,
+                            )
+
+                            generator = make_multihost_batcher(
+                                generator,
+                                decode_block=min(8, self.decode_block),
+                                policy=self.admission_policy,
+                            )
+                        else:
+                            from mlx_sharding_tpu.scheduler import (
+                                ContinuousBatcher,
+                            )
+
+                            generator = ContinuousBatcher(
+                                generator,
+                                decode_block=min(8, self.decode_block),
+                                policy=self.admission_policy,
+                            )
                     elif self.multihost:
                         import jax
 
@@ -329,21 +348,33 @@ class APIHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    # request bodies above this are rejected before being read — an
+    # unauthenticated client must not be able to buffer arbitrary bytes or
+    # pin a handler thread with a huge/negative Content-Length
+    MAX_BODY = 8 << 20
+
     def do_POST(self):
         route = self.path.split("?")[0]
         handlers = {
             "/v1/completions": self._handle_text_completion,
             "/v1/chat/completions": self._handle_chat_completion,
         }
-        if route not in handlers:
-            return self._error(404, f"unknown route {route}")
         try:
             length = int(self.headers.get("Content-Length", 0))
-            raw = self.rfile.read(length)  # always drain: replying with the
-            # body unread desyncs HTTP/1.1 keep-alive (the leftover bytes
-            # would parse as the next request line)
-        except (ValueError, OSError):
+        except ValueError:
+            length = -1
+        if not 0 <= length <= self.MAX_BODY:
+            self.close_connection = True  # can't safely drain; don't reuse
+            return self._error(413, "invalid or oversized request body")
+        try:
+            raw = self.rfile.read(length)  # always drain — before ANY reply,
+            # including 404/401: replying with the body unread desyncs
+            # HTTP/1.1 keep-alive (the leftover bytes would parse as the
+            # next request line)
+        except OSError:
             return self._error(400, "unreadable request body")
+        if route not in handlers:
+            return self._error(404, f"unknown route {route}")
         if self.api_key:
             # the reference UI sends Authorization: Bearer <key>
             # (ref shard/static/app.js:151) but its server never checks it;
@@ -812,9 +843,6 @@ def main(argv=None):
     if (args.tp > 1 or args.ep > 1) and args.engine == "chained":
         parser.error("--tp/--ep require the fused engine")
     if args.coordinator and (args.num_processes or 1) > 1:
-        if args.concurrent > 1:
-            parser.error("--concurrent is not yet supported with multi-host "
-                         "serving (workers mirror the single-stream protocol)")
         if not args.model:
             parser.error("multi-host serving requires --model (workers load "
                          "the model at startup)")
@@ -864,10 +892,20 @@ def main(argv=None):
             # worker rank: no HTTP — mirror rank 0's step sequence until
             # shutdown (the reference's per-machine shard server,
             # /root/reference/shard/main.py:4-14, without the RPC surface)
-            from mlx_sharding_tpu.parallel.multihost import serve_worker
-
             logger.info("worker rank %d serving", jax.process_index())
-            serve_worker(provider.generator)
+            if args.concurrent > 1:
+                from mlx_sharding_tpu.parallel.multihost import (
+                    serve_worker_batched,
+                )
+
+                serve_worker_batched(
+                    provider.generator,
+                    decode_block=min(8, args.decode_block),
+                )
+            else:
+                from mlx_sharding_tpu.parallel.multihost import serve_worker
+
+                serve_worker(provider.generator)
             return
     server = make_server(provider, args.host, args.port,
                          profile_dir=args.profile_dir, api_key=args.api_key)

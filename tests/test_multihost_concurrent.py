@@ -1,0 +1,140 @@
+"""Multi-host continuous batching: 2-process jax.distributed deployment.
+
+`--concurrent N` under `--coordinator`: rank 0 runs the real scheduler and
+broadcasts each device op; rank 1 mirrors them on an identical batcher
+(parallel/multihost.py batched protocol). Every response must match the
+identical request served by a single-process `--concurrent` server —
+including seeded sampling, slot reuse across requests, interleaved
+admission, and early stream termination (stop sequences → OP_B_CANCEL).
+"""
+
+import signal
+import threading
+
+from tests.test_multihost import (
+    _env,
+    _free_port,
+    _post_completion,
+    _spawn_server,
+    _wait_health,
+    ckpt,  # noqa: F401 — module-scoped fixture reused
+)
+
+CONCURRENT = [
+    "--concurrent", "2", "--paged-pool", "12", "--page-size", "16",
+]
+
+
+def _forced_token(ckpt_dir):
+    """A (token_id, text) pair the battery can force via logit_bias so a
+    stop sequence deterministically truncates the stream mid-request —
+    exercising consumer abandonment (OP_B_CANCEL in the batched protocol)."""
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(ckpt_dir)
+    for tid in range(1, tok.vocab_size):
+        text = tok.decode([tid])
+        # the stop string must re-encode to [tid, tid] (stop sequences are
+        # matched on raw ids) — BPE may merge a doubled token into another id
+        if (
+            text.strip() and text.isprintable()
+            and tok(text + text, add_special_tokens=False)["input_ids"]
+            == [tid, tid]
+        ):
+            return tid, text
+    raise AssertionError("no printable self-doubling token in the tiny vocab")
+
+
+def _run_requests(port, forced):
+    """The request battery, identical against either deployment."""
+    tid, ttext = forced
+    out = {}
+    # stop sequence matched mid-stream: the consumer abandons the request
+    # with 8 tokens unproduced → slot cancel; the next requests prove the
+    # deployment stayed aligned afterwards
+    s, r = _post_completion(
+        port,
+        {"prompt": "the quick", "max_tokens": 10, "seed": 9,
+         "logit_bias": {str(tid): 100.0}, "stop": [ttext + ttext]},
+    )
+    assert s == 200
+    out["cancelled"] = r["choices"][0]["text"]
+    # greedy, slot 0
+    s, r = _post_completion(
+        port, {"prompt": "the quick brown fox", "max_tokens": 8, "seed": 3})
+    assert s == 200
+    out["greedy"] = r["choices"][0]["text"]
+    # seeded sampling — exercises the replicated PRNG chain
+    s, r = _post_completion(
+        port,
+        {"prompt": "hello world", "max_tokens": 8, "seed": 11,
+         "temperature": 0.8, "top_p": 0.9},
+    )
+    assert s == 200
+    out["sampled"] = r["choices"][0]["text"]
+    # multi-chunk prefill (prompt longer than --prefill-chunk 16)
+    s, r = _post_completion(
+        port,
+        {"prompt": "one two three four five six seven eight nine ten "
+                   "eleven twelve thirteen fourteen fifteen sixteen "
+                   "seventeen eighteen", "max_tokens": 6, "seed": 4},
+    )
+    assert s == 200
+    out["long"] = r["choices"][0]["text"]
+    # two interleaved requests — mid-decode admission into the second slot
+    results = [None, None]
+
+    def post(i, body):
+        results[i] = _post_completion(port, body)
+
+    threads = [
+        threading.Thread(target=post, args=(0, {
+            "prompt": "alpha beta", "max_tokens": 10, "seed": 21})),
+        threading.Thread(target=post, args=(1, {
+            "prompt": "gamma delta", "max_tokens": 10, "seed": 22})),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    for i in (0, 1):
+        assert results[i] is not None and results[i][0] == 200
+    out["inter_a"] = results[0][1]["choices"][0]["text"]
+    out["inter_b"] = results[1][1]["choices"][0]["text"]
+    return out
+
+
+def test_two_process_concurrent_matches_single_process(ckpt, tmp_path):  # noqa: F811
+    forced = _forced_token(ckpt)
+    # reference: single process, 4 local devices, same batching config
+    port1 = _free_port()
+    log1 = open(tmp_path / "single.log", "w")
+    p_single = _spawn_server(ckpt, port1, CONCURRENT, 4, log1)
+    try:
+        _wait_health(port1, [p_single])
+        ref = _run_requests(port1, forced)
+    finally:
+        p_single.send_signal(signal.SIGTERM)
+        p_single.wait(timeout=30)
+
+    # deployment under test: 2 processes x 2 devices, same 4-stage mesh
+    port0 = _free_port()
+    coord = f"localhost:{_free_port()}"
+    mh = [*CONCURRENT, "--coordinator", coord, "--num-processes", "2"]
+    log_r0 = open(tmp_path / "rank0.log", "w")
+    log_r1 = open(tmp_path / "rank1.log", "w")
+    r0 = _spawn_server(ckpt, port0, [*mh, "--process-id", "0"], 2, log_r0)
+    r1 = _spawn_server(ckpt, _free_port(), [*mh, "--process-id", "1"], 2, log_r1)
+    try:
+        _wait_health(port0, [r0, r1])
+        got = _run_requests(port0, forced)
+        assert got == ref
+    finally:
+        for p in (r0, r1):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in (r0, r1):
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                p.kill()
