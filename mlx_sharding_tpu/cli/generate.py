@@ -57,7 +57,8 @@ def main(argv=None):
                         help="speculative decoding: a small draft model "
                         "proposes --spec-k tokens per round, the target "
                         "verifies them in one forward — greedy streams are "
-                        "token-exact whatever the draft")
+                        "token-exact; sampled requests use rejection "
+                        "sampling (distribution-exact)")
     parser.add_argument("--spec-k", type=int, default=4,
                         help="speculation window (with --draft-model)")
     parser.add_argument("--keep-quantized", action="store_true",

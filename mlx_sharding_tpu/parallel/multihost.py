@@ -147,7 +147,11 @@ def _request_msg(prompt, temperature, top_p, repetition_penalty,
             np.int32,
         ),
         "floats": np.asarray(
-            [temperature, top_p, repetition_penalty or 1.0, 0.0], np.float32
+            # None-ness rides ONLY in the has_pen header flag: `or 1.0`
+            # would mangle an explicit penalty of 0.0 on the wire
+            [temperature, top_p,
+             1.0 if repetition_penalty is None else repetition_penalty, 0.0],
+            np.float32,
         ),
         "tokens": prompt,
         "bias_idx": bias_idx,
@@ -331,7 +335,10 @@ def _assign_msg(req, slot: int) -> dict:
             np.int32,
         ),
         "floats": np.asarray(
-            [req.temperature, req.top_p, req.repetition_penalty or 1.0, 0.0],
+            # see _request_msg: None-ness rides only in the has_pen flag
+            [req.temperature, req.top_p,
+             1.0 if req.repetition_penalty is None
+             else req.repetition_penalty, 0.0],
             np.float32,
         ),
         "tokens": prompt,

@@ -112,6 +112,36 @@ def top_p_filter(logits: jax.Array, top_p: jax.Array) -> jax.Array:
     return jax.lax.cond(top_p < 1.0, nucleus, lambda lo: lo, logits)
 
 
+def transform_logits(
+    logits: jax.Array,
+    recent_tokens: Optional[jax.Array],
+    params: SamplerParams,
+) -> jax.Array:
+    """bias → repetition penalty: the request-transformed logits every
+    downstream consumer (greedy argmax, logprob reporting, nucleus
+    sampling, speculative verification) derives from."""
+    logits = apply_logit_bias(
+        logits.astype(jnp.float32), params.bias_indices, params.bias_values
+    )
+    if recent_tokens is not None:
+        logits = apply_repetition_penalty(
+            logits, recent_tokens, params.repetition_penalty
+        )
+    return logits
+
+
+def nucleus_logits(lo: jax.Array, params: SamplerParams) -> jax.Array:
+    """Temperature then top-p on transformed logits — the log-domain
+    (unnormalized) final sampling distribution of the sampled branch.
+    Temperature first, THEN the nucleus cut: the kept set must be computed
+    on the tempered distribution (matches mlx_lm top_p_sampling semantics
+    used at ref shard/utils.py:136). Speculative rejection sampling defines
+    both its p and q through this same function, which is what keeps its
+    acceptance ratio aligned with what sample_token actually samples."""
+    safe_temp = jnp.maximum(params.temperature, 1e-6)
+    return top_p_filter(lo / safe_temp, params.top_p)
+
+
 def sample_token(
     key: jax.Array,
     logits: jax.Array,  # (B, V) f32
@@ -122,19 +152,12 @@ def sample_token(
     dynamic scalars, so one compiled program covers every request's sampler
     settings; the sampled branch (gumbel draw + nucleus sort) sits behind a
     ``lax.cond`` so greedy requests — the serving default — skip it."""
-    logits = logits.astype(jnp.float32)
-    logits = apply_logit_bias(logits, params.bias_indices, params.bias_values)
-    if recent_tokens is not None:
-        logits = apply_repetition_penalty(logits, recent_tokens, params.repetition_penalty)
+    logits = transform_logits(logits, recent_tokens, params)
 
     logprobs = jax.nn.log_softmax(logits, axis=-1)
 
     def sampled_fn(lo):
-        safe_temp = jnp.maximum(params.temperature, 1e-6)
-        # Temperature first, THEN the nucleus cut — the kept set must be
-        # computed on the tempered distribution (matches mlx_lm
-        # top_p_sampling semantics used at ref shard/utils.py:136).
-        filtered = top_p_filter(lo / safe_temp, params.top_p)
+        filtered = nucleus_logits(lo, params)
         return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
     token = jax.lax.cond(

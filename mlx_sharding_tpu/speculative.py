@@ -15,13 +15,20 @@ overwritten in place), so rejecting draft tokens costs ONE scalar — set
 ``offset = verified_prefix_end`` — no copying, no paging, no mask
 rebuild. The draft model keeps its own cache and rewinds the same way.
 
-Scope: greedy requests (temperature == 0 — the serving default), where
-prefix acceptance is exact. Sampled requests fall back to the normal
-blocked decode; the rejection-sampling variant for temperature > 0 is a
-future extension. Sampler transforms (logit_bias, repetition penalty)
-participate in verification — the target's choice at each position is
-computed with the same ``sample_token`` transforms and an exactly-evolved
-repetition window, so speculation composes with penalties.
+Greedy requests (temperature == 0 — the serving default) use exact prefix
+acceptance: every emitted token is what plain greedy decode would produce.
+Sampled requests (temperature > 0) use REJECTION SAMPLING (Leviathan et
+al.): the draft SAMPLES its proposals and records its distribution q_i;
+the target's one T=K forward yields p_i; proposal d is accepted with
+probability min(1, p_i(d)/q_i(d)), and the first rejection resamples from
+the residual norm(max(p_i - q_i, 0)). The emitted stream is distributed
+EXACTLY as plain sampling from the target (tested distributionally in
+tests/test_speculative.py) — the draft only changes throughput, never the
+distribution. Both p and q are the fully-transformed distributions
+(logit_bias, repetition penalty over an exactly-evolved window,
+temperature, top-p nucleus), so speculation composes with every sampler
+knob; the token streams differ from non-speculative sampling for the same
+seed (the PRNG is consumed differently), which is inherent to the method.
 """
 
 from __future__ import annotations
@@ -40,9 +47,62 @@ from mlx_sharding_tpu.generate import (
 from mlx_sharding_tpu.sample import (
     init_recent_tokens,
     make_sampler_params,
+    nucleus_logits,
     sample_token,
+    transform_logits,
     update_recent_tokens,
 )
+
+
+def _dist_logits(logits, recent, sp):
+    """The request's full sampling distribution in log domain (unnormalized),
+    via the SAME pipeline sample_token samples from (sample.py
+    transform_logits → nucleus_logits) — p and q below are both defined by
+    it, which is what makes the acceptance ratio meaningful."""
+    return nucleus_logits(transform_logits(logits, recent, sp), sp)
+
+
+def rejection_round(key, drafts, q_logprobs, p_logprobs):
+    """One round of speculative rejection sampling (pure math, jit-safe).
+
+    drafts: (K, B) proposals; q_logprobs / p_logprobs: (K, B, V) draft and
+    target log-distributions at each slot. Returns (gs, m, count):
+    gs (K, B) — per-slot emitted token (draft token where accepted, the
+    residual resample where rejected; only slots ≤ m are meaningful),
+    m (B,) — last emitted slot, count (B,) = m + 1.
+
+    Guarantee (the Leviathan et al. identity, unit-tested directly): the
+    token emitted at a slot is distributed exactly as p at that slot."""
+    K, B = drafts.shape
+    k_u, k_res = jax.random.split(key)
+    u = jax.random.uniform(k_u, (K, B))
+    d_lp_q = jnp.take_along_axis(
+        q_logprobs, drafts[..., None], axis=-1
+    )[..., 0]  # (K, B)
+    d_lp_p = jnp.take_along_axis(
+        p_logprobs, drafts[..., None], axis=-1
+    )[..., 0]
+    # accept with prob min(1, p/q); exp of a clamped-to-0 log ratio avoids
+    # overflow and u < 1 makes ratio >= 1 an unconditional accept
+    accept = u < jnp.exp(jnp.minimum(d_lp_p - d_lp_q, 0.0))
+    reject = ~accept
+
+    # residual distribution per slot: norm(max(p - q, 0)); if its mass is
+    # ~0 (p ≈ q everywhere) resampling from p is the correct limit
+    p = jnp.exp(p_logprobs)
+    q = jnp.exp(q_logprobs)
+    res = jnp.maximum(p - q, 0.0)
+    mass = res.sum(axis=-1, keepdims=True)
+    res_logits = jnp.where(mass > 1e-9, jnp.log(res), p_logprobs)
+    r = jax.vmap(jax.random.categorical)(
+        jax.random.split(k_res, K), res_logits
+    ).astype(jnp.int32)  # (K, B)
+
+    gs = jnp.where(reject, r, drafts)
+    any_rej = reject.any(axis=0)
+    first = jnp.argmax(reject, axis=0)
+    m = jnp.where(any_rej, first, K - 1)
+    return gs, m, (m + 1).astype(jnp.int32)
 
 
 class SpeculativeGenerator:
@@ -69,6 +129,10 @@ class SpeculativeGenerator:
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         self.spec_k = spec_k
+        # acceptance telemetry: tokens emitted per verify round averages
+        # between 1 (draft never agrees) and K (always agrees)
+        self.rounds = 0
+        self.accepted_tokens = 0
         self.target = Generator(
             model, params, max_seq=max_seq, cache_dtype=cache_dtype,
             prefill_chunk=prefill_chunk, decode_block=decode_block,
@@ -135,8 +199,64 @@ class SpeculativeGenerator:
             next_tok = gs[m[0]]
             return gs, count, next_tok, cache, recent
 
+        def draft_sampled_fn(dparams, token, dcache, recent, keys, sp):
+            """K sampled draft proposals + the exact distribution each was
+            drawn from (q_i log rows — the acceptance denominator). The
+            draft sees the target's true recent window and evolves a local
+            copy with its own proposals."""
+
+            def step(carry, key_i):
+                tok, dcache, recent = carry
+                logits, dcache = draft_model(dparams, tok[:, None], dcache)
+                f = _dist_logits(logits[:, -1], recent, sp)
+                qlp = jax.nn.log_softmax(f, axis=-1)
+                tok = jax.random.categorical(key_i, f, axis=-1).astype(
+                    jnp.int32
+                )
+                recent = update_recent_tokens(recent, tok)
+                return (tok, dcache, recent), (tok, qlp)
+
+            (_, dcache, _), (drafts, qlps) = jax.lax.scan(
+                step, (token, dcache, recent), keys
+            )
+            return drafts, qlps, dcache  # (K, B), (K, B, V)
+
+        def verify_sampled_fn(params, token, drafts, qlps, cache, recent,
+                              key, sp):
+            """Target T=K forward + rejection sampling. Same bookkeeping as
+            the greedy verify: gs[m] is the next feed token and is NOT in
+            the cache; offset keeps exactly the verified prefix."""
+            x = jnp.concatenate([token[:, None], drafts[:-1].T], axis=1)
+            off0 = cache.offset
+            logits, cache = model(params, x, cache)  # (B, K, V)
+
+            def score(carry, i):
+                recent = carry
+                f = _dist_logits(logits[:, i], recent, sp)
+                plp = jax.nn.log_softmax(f, axis=-1)
+                # the consumed token at slot i+1 is drafts[i]; evolving with
+                # it is exact on the accepted prefix (discarded past it)
+                recent = update_recent_tokens(recent, drafts[i])
+                return recent, plp
+
+            _, plps = jax.lax.scan(score, recent, jnp.arange(K))  # (K, B, V)
+            gs, m, count = rejection_round(key, drafts, qlps, plps)
+
+            def replay(carry, i):
+                recent = carry
+                upd = update_recent_tokens(recent, gs[i])
+                return jnp.where((i <= m)[:, None], upd, recent), None
+
+            recent, _ = jax.lax.scan(replay, recent, jnp.arange(K))
+            cache = cache._replace(offset=off0 + count[0])
+            return gs, count, gs[m[0]], cache, recent
+
         self._draft_block = jax.jit(draft_block_fn, donate_argnums=(2,))
         self._verify = jax.jit(verify_fn, donate_argnums=(3, 4))
+        self._draft_sampled = jax.jit(draft_sampled_fn, donate_argnums=(2,))
+        self._verify_sampled = jax.jit(
+            verify_sampled_fn, donate_argnums=(4, 5)
+        )
         self._rewind = jax.jit(
             lambda c, off: c._replace(offset=off), donate_argnums=(0,)
         )
@@ -155,10 +275,9 @@ class SpeculativeGenerator:
         max_tokens: int = 256,
         want_logprobs: bool = False,
     ) -> Iterator[tuple[int, Optional[TokenLogprobs]]]:
-        if temperature > 0 or want_logprobs:
-            # sampled requests need the rejection-sampling variant;
+        if want_logprobs:
             # logprobs need per-token summaries the verify path doesn't
-            # compute — both take the exact normal path
+            # compute — take the exact normal path
             yield from self.target.generate_step(
                 prompt_tokens, temperature=temperature, top_p=top_p,
                 repetition_penalty=repetition_penalty,
@@ -168,7 +287,10 @@ class SpeculativeGenerator:
             )
             return
 
-        sp = make_sampler_params(0.0, top_p, repetition_penalty, logit_bias)
+        sampled = temperature > 0
+        sp = make_sampler_params(
+            temperature, top_p, repetition_penalty, logit_bias
+        )
         prompt = np.asarray(prompt_tokens, np.int32).reshape(
             self.target.batch, -1
         )
@@ -179,10 +301,14 @@ class SpeculativeGenerator:
                 f"capacity {self.max_seq}"
             )
 
+        import time as _time
+
         t = self.target
         cache = t.model.make_cache(t.batch, t.max_seq, t.cache_dtype)
         recent = init_recent_tokens(t.batch, repetition_context_size, prompt)
-        key = jax.random.PRNGKey(0)
+        key = jax.random.PRNGKey(
+            int(_time.time_ns()) & 0x7FFFFFFF if seed is None else seed
+        )
 
         last_logits, cache = t.run_prefill(prompt, cache)
         # draft prefills the same prompt into its own cache
@@ -219,11 +345,22 @@ class SpeculativeGenerator:
                 )
                 return
 
-            drafts, dcache = self._draft_block(d.params, tok, dcache)
-            gs, count, tok, cache, recent = self._verify(
-                t.params, tok, drafts, cache, recent, sp
-            )
+            if sampled:
+                key, kd, kv = jax.random.split(key, 3)
+                drafts, qlps, dcache = self._draft_sampled(
+                    d.params, tok, dcache, recent, jax.random.split(kd, K), sp
+                )
+                gs, count, tok, cache, recent = self._verify_sampled(
+                    t.params, tok, drafts, qlps, cache, recent, kv, sp
+                )
+            else:
+                drafts, dcache = self._draft_block(d.params, tok, dcache)
+                gs, count, tok, cache, recent = self._verify(
+                    t.params, tok, drafts, cache, recent, sp
+                )
             n, gs_host = int(count[0]), np.asarray(gs)
+            self.rounds += 1
+            self.accepted_tokens += n
             # draft consumed [t0, d1..d_{K-1}] = K rows; keep the verified
             # prefix (the accepted tokens ARE the draft's inputs there)
             dcache = self._rewind(

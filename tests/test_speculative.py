@@ -100,11 +100,87 @@ def test_spec_k_values(pair):
         assert [t for t, _ in spec.generate_step(prompt, max_tokens=15)] == want
 
 
-def test_sampled_requests_fall_back(pair):
+def test_logprobs_requests_fall_back(pair):
     spec, ref = pair
-    kw = dict(temperature=0.8, seed=42, max_tokens=10)
+    kw = dict(seed=42, max_tokens=10, want_logprobs=True)
     want = [t for t, _ in ref.generate_step([4, 5], **kw)]
     assert [t for t, _ in spec.generate_step([4, 5], **kw)] == want
+
+
+# ---------------------------------------------------------------- sampled
+# temperature > 0: rejection sampling. The stream legitimately differs
+# from non-speculative sampling with the same seed (PRNG consumed
+# differently); what must hold is the DISTRIBUTION identity, the
+# all-accept behavior for a perfect draft, and per-seed determinism.
+
+
+def test_rejection_round_emits_target_distribution():
+    """The Leviathan et al. identity, tested on the pure round function:
+    whatever q is, the slot-0 emitted token is distributed exactly as p."""
+    from mlx_sharding_tpu.speculative import rejection_round
+
+    V, K, N = 12, 3, 20000
+    kp, kq, kd = jax.random.split(jax.random.PRNGKey(0), 3)
+    p_logits = jax.random.normal(kp, (K, 1, V)) * 1.5
+    q_logits = jax.random.normal(kq, (K, 1, V)) * 1.5
+    plp = jax.nn.log_softmax(p_logits, axis=-1)
+    qlp = jax.nn.log_softmax(q_logits, axis=-1)
+
+    def one(key):
+        k_draft, k_round = jax.random.split(key)
+        # draft proposes from q, independently per slot (any proposal chain
+        # is admissible for the slot-0 identity)
+        drafts = jax.vmap(jax.random.categorical)(
+            jax.random.split(k_draft, K), qlp[:, 0]
+        ).astype(jnp.int32)[:, None]
+        gs, m, count = rejection_round(k_round, drafts, qlp, plp)
+        return gs[0, 0]
+
+    toks = np.asarray(jax.vmap(one)(jax.random.split(jax.random.PRNGKey(7), N)))
+    empirical = np.bincount(toks, minlength=V) / N
+    expected = np.asarray(jnp.exp(plp[0, 0]))
+    tv = 0.5 * np.abs(empirical - expected).sum()
+    assert tv < 0.03, (tv, empirical, expected)
+
+
+def test_sampled_perfect_draft_accepts_everything():
+    """Draft == target ⇒ p == q at every slot ⇒ acceptance probability 1:
+    every round must emit the full window."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    spec = SpeculativeGenerator(
+        model, params, model, params, spec_k=4, max_seq=96,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    toks = [t for t, _ in spec.generate_step(
+        [5, 9, 2], max_tokens=21, temperature=0.9, top_p=0.95, seed=3
+    )]
+    assert len(toks) == 21
+    assert spec.rounds > 0
+    assert spec.accepted_tokens == spec.spec_k * spec.rounds
+
+
+def test_sampled_deterministic_per_seed(pair):
+    spec, _ = pair
+    kw = dict(temperature=0.8, top_p=0.9, max_tokens=18, seed=11,
+              repetition_penalty=1.3, logit_bias={7: 2.0})
+    a = [t for t, _ in spec.generate_step([4, 5], **kw)]
+    b = [t for t, _ in spec.generate_step([4, 5], **kw)]
+    assert a == b
+    c = [t for t, _ in spec.generate_step([4, 5], **{**kw, "seed": 12})]
+    assert a != c  # a 300-vocab 18-token collision is astronomically unlikely
+
+
+def test_sampled_capacity_edge(pair):
+    """The blocked-decode tail engages for sampled requests too and the
+    stream stays within capacity."""
+    spec, _ = pair
+    prompt = list(range(1, 60))
+    toks = [t for t, _ in spec.generate_step(
+        prompt, max_tokens=37, temperature=0.7, seed=5
+    )]
+    assert len(toks) == 37
 
 
 def test_capacity_edge(pair):
