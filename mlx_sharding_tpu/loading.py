@@ -91,9 +91,10 @@ def dequantize_weights(
     collapse to a dense weight — mirrors the predicate the reference feeds
     nn.quantize, a param is quantized iff its ``.scales`` sibling exists
     (shard/utils.py:58-63). With ``keep_packed_layers``, decoder-layer
-    projections stay packed as ``{q, scales, biases}`` dicts (scales/biases
-    promoted to f32) for the fused dequant-matmul path; embed/head/norms are
-    still dequantized so every engine's embed/vocab machinery is unaffected.
+    projections AND the vocab pair (embed_tokens / lm_head — published
+    4-bit checkpoints quantize them too, and the head matmul is the largest
+    dense per-token read) stay packed as ``{q, scales, biases}`` dicts for
+    the fused dequant-matmul path; norms are still dequantized.
     ``keep_dense_re`` (model.packed_keep_dense_re) names layer weights that
     are consumed as tensors, not matmul operands — those dequantize even in
     packed mode (MoE routers, MLA kv_b under the compressed cache)."""
@@ -108,7 +109,11 @@ def dequantize_weights(
         if leaf == "weight" and f"{base}.scales" in weights:
             if (
                 keep_packed_layers
-                and LAYER_RE.search(name)
+                and (
+                    LAYER_RE.search(name)
+                    or "embed_tokens" in name
+                    or "lm_head" in name
+                )
                 and not (dense_re and dense_re.search(name))
             ):
                 # scales/biases stay in the checkpoint dtype (fp16 for
@@ -197,6 +202,9 @@ def load_model(
         )
     weights = filter_stage_weights(weights, config)
     params = model.map_weights(weights, dtype)
+    # paths that must materialize dense values from packed params (embed
+    # row dequant) produce this dtype, so packed and dense loads agree
+    model.compute_dtype = dtype
     return model, params
 
 
@@ -248,3 +256,14 @@ def first_key(weights: dict, *candidates: str):
         if c in weights:
             return weights[c]
     raise KeyError(f"none of {candidates} present in checkpoint")
+
+
+def vocab_param(value, dtype, transpose: bool = False):
+    """Embed table / LM head param: packed triples (keep-quantized loads)
+    stay in MLX (V, …) orientation — base.embed_tokens/apply_head consume
+    them directly; dense arrays cast (and for untied heads transpose to the
+    (H, V) matmul orientation)."""
+    if isinstance(value, dict):
+        return value
+    value = jnp.asarray(value, dtype)
+    return value.T if transpose else value

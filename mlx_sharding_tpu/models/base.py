@@ -23,6 +23,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from mlx_sharding_tpu.cache import KVCache, init_cache
+from mlx_sharding_tpu.ops.quant import (
+    dequantize,
+    is_quantized,
+    linear as quant_linear,
+)
 
 
 def dense_init(key, in_dim: int, out_dim: int, dtype, scale: float | None = None):
@@ -162,8 +167,27 @@ class BaseModel:
     def init_params(self, key, dtype=jnp.bfloat16):
         raise NotImplementedError
 
+    # compute dtype for paths that must materialize dense values from
+    # packed 4-bit params (embed row dequant); load_model overrides it with
+    # the checkpoint load dtype so packed and dense loads agree bit-for-bit
+    compute_dtype = jnp.bfloat16
+
+    def _quant_args(self) -> tuple[int, int]:
+        q = getattr(self.config, "quantization", None) or {}
+        return int(q.get("group_size", 64)), int(q.get("bits", 4))
+
     def embed_tokens(self, params, tokens):
-        return jnp.take(params["embed"]["weight"], tokens, axis=0)
+        w = params["embed"]["weight"]
+        if is_quantized(w):
+            # gather the packed rows for these tokens and dequantize just
+            # those — O(T·H) work; the (V, H) dense table never exists
+            gs, bits = self._quant_args()
+            rows = jax.tree.map(lambda a: jnp.take(a, tokens, axis=0), w)
+            return dequantize(
+                rows["q"], rows["scales"], rows["biases"], gs, bits,
+                self.compute_dtype,
+            )
+        return jnp.take(w, tokens, axis=0)
 
     # -- embed/head decomposition -----------------------------------------
     # The fused engine vocab-shards the embedding table and LM head over the
@@ -195,8 +219,16 @@ class BaseModel:
     def apply_head(self, params, h):
         h = self.head_input(params, h)
         w = (
-            params["embed"]["weight"].T
+            params["embed"]["weight"]
             if self.head_is_tied()
             else params["lm_head"]["weight"]
         )
+        if is_quantized(w):
+            # MLX packs (out, in) = (V, H) — exactly quant.linear's packed
+            # orientation for the H→V projection, tied or not; the vocab
+            # matmul runs off the packed bytes (4x less weight bandwidth
+            # on the biggest dense read of a decode step)
+            gs, bits = self._quant_args()
+            return self.head_transform(quant_linear(h, w, gs, bits))
+        w = w.T if self.head_is_tied() else w
         return self.head_transform(h @ w)

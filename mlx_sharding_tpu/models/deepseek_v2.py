@@ -355,7 +355,7 @@ class DeepseekV2Model(BaseModel):
         """Stage-filtered HF tensors → {dense: (Ld,…), moe: (Lm,…)} stacks.
         Per-expert tensors fuse into switch stacks — the load-time version of
         the reference's sanitize stacking (deepseek_v2.py:101-112)."""
-        from mlx_sharding_tpu.loading import fetch_weight, first_key, stack_tree
+        from mlx_sharding_tpu.loading import fetch_weight, first_key, stack_tree, vocab_param
 
         cfg = self.config
         attn_map = self._attn_map()
@@ -422,11 +422,11 @@ class DeepseekV2Model(BaseModel):
         params = {"layers": layers}
         if cfg.needs_embed:
             embed = first_key(weights, "model.embed_tokens.weight", "embed_tokens.weight")
-            params["embed"] = {"weight": jnp.asarray(embed, dtype)}
+            params["embed"] = {"weight": vocab_param(embed, dtype)}
         if cfg.needs_head:
             norm = first_key(weights, "model.norm.weight", "norm.weight")
             params["final_norm"] = {"weight": jnp.asarray(norm, dtype)}
-            params["lm_head"] = {"weight": jnp.asarray(weights["lm_head.weight"], dtype).T}
+            params["lm_head"] = {"weight": vocab_param(weights["lm_head.weight"], dtype, transpose=True)}
         return params
 
     def init_params(self, key, dtype=jnp.bfloat16):

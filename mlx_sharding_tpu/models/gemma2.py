@@ -141,7 +141,7 @@ class Gemma2Model(BaseModel):
     }
 
     def map_weights(self, weights: dict, dtype=jnp.bfloat16) -> dict:
-        from mlx_sharding_tpu.loading import collect_layer_stack, first_key
+        from mlx_sharding_tpu.loading import collect_layer_stack, first_key, vocab_param
 
         cfg = self.config
         layers = collect_layer_stack(weights, cfg, self.HF_LAYER_MAP, dtype)
@@ -149,7 +149,7 @@ class Gemma2Model(BaseModel):
         params = {"layers": layers}
         if cfg.needs_embed:
             embed = first_key(weights, "model.embed_tokens.weight", "embed_tokens.weight")
-            params["embed"] = {"weight": jnp.asarray(embed, dtype)}
+            params["embed"] = {"weight": vocab_param(embed, dtype)}
         if cfg.needs_head:
             norm = first_key(weights, "model.norm.weight", "norm.weight")
             params["final_norm"] = {"weight": jnp.asarray(norm, dtype)}

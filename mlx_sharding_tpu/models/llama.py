@@ -157,7 +157,11 @@ class LlamaModel(BaseModel):
         scan-ready stacked pytree. Plays the role of the reference models'
         sanitize + load_weights (shard/server/model/llama.py:92-107,
         shard/utils.py:66-67)."""
-        from mlx_sharding_tpu.loading import collect_layer_stack, first_key
+        from mlx_sharding_tpu.loading import (
+            collect_layer_stack,
+            first_key,
+            vocab_param,
+        )
 
         cfg = self.config
         layer_map = dict(self.HF_LAYER_MAP)
@@ -172,13 +176,13 @@ class LlamaModel(BaseModel):
         params = {"layers": collect_layer_stack(weights, cfg, layer_map, dtype)}
         if cfg.needs_embed:
             embed = first_key(weights, "model.embed_tokens.weight", "embed_tokens.weight")
-            params["embed"] = {"weight": jnp.asarray(embed, dtype)}
+            params["embed"] = {"weight": vocab_param(embed, dtype)}
         if cfg.needs_head:
             norm = first_key(weights, "model.norm.weight", "norm.weight")
             params["final_norm"] = {"weight": jnp.asarray(norm, dtype)}
             if not cfg.tie_word_embeddings:
                 head = first_key(weights, "lm_head.weight")
-                params["lm_head"] = {"weight": jnp.asarray(head, dtype).T}
+                params["lm_head"] = {"weight": vocab_param(head, dtype, transpose=True)}
         return params
 
     def init_params(self, key, dtype=jnp.bfloat16):

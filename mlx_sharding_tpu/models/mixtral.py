@@ -140,6 +140,7 @@ class MixtralModel(BaseModel):
             fetch_weight,
             first_key,
             stack_tree,
+            vocab_param,
         )
 
         cfg = self.config
@@ -170,12 +171,12 @@ class MixtralModel(BaseModel):
         params = {"layers": layers}
         if cfg.needs_embed:
             embed = first_key(weights, "model.embed_tokens.weight", "embed_tokens.weight")
-            params["embed"] = {"weight": jnp.asarray(embed, dtype)}
+            params["embed"] = {"weight": vocab_param(embed, dtype)}
         if cfg.needs_head:
             norm = first_key(weights, "model.norm.weight", "norm.weight")
             params["final_norm"] = {"weight": jnp.asarray(norm, dtype)}
             if not cfg.tie_word_embeddings:
-                params["lm_head"] = {"weight": jnp.asarray(weights["lm_head.weight"], dtype).T}
+                params["lm_head"] = {"weight": vocab_param(weights["lm_head.weight"], dtype, transpose=True)}
         return params
 
     def init_params(self, key, dtype=jnp.bfloat16):

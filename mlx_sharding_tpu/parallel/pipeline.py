@@ -38,7 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mlx_sharding_tpu.cache import KVCache
-from mlx_sharding_tpu.ops.quant import is_quantized
+from mlx_sharding_tpu.ops.quant import dequantize, is_quantized
 from mlx_sharding_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_TP
 from mlx_sharding_tpu.sample import (
     SamplerParams,
@@ -353,10 +353,25 @@ class PipelineEngine:
         self._head_tied = model.head_is_tied()
         Vs = -(-cfg.vocab_size // S)
         table = params["embed"]["weight"]
+        if is_quantized(table):
+            # the vocab-sharded embed/head machinery is dense; a packed
+            # table (keep-quantized load) dequantizes once at build — each
+            # device still holds only its V/S rows afterwards
+            gs, bits = model._quant_args()
+            table = dequantize(
+                table["q"], table["scales"], table["biases"], gs, bits,
+                model.compute_dtype,
+            )
         table = jnp.pad(table, ((0, Vs * S - table.shape[0]), (0, 0)))
         vparts = [table.reshape(S, Vs, -1)]
         if not self._head_tied:
             head = params["lm_head"]["weight"]  # (H, V)
+            if is_quantized(head):
+                gs, bits = model._quant_args()
+                head = dequantize(
+                    head["q"], head["scales"], head["biases"], gs, bits,
+                    model.compute_dtype,
+                ).T  # packed is MLX (V, H); the engine wants (H, V)
             head = jnp.pad(head, ((0, 0), (0, Vs * S - head.shape[1])))
             # (S, H, Vs) so each device's slice is its vocab shard
             vparts.append(head.reshape(-1, S, Vs).transpose(1, 0, 2))
@@ -625,6 +640,63 @@ class PipelineEngine:
             out = jax.lax.psum(out, AXIS_PP)  # only stage S-1 contributed
             logits = self._vs_head(shared, vparts, out)  # (M, B, V) f32
             return logits, k[None], v[None]
+
+        def body_s1(layer_params, masks, vparts, shared, tokens, k, v,
+                    offsets, active, n_valid, table):
+            """S == 1 fast path: every microbatch is resident on the one
+            stage, so the tick rotation above — which would run M sequential
+            forwards, streaming the weights M times — collapses to ONE
+            vmapped forward. XLA batches each layer's matmuls over the M
+            lanes, so the M-slot continuous-batching step streams the
+            weights once: aggregate decode throughput scales with slots
+            instead of dividing by them. Per-lane KV views are gathered
+            up front (the same reads the tick path does) and the dirty
+            slices written back in a short sequential loop — lanes only
+            ever collide on the scratch slice, where order is garbage
+            anyway."""
+            layer_params = jax.tree.map(lambda x: x[0], layer_params)
+            masks = jax.tree.map(lambda x: x[0], masks)
+            vparts = jax.tree.map(lambda x: x[0], vparts)
+            k, v = k[0], v[0]
+            s = jax.lax.axis_index(AXIS_PP)
+            offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
+            m_write = jnp.where(active, jnp.arange(M), M)  # inactive → scratch
+            offset_m = offsets_pad[m_write]
+
+            h_all = self._vs_embed(s, vparts, tokens).astype(k.dtype)  # (M, B, T, H)
+
+            def read(mw):
+                k_m, v_m, row = self._kv_read(paged, k, v, table, mw)
+                return (k_m, v_m, row) if paged else (k_m, v_m, mw)
+
+            k_ms, v_ms, rows = jax.vmap(read)(m_write)
+
+            def micro(h_m, k_m, v_m, off):
+                return model.run_layers(
+                    layer_params, h_m, k_m, v_m, off, mask=masks, **rl_kwargs
+                )
+
+            h_outs, k_ms, v_ms = jax.vmap(micro)(h_all, k_ms, v_ms, offset_m)
+
+            def wr(i, kv):
+                k, v = kv
+                return self._kv_write(
+                    paged, k, v, k_ms[i], v_ms[i],
+                    rows[i] if paged else None, m_write[i], offset_m[i],
+                )
+
+            k, v = jax.lax.fori_loop(0, M, wr, (k, v))
+            out = jax.lax.dynamic_index_in_dim(
+                h_outs, n_valid - 1, 2, keepdims=False
+            )  # (M, B, H)
+            out = jnp.where(active[:, None, None], out, 0).astype(k.dtype)
+            out = jax.lax.psum(out, AXIS_PP)  # identity at S=1; keeps the
+            # body shape identical to the rotated one
+            logits = self._vs_head(shared, vparts, out)
+            return logits, k[None], v[None]
+
+        if S == 1:
+            body = body_s1
 
         spec_stage, spec_rep = P(AXIS_PP), P()
         inner = jax.shard_map(

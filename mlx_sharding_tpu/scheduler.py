@@ -157,6 +157,7 @@ class ContinuousBatcher:
 
         # host-side slot table
         self._slots: list[Optional[_Request]] = [None] * self.M
+        self._prefill_rr = 0  # round-robin cursor for admission fairness
 
         self._first_sample = jax.jit(self._first_sample_fn)
 
@@ -511,8 +512,15 @@ class ContinuousBatcher:
 
     def _tick(self):
         """One scheduler iteration: reap, admit waiting requests into free
-        slots (policy + page-reservation gated), run one prefill chunk per
-        mid-admission request, one decode block for active slots."""
+        slots (policy + page-reservation gated), prefill mid-admission
+        requests, one decode block for active slots.
+
+        Prefill fairness: every prefill chunk stalls every decoding slot
+        for its duration, so while anything is decoding, at most ONE chunk
+        runs per tick (round-robin across admitting requests) — admission
+        latency for long prompts trades against decode jitter bounded at
+        one chunk per block. With nothing decoding, all admitting requests
+        advance at full rate."""
         self._reap_cancelled()
         self._drain_submissions()
         self._admit_waiting()
@@ -520,8 +528,16 @@ class ContinuousBatcher:
             r for r in self._slots
             if r is not None and r.prefill_pos < r.prompt.size
         ]
-        for req in prefilling:
-            self._prefill_one_chunk(req)
+        decoding = bool(np.asarray(self.active).any())
+        if prefilling:
+            if decoding:
+                self._prefill_rr += 1
+                self._prefill_one_chunk(
+                    prefilling[self._prefill_rr % len(prefilling)]
+                )
+            else:
+                for req in prefilling:
+                    self._prefill_one_chunk(req)
         if bool(np.asarray(self.active).any()):
             self._decode_once()
         elif not any(self._slots):

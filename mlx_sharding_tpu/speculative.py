@@ -160,6 +160,21 @@ class SpeculativeGenerator:
             )
             return drafts, dcache  # drafts (K, B)
 
+        def finish_round(gs, m, count, off0, cache, recent):
+            """Shared verify epilogue (greedy and rejection-sampled rounds):
+            replay ONLY the emitted tokens into the recent window, keep
+            exactly the verified prefix in the cache (gs[m] is the next
+            feed token and is NOT cached), return the round tuple."""
+
+            def replay(carry, i):
+                recent = carry
+                upd = update_recent_tokens(recent, gs[i])
+                return jnp.where((i <= m)[:, None], upd, recent), None
+
+            recent, _ = jax.lax.scan(replay, recent, jnp.arange(K))
+            cache = cache._replace(offset=off0 + count[0])
+            return gs, count, gs[m[0]], cache, recent
+
         def verify_fn(params, token, drafts, cache, recent, sp):
             """One target forward over [t0, d1..d_{K-1}] scores every draft
             position; acceptance walks the agreement prefix. Returns the
@@ -185,19 +200,7 @@ class SpeculativeGenerator:
             first = jnp.argmax(mism, axis=0)  # first True (0 if none)
             m = jnp.where(any_mism, first, K - 1)
             count = (m + 1).astype(jnp.int32)  # tokens emitted this round
-
-            # recent window: replay ONLY the accepted tokens
-            def replay(carry, i):
-                recent = carry
-                upd = update_recent_tokens(recent, gs[i])
-                return jnp.where((i <= m)[:, None], upd, recent), None
-
-            recent, _ = jax.lax.scan(replay, recent, jnp.arange(K))
-
-            # offset rollback: model() advanced by K; keep the verified prefix
-            cache = cache._replace(offset=off0 + count[0])
-            next_tok = gs[m[0]]
-            return gs, count, next_tok, cache, recent
+            return finish_round(gs, m, count, off0, cache, recent)
 
         def draft_sampled_fn(dparams, token, dcache, recent, keys, sp):
             """K sampled draft proposals + the exact distribution each was
@@ -241,15 +244,7 @@ class SpeculativeGenerator:
 
             _, plps = jax.lax.scan(score, recent, jnp.arange(K))  # (K, B, V)
             gs, m, count = rejection_round(key, drafts, qlps, plps)
-
-            def replay(carry, i):
-                recent = carry
-                upd = update_recent_tokens(recent, gs[i])
-                return jnp.where((i <= m)[:, None], upd, recent), None
-
-            recent, _ = jax.lax.scan(replay, recent, jnp.arange(K))
-            cache = cache._replace(offset=off0 + count[0])
-            return gs, count, gs[m[0]], cache, recent
+            return finish_round(gs, m, count, off0, cache, recent)
 
         self._draft_block = jax.jit(draft_block_fn, donate_argnums=(2,))
         self._verify = jax.jit(verify_fn, donate_argnums=(3, 4))

@@ -66,8 +66,9 @@ def test_linear_dispatch_packed_vs_dense():
 
 
 def _quantized_tiny_llama(tmp_path: Path, group_size: int = 64):
-    """Write a tiny llama checkpoint whose decoder projections are MLX-style
-    4-bit triples (config.quantization present)."""
+    """Write a tiny llama checkpoint whose decoder projections AND vocab
+    pair (embed_tokens / lm_head — published 4-bit checkpoints quantize
+    both) are MLX-style 4-bit triples (config.quantization present)."""
     from safetensors.numpy import save_file
 
     cfg = dict(
@@ -90,9 +91,9 @@ def _quantized_tiny_llama(tmp_path: Path, group_size: int = 64):
         tensors[name.replace(".weight", ".scales")] = s
         tensors[name.replace(".weight", ".biases")] = b
 
-    dense("model.embed_tokens.weight", (128, 64))
+    quant("model.embed_tokens.weight", 128, 64)
     dense("model.norm.weight", (64,))
-    dense("lm_head.weight", (128, 64))
+    quant("lm_head.weight", 128, 64)
     for i in range(2):
         p = f"model.layers.{i}"
         dense(f"{p}.input_layernorm.weight", (64,))
@@ -122,12 +123,16 @@ def test_keep_quantized_end_to_end(tmp_path):
     model_p, params_p = load_model(
         str(path), dtype=jnp.float32, keep_quantized=True
     )
-    # packed layers really are packed (and much smaller)
+    # packed layers really are packed (and much smaller); the vocab pair
+    # stays packed too — the head matmul is the biggest dense read of a
+    # decode step
     assert is_quantized(
         jax.tree.map(
             lambda x: x, params_p["layers"]["q_proj"], is_leaf=is_quantized
         )
     )
+    assert is_quantized(params_p["embed"]["weight"])
+    assert is_quantized(params_p["lm_head"]["weight"])
     assert _leaf_bytes(params_p["layers"]) < _leaf_bytes(params_d["layers"]) / 2
 
     prompt = [3, 17, 42, 9, 77]
@@ -140,6 +145,38 @@ def test_keep_quantized_end_to_end(tmp_path):
     want = [t for t, _ in ref.generate_step(prompt, max_tokens=10)]
     got = [t for t, _ in gen.generate_step(prompt, max_tokens=10)]
     assert got == want
+
+
+def test_keep_quantized_tied_embedding(tmp_path):
+    """Tied models project logits through the packed embed triple (MLX's
+    (V, H) layout is already the head's packed orientation) and gather
+    embed rows by dequantizing only the looked-up tokens."""
+    import json as _json
+
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.loading import load_model
+
+    path = _quantized_tiny_llama(tmp_path)
+    cfg = _json.loads((path / "config.json").read_text())
+    cfg["tie_word_embeddings"] = True
+    (path / "config.json").write_text(_json.dumps(cfg))
+
+    model_d, params_d = load_model(str(path), dtype=jnp.float32)
+    model_p, params_p = load_model(
+        str(path), dtype=jnp.float32, keep_quantized=True
+    )
+    assert is_quantized(params_p["embed"]["weight"])
+    assert "lm_head" not in params_p
+
+    prompt = [3, 17, 42, 9, 77]
+    ref = Generator(
+        model_d, params_d, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    gen = Generator(
+        model_p, params_p, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=10)]
+    assert [t for t, _ in gen.generate_step(prompt, max_tokens=10)] == want
 
 
 def _packed_ref(tmp_path):
