@@ -28,6 +28,8 @@ _GLOBAL_WINDOW = 1 << 30  # "no window" encoded as a huge traced window
 
 
 class Gemma2Model(BaseModel):
+    supports_packed = True
+
     def __init__(self, config: Gemma2Config):
         super().__init__(config)
         self.inv_freq = jnp.asarray(
@@ -48,9 +50,9 @@ class Gemma2Model(BaseModel):
         # head counts derive from the projection shards, so the same code
         # runs the full model and any tp slice (heads split over tp)
         r = rms_norm(h, p["input_norm"], eps, offset=1.0)
-        q = (r @ p["q_proj"]).reshape(b, t, -1, d)
-        k = (r @ p["k_proj"]).reshape(b, t, -1, d)
-        v = (r @ p["v_proj"]).reshape(b, t, -1, d)
+        q = self._linear(r, p["q_proj"]).reshape(b, t, -1, d)
+        k = self._linear(r, p["k_proj"]).reshape(b, t, -1, d)
+        v = self._linear(r, p["v_proj"]).reshape(b, t, -1, d)
         q = apply_rope(q, self.inv_freq, offset)
         k = apply_rope(k, self.inv_freq, offset)
         k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
@@ -59,7 +61,7 @@ class Gemma2Model(BaseModel):
             logit_softcap=cfg.attn_logit_softcapping,
             sliding_window=window,
         )
-        attn_out = attn.reshape(b, t, -1) @ p["o_proj"]
+        attn_out = self._linear(attn.reshape(b, t, -1), p["o_proj"])
         if tp_axis is not None:
             # the post-attention norm is NONLINEAR: partial row-parallel
             # products must be summed BEFORE it, unlike Llama's plain residual
@@ -67,9 +69,11 @@ class Gemma2Model(BaseModel):
         h = h + rms_norm(attn_out, p["post_attn_norm"], eps, offset=1.0)
 
         r = rms_norm(h, p["pre_ffw_norm"], eps, offset=1.0)
-        ff = (
-            jax.nn.gelu(r @ p["gate_proj"], approximate=True) * (r @ p["up_proj"])
-        ) @ p["down_proj"]
+        ff = self._linear(
+            jax.nn.gelu(self._linear(r, p["gate_proj"]), approximate=True)
+            * self._linear(r, p["up_proj"]),
+            p["down_proj"],
+        )
         if tp_axis is not None:
             ff = jax.lax.psum(ff, tp_axis)
         h = h + rms_norm(ff, p["post_ffw_norm"], eps, offset=1.0)

@@ -110,7 +110,12 @@ class ModelProvider:
         paged_pool: Optional[int] = None,
         page_size: Optional[int] = None,
         admission_policy: str = "fifo",
+        draft_model: Optional[str] = None,
+        spec_k: int = 4,
     ):
+        # speculative decoding (single-chip generator path only)
+        self.draft_model = draft_model
+        self.spec_k = spec_k
         self.chat_template = chat_template
         self.keep_quantized = keep_quantized
         # decode steps fused per program launch: 16 amortizes a network-
@@ -249,6 +254,21 @@ class ModelProvider:
 
                             generator = MultiHostPipeline(generator)
                         # ranks > 0 keep the raw engine: serve_worker drives it
+                elif self.draft_model:
+                    from mlx_sharding_tpu.speculative import (
+                        SpeculativeGenerator,
+                    )
+
+                    dmodel, dparams = load_model(
+                        self.draft_model, dtype=cache_dtype,
+                        keep_quantized=self.keep_quantized,
+                    )
+                    generator = SpeculativeGenerator(
+                        model, params, dmodel, dparams, spec_k=self.spec_k,
+                        max_seq=self.max_seq, cache_dtype=cache_dtype,
+                        prefill_chunk=self.prefill_chunk,
+                        decode_block=self.decode_block,
+                    )
                 else:
                     generator = Generator(
                         model, params, max_seq=self.max_seq,
@@ -814,6 +834,13 @@ def main(argv=None):
                         help="waiting-line policy when a request doesn't fit "
                              "the page pool: strict order vs let smaller "
                              "requests jump a blocked head")
+    parser.add_argument("--draft-model", default=None,
+                        help="speculative decoding: a small draft model "
+                             "proposes --spec-k tokens per round (greedy "
+                             "token-exact, sampled distribution-exact). "
+                             "Single-chip generator path only.")
+    parser.add_argument("--spec-k", type=int, default=4,
+                        help="speculation window (with --draft-model)")
     parser.add_argument("--decode-block", type=int, default=16,
                         help="decode steps fused per program launch (token "
                              "pulls amortize over this many tokens; set 1 "
@@ -866,6 +893,15 @@ def main(argv=None):
     chat_template = args.chat_template
     if chat_template and chat_template.startswith("@"):
         chat_template = Path(chat_template[1:]).read_text()
+    if args.draft_model and (
+        args.concurrent > 1 or args.coordinator or args.tp > 1
+        or args.ep > 1 or args.stage_bounds or (args.num_stages or 1) > 1
+        or args.engine == "chained"
+        or args.start_layer is not None or args.end_layer is not None
+    ):
+        parser.error("--draft-model applies to the single-chip full-model "
+                     "generator (no --concurrent/--coordinator/--tp/--ep/"
+                     "stage or layer-range flags)")
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
     if args.paged_pool and args.engine == "chained":
@@ -884,6 +920,7 @@ def main(argv=None):
         chat_template=chat_template, keep_quantized=args.keep_quantized,
         decode_block=args.decode_block, paged_pool=args.paged_pool,
         page_size=args.page_size, admission_policy=args.admission_policy,
+        draft_model=args.draft_model, spec_k=args.spec_k,
     )
     if multihost:
         import jax

@@ -128,6 +128,20 @@ class SpeculativeGenerator:
     ):
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        tv = getattr(model.config, "vocab_size", None)
+        dv = getattr(draft_model.config, "vocab_size", None)
+        if tv != dv:
+            # a mismatched pair would silently emit clamped-index garbage:
+            # draft token ids index the target's embedding/logprob rows
+            raise ValueError(
+                f"draft vocab ({dv}) must match target vocab ({tv}) — "
+                "speculation exchanges raw token ids between the models"
+            )
+        if not (model.config.is_first_stage and model.config.is_last_stage):
+            raise ValueError(
+                "speculative decoding needs the FULL model on one program "
+                "(no start/end-layer stage slice)"
+            )
         self.spec_k = spec_k
         # acceptance telemetry: tokens emitted per verify round averages
         # between 1 (draft never agrees) and K (always agrees)

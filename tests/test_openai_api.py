@@ -241,3 +241,46 @@ def test_api_key_auth():
         assert status == 200  # static UI must load to let the user SET a key
     finally:
         srv.shutdown()
+
+
+def test_speculative_server(server):
+    """--draft-model serving path: a SpeculativeGenerator behind the same
+    HTTP contract. Greedy completions must be byte-identical to the plain
+    generator's (token-exact speculation), and sampled requests must work
+    (rejection sampling)."""
+    from mlx_sharding_tpu.speculative import SpeculativeGenerator
+
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    draft = LlamaModel(LlamaConfig(**{**TINY, "num_hidden_layers": 1}))
+    dparams = draft.init_params(jax.random.PRNGKey(5), jnp.float32)
+    spec = SpeculativeGenerator(
+        model, params, draft, dparams, spec_k=3, max_seq=512,
+        cache_dtype=jnp.float32, prefill_chunk=16,
+    )
+    provider = ModelProvider.__new__(ModelProvider)
+    provider.default_model = "tiny"
+    provider.trust_remote_paths = False
+    provider._key = None
+    provider._load_lock = threading.Lock()
+    provider._set("tiny", spec, ByteTokenizer())
+    srv = make_server(provider, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        body = {"prompt": "hello there", "max_tokens": 12}
+        s1, _, ref = _request(server, "POST", "/v1/completions", body)
+        s2, _, got = _request(port, "POST", "/v1/completions", body)
+        assert s1 == s2 == 200
+        assert (
+            json.loads(got)["choices"][0]["text"]
+            == json.loads(ref)["choices"][0]["text"]
+        )
+        s3, _, sampled = _request(
+            port, "POST", "/v1/completions",
+            {"prompt": "hi", "max_tokens": 8, "temperature": 0.9, "seed": 2},
+        )
+        assert s3 == 200
+        assert isinstance(json.loads(sampled)["choices"][0]["text"], str)
+    finally:
+        srv.shutdown()

@@ -208,20 +208,85 @@ def test_keep_quantized_fused_pipeline(tmp_path):
     assert got == want
 
 
-def test_keep_quantized_unsupported_arch(tmp_path):
-    from mlx_sharding_tpu.loading import load_model
-    import transformers
-    import torch
+def test_keep_quantized_gemma2(tmp_path):
+    """Gemma-2 packed 4-bit: projections through _linear's quant dispatch,
+    tied packed embedding (scaled row-gather dequant on lookup, softcapped
+    packed head matmul) — token parity with the dequantize-at-load path."""
+    import json as _json
 
-    cfg = transformers.Gemma2Config(
-        vocab_size=64, hidden_size=32, intermediate_size=64,
-        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-        head_dim=8, sliding_window=8, query_pre_attn_scalar=8,
+    from safetensors.numpy import save_file
+
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.loading import load_model
+
+    gs = 32
+    cfg = dict(
+        model_type="gemma2", vocab_size=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=8, sliding_window=8,
+        query_pre_attn_scalar=8.0, rms_norm_eps=1e-6, rope_theta=10000.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        tie_word_embeddings=True, max_position_embeddings=128,
+        quantization={"group_size": gs, "bits": 4},
     )
-    m = transformers.Gemma2ForCausalLM(cfg)
-    m.save_pretrained(tmp_path, safe_serialization=True)
+    rng = np.random.default_rng(11)
+    tensors = {}
+
+    def dense(name, shape):
+        tensors[name] = (rng.normal(size=shape) * 0.05).astype(np.float32)
+
+    def quant(name, out_d, in_d):
+        w = (rng.normal(size=(out_d, in_d)) * 0.05).astype(np.float32)
+        q, s, b = quantize(w, group_size=gs, bits=4)
+        tensors[name] = q
+        tensors[name.replace(".weight", ".scales")] = s
+        tensors[name.replace(".weight", ".biases")] = b
+
+    quant("model.embed_tokens.weight", 64, 32)
+    dense("model.norm.weight", (32,))
+    for i in range(2):
+        p = f"model.layers.{i}"
+        for n in ("input_layernorm", "post_attention_layernorm",
+                  "pre_feedforward_layernorm", "post_feedforward_layernorm"):
+            dense(f"{p}.{n}.weight", (32,))
+        quant(f"{p}.self_attn.q_proj.weight", 32, 32)
+        quant(f"{p}.self_attn.k_proj.weight", 16, 32)
+        quant(f"{p}.self_attn.v_proj.weight", 16, 32)
+        quant(f"{p}.self_attn.o_proj.weight", 32, 32)
+        quant(f"{p}.mlp.gate_proj.weight", 64, 32)
+        quant(f"{p}.mlp.up_proj.weight", 64, 32)
+        quant(f"{p}.mlp.down_proj.weight", 32, 64)
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(_json.dumps(cfg))
+
+    model_d, params_d = load_model(str(tmp_path), dtype=jnp.float32)
+    model_p, params_p = load_model(
+        str(tmp_path), dtype=jnp.float32, keep_quantized=True
+    )
+    assert is_quantized(params_p["layers"]["q_proj"])
+    assert is_quantized(params_p["embed"]["weight"])
+
+    prompt = [3, 17, 42, 9]
+    ref = Generator(
+        model_d, params_d, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    gen = Generator(
+        model_p, params_p, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    want = [t for t, _ in ref.generate_step(prompt, max_tokens=10)]
+    assert [t for t, _ in gen.generate_step(prompt, max_tokens=10)] == want
+
+
+def test_keep_quantized_native_checkpoint_rejected(tmp_path):
+    """Native (Orbax) checkpoints store dense weights; keep_quantized on
+    one is a user error, not a silent no-op."""
+    from mlx_sharding_tpu.loading import load_model
+
+    d = tmp_path / "native"
+    d.mkdir()
+    (d / "native_checkpoint.json").write_text("{}")
     with pytest.raises(ValueError, match="keep_quantized"):
-        load_model(str(tmp_path), dtype=jnp.float32, keep_quantized=True)
+        load_model(str(d), dtype=jnp.float32, keep_quantized=True)
 
 
 def test_keep_quantized_chained_pipeline(tmp_path):
@@ -286,3 +351,31 @@ def test_keep_quantized_tp_group_misalignment_rejected(tmp_path):
             model, params, make_mesh(pp=1, tp=2), max_seq=64,
             cache_dtype=jnp.float32, prefill_chunk=8,
         )
+
+
+def test_keep_quantized_unsupported_arch_rejected(tmp_path, monkeypatch):
+    """Architectures without packed wiring must reject keep_quantized
+    loudly instead of silently loading dense (every in-tree family now
+    supports packed, so the branch is exercised by flipping the flag)."""
+    from mlx_sharding_tpu.loading import load_model
+    from mlx_sharding_tpu.models.llama import LlamaModel
+
+    path = _quantized_tiny_llama(tmp_path)
+    monkeypatch.setattr(LlamaModel, "supports_packed", False)
+    with pytest.raises(ValueError, match="keep_quantized is not supported"):
+        load_model(str(path), dtype=jnp.float32, keep_quantized=True)
+
+
+def test_speculative_rejects_mismatched_vocab():
+    from mlx_sharding_tpu.config import LlamaConfig
+    from mlx_sharding_tpu.models.llama import LlamaModel
+    from mlx_sharding_tpu.speculative import SpeculativeGenerator
+
+    tiny = dict(hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+                num_attention_heads=4, num_key_value_heads=2)
+    model = LlamaModel(LlamaConfig(vocab_size=128, **tiny))
+    draft = LlamaModel(LlamaConfig(vocab_size=64, **tiny))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    dparams = draft.init_params(jax.random.PRNGKey(1), jnp.float32)
+    with pytest.raises(ValueError, match="vocab"):
+        SpeculativeGenerator(model, params, draft, dparams, max_seq=64)
