@@ -350,6 +350,22 @@ def main() -> int:
         finally:
             os.environ.pop("MST_FLASH_DECODE", None)
 
+        # flash-prefill e2e A/B: per-kernel µs through the tunnel is too
+        # noisy to trust (observed 397↔880 µs across runs) — prompt_tps /
+        # TTFT with the kernel OFF is the decision-grade comparison for the
+        # MST_FLASH default
+        os.environ["MST_FLASH"] = "0"
+        try:
+            gen_nf = Generator(model, params, max_seq=MAX_SEQ, prefill_chunk=128)
+            detail["decode_bf16_no_flash_prefill"] = measure_decode(
+                gen_nf, prompt, "decode_bf16_no_flash_prefill"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["decode_bf16_no_flash_prefill"] = dict(error=repr(e)[:300])
+            log(f"[decode_bf16_no_flash_prefill] FAILED: {e!r}")
+        finally:
+            os.environ.pop("MST_FLASH", None)
+
         kernel_smoke(detail)
 
         # packed-4bit resident decode: quantize the decoder weights on device,
@@ -412,7 +428,8 @@ def main() -> int:
         # pool — running it earlier starves the packed variants of HBM.
         import gc
 
-        gen = gen64 = gen_q = gen_q64 = gen_fd = qparams = qlayers = None  # noqa: F841
+        gen = gen64 = gen_q = gen_q64 = gen_fd = gen_nf = None  # noqa: F841
+        qparams = qlayers = None  # noqa: F841
         gc.collect()
         try:
             detail["decode_bf16_cb4"] = measure_cb(

@@ -79,7 +79,7 @@ def block_token_logprobs(outs, j, row=0) -> TokenLogprobs:
 
 
 def blocked_token_stream(dispatch, carry, remaining, block_size, want_logprobs,
-                         tok_index=(0,)):
+                         tok_index=(0,), sink=None):
     """The blocked-decode host loop shared by every engine: one-BLOCK
     lookahead — block i+1 is dispatched (chained on block i's device-side
     carry, no host sync) before block i's tokens are pulled, so the host
@@ -87,7 +87,10 @@ def blocked_token_stream(dispatch, carry, remaining, block_size, want_logprobs,
     leaves max(step_time, RTT/block_size) instead of RTT.
 
     ``dispatch(carry) -> (block_outputs, carry)`` launches one block;
-    ``tok_index`` selects the yielded row from the (K, …) token stack."""
+    ``tok_index`` selects the yielded row from the (K, …) token stack.
+    ``sink`` (optional) receives each pulled block's full (K, …) token
+    array — including tokens past ``remaining`` that are never yielded —
+    so a prompt cache can account for every KV row the blocks wrote."""
     n_blocks = -(-remaining // block_size)
     pending, carry = dispatch(carry)
     pending = [pending]
@@ -98,6 +101,8 @@ def blocked_token_stream(dispatch, carry, remaining, block_size, want_logprobs,
             pending.append(nxt)
         outs = jax.device_get(pending.pop(0))
         toks = outs[0]
+        if sink is not None:
+            sink(toks)
         for j in range(toks.shape[0]):
             if emitted >= remaining:
                 break
@@ -141,9 +146,22 @@ class Generator:
         sp_mesh=None,
         sp_decode: bool = False,
         decode_block: int = DEFAULT_DECODE_BLOCK,
+        prompt_cache: bool = False,
     ):
         self.model = model
         self.params = params
+        # Prompt-prefix caching: keep the previous request's KV cache and
+        # token sequence; a new request prefills only past the longest
+        # common token prefix. The chat pattern — system prompt + growing
+        # history — re-sends the whole previous context every turn, so TTFT
+        # drops from O(context) to O(new tokens). Rows past the matched
+        # prefix are stale but NEVER attended (validity derives from the
+        # offset), the same invariant the speculative rollback leans on.
+        # The reference resets every remote cache per request instead
+        # (shard/utils.py:122-124).
+        self._prompt_cache = bool(prompt_cache)
+        self._pc = None  # {"tokens": np (T,), "cache": KVCache}
+        self.last_prefix_hit = 0  # observability + tests
         # optional sequence-parallel prefill: prompts longer than one chunk
         # are sharded over the mesh's sp axis (ring attention) instead of
         # looping chunks on one device — see parallel/sp_prefill.py.
@@ -295,7 +313,38 @@ class Generator:
             )
             return
 
-        cache = self.model.make_cache(self.batch, self.max_seq, self.cache_dtype)
+        # prompt-prefix reuse: consume the previous request's cache (its
+        # buffer is about to be donated either way) and compute the longest
+        # common token prefix. Cap at n_prompt - 1 — at least one token must
+        # prefill to produce logits.
+        use_pc = self._prompt_cache and self.batch == 1
+        pc_hit = 0
+        cache = None
+        if use_pc:
+            pc, self._pc = self._pc, None
+            if pc is not None:
+                known = pc["tokens"]
+                limit = min(known.size, n_prompt - 1)
+                eq = known[:limit] == prompt[0, :limit]
+                pc_hit = limit if eq.all() else int(eq.argmin())
+                # the padded FINAL suffix chunk must not cross max_seq —
+                # dynamic_update_slice would clamp its start and overwrite
+                # valid rows. If a non-aligned hit would overflow, align it
+                # down to a chunk boundary (aligned prefill always fits:
+                # max_seq is a chunk multiple and n_prompt <= max_seq).
+                c = self.prefill_chunk
+                if pc_hit and pc_hit + -(-(n_prompt - pc_hit) // c) * c > self.max_seq:
+                    pc_hit = (pc_hit // c) * c
+                cache = (
+                    pc["cache"]._replace(
+                        offset=jnp.asarray(pc_hit, jnp.int32)
+                    )
+                    if pc_hit > 0
+                    else reset(pc["cache"])  # reuse the buffer, offset 0
+                )
+        self.last_prefix_hit = pc_hit
+        if cache is None:
+            cache = self.model.make_cache(self.batch, self.max_seq, self.cache_dtype)
 
         # chunked prefill (ref does whole-prompt single shot, shard/utils.py:158;
         # chunking bounds activation memory and fixes compile shapes). Capacity
@@ -303,6 +352,7 @@ class Generator:
         use_sp = (
             self._sp_prefill is not None
             and n_prompt > self.prefill_chunk
+            and pc_hit == 0  # sp prefill shards the WHOLE prompt from 0
             # quantum padding may need more cache rows than the prompt itself;
             # fall back to the chunked path rather than fail a fitting request
             and self._sp_prefill.padded_len(n_prompt) <= cache.max_seq
@@ -310,7 +360,7 @@ class Generator:
         if use_sp:
             last_logits, cache = self._sp_prefill(prompt, cache)
         else:
-            last_logits, cache = self.run_prefill(prompt, cache)
+            last_logits, cache = self.run_prefill(prompt[:, pc_hit:], cache)
 
         tok, logprobs, recent, key = self._sample(last_logits, recent, key, sp)
 
@@ -320,22 +370,45 @@ class Generator:
             first_lp = TokenLogprobs(
                 float(chosen[0]), np.asarray(top_i[0]), np.asarray(top_v[0])
             )
-        yield int(tok[0]), first_lp
-        remaining = max_tokens - 1
-        if remaining <= 0:
-            return
+
+        last = {"cache": cache}  # latest un-donated cache in the chain
+        collected: list[np.ndarray] = []
 
         def dispatch(carry):
             outs, t, c, r, kk = self._decode_block(
                 self.params, carry[0], carry[1], carry[2], carry[3],
                 sp, want_logprobs,
             )
+            last["cache"] = c
             return outs, (t, c, r, kk)
 
-        yield from blocked_token_stream(
-            dispatch, (tok, cache, recent, key), remaining,
-            self.decode_block, want_logprobs,
-        )
+        try:
+            yield int(tok[0]), first_lp
+            remaining = max_tokens - 1
+            if remaining <= 0:
+                return
+            yield from blocked_token_stream(
+                dispatch, (tok, cache, recent, key), remaining,
+                self.decode_block, want_logprobs,
+                sink=(lambda toks: collected.append(np.asarray(toks)[:, 0]))
+                if use_pc else None,
+            )
+        finally:
+            if use_pc:
+                # tokens whose KV rows we can ACCOUNT FOR: the prompt plus
+                # every fed decode token from pulled blocks (the last
+                # sampled token was never fed; rows written by dispatched-
+                # but-unpulled lookahead blocks hold tokens we can't name —
+                # the prefix match is simply capped at what we know)
+                fed = [int(np.asarray(tok)[0])]
+                for blk in collected:
+                    fed.extend(int(t) for t in blk)
+                self._pc = {
+                    "tokens": np.concatenate(
+                        [prompt[0], np.asarray(fed[:-1], np.int32)]
+                    ),
+                    "cache": last["cache"],
+                }
 
 
     # ------------------------------------------------------------------

@@ -193,6 +193,12 @@ def load_model(
         raise ValueError(
             f"keep_quantized is not supported for {type(model).__name__}"
         )
+    if keep_quantized and config.quantization is None:
+        # a silent dense load would quietly cost 4x the expected HBM
+        raise ValueError(
+            "keep_quantized requires a quantized checkpoint "
+            "(no 'quantization' key in config.json)"
+        )
     weights = load_raw_weights(model_path)
     if config.quantization is not None:
         weights = dequantize_weights(

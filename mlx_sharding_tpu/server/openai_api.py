@@ -112,10 +112,13 @@ class ModelProvider:
         admission_policy: str = "fifo",
         draft_model: Optional[str] = None,
         spec_k: int = 4,
+        prompt_cache: bool = False,
     ):
         # speculative decoding (single-chip generator path only)
         self.draft_model = draft_model
         self.spec_k = spec_k
+        # prompt-prefix KV reuse across requests (single-chip generator)
+        self.prompt_cache = prompt_cache
         self.chat_template = chat_template
         self.keep_quantized = keep_quantized
         # decode steps fused per program launch: 16 amortizes a network-
@@ -255,13 +258,22 @@ class ModelProvider:
                             generator = MultiHostPipeline(generator)
                         # ranks > 0 keep the raw engine: serve_worker drives it
                 elif self.draft_model:
+                    from mlx_sharding_tpu.loading import load_config
                     from mlx_sharding_tpu.speculative import (
                         SpeculativeGenerator,
                     )
 
+                    # the draft rides the packed path only if IT is a
+                    # quantized checkpoint — a dense draft next to a
+                    # quantized target is a legitimate pairing
+                    draft_quant = (
+                        load_config(
+                            get_model_path(self.draft_model)
+                        ).get("quantization") is not None
+                    )
                     dmodel, dparams = load_model(
                         self.draft_model, dtype=cache_dtype,
-                        keep_quantized=self.keep_quantized,
+                        keep_quantized=self.keep_quantized and draft_quant,
                     )
                     generator = SpeculativeGenerator(
                         model, params, dmodel, dparams, spec_k=self.spec_k,
@@ -275,6 +287,7 @@ class ModelProvider:
                         cache_dtype=cache_dtype,
                         prefill_chunk=self.prefill_chunk,
                         decode_block=self.decode_block,
+                        prompt_cache=self.prompt_cache,
                     )
             from transformers import AutoTokenizer
 
@@ -781,7 +794,10 @@ def make_server(
             "metrics": ServingMetrics(
                 batcher_fn=lambda: provider.generator
                 if getattr(provider.generator, "concurrent", False)
-                else None
+                else None,
+                spec_fn=lambda: provider.generator
+                if hasattr(provider.generator, "accepted_tokens")
+                else None,
             ),
             "profile_dir": profile_dir,
             "api_key": api_key,
@@ -841,6 +857,11 @@ def main(argv=None):
                              "Single-chip generator path only.")
     parser.add_argument("--spec-k", type=int, default=4,
                         help="speculation window (with --draft-model)")
+    parser.add_argument("--prompt-cache", action="store_true",
+                        help="reuse the previous request's KV cache for the "
+                             "longest common prompt prefix (chat turns "
+                             "re-send their whole history: TTFT becomes "
+                             "O(new tokens)). Single-chip generator path.")
     parser.add_argument("--decode-block", type=int, default=16,
                         help="decode steps fused per program launch (token "
                              "pulls amortize over this many tokens; set 1 "
@@ -902,6 +923,15 @@ def main(argv=None):
         parser.error("--draft-model applies to the single-chip full-model "
                      "generator (no --concurrent/--coordinator/--tp/--ep/"
                      "stage or layer-range flags)")
+    if args.prompt_cache and (
+        args.concurrent > 1 or args.coordinator or args.tp > 1
+        or args.ep > 1 or args.stage_bounds or (args.num_stages or 1) > 1
+        or args.engine == "chained" or args.draft_model
+        or args.start_layer is not None or args.end_layer is not None
+    ):
+        parser.error("--prompt-cache applies to the single-chip full-model "
+                     "generator path (no --concurrent/--coordinator/--tp/"
+                     "--ep/stage, layer-range, or --draft-model flags)")
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
     if args.paged_pool and args.engine == "chained":
@@ -921,6 +951,7 @@ def main(argv=None):
         decode_block=args.decode_block, paged_pool=args.paged_pool,
         page_size=args.page_size, admission_policy=args.admission_policy,
         draft_model=args.draft_model, spec_k=args.spec_k,
+        prompt_cache=args.prompt_cache,
     )
     if multihost:
         import jax

@@ -69,6 +69,8 @@ class ServingMetrics:
     # zero-arg callable returning the live ContinuousBatcher (or None) —
     # a callable so model hot-swaps can never leave a stale reference
     batcher_fn: object = None
+    # zero-arg callable returning the live SpeculativeGenerator (or None)
+    spec_fn: object = None
 
     def record_request(
         self,
@@ -136,4 +138,14 @@ class ServingMetrics:
                         "# TYPE mst_kv_pool_pages_high_water gauge",
                         f"mst_kv_pool_pages_high_water {high}",
                     ]
+            spec = self.spec_fn() if self.spec_fn is not None else None
+            if spec is not None:
+                # accepted/round ∈ [1, spec_k]: the draft-quality dial the
+                # operator watches to size --spec-k
+                lines += [
+                    "# TYPE mst_spec_rounds_total counter",
+                    f"mst_spec_rounds_total {spec.rounds}",
+                    "# TYPE mst_spec_tokens_accepted_total counter",
+                    f"mst_spec_tokens_accepted_total {spec.accepted_tokens}",
+                ]
         return "\n".join(lines) + "\n"

@@ -282,5 +282,8 @@ def test_speculative_server(server):
         )
         assert s3 == 200
         assert isinstance(json.loads(sampled)["choices"][0]["text"], str)
+        s4, _, metrics = _request(port, "GET", "/metrics")
+        assert s4 == 200 and b"mst_spec_rounds_total" in metrics
+        assert b"mst_spec_tokens_accepted_total" in metrics
     finally:
         srv.shutdown()

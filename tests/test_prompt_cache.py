@@ -1,0 +1,163 @@
+"""Prompt-prefix caching (generate.py): a new request reuses the previous
+request's KV rows for the longest common token prefix and prefills only the
+rest. Streams must be EXACTLY what an uncached generator produces — prefix
+reuse is a pure prefill shortcut, never a semantic change."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.generate import Generator
+from mlx_sharding_tpu.models.llama import LlamaModel
+
+TINY = dict(
+    vocab_size=300,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    cached = Generator(
+        model, params, max_seq=128, cache_dtype=jnp.float32,
+        prefill_chunk=8, decode_block=4, prompt_cache=True,
+    )
+    plain = Generator(
+        model, params, max_seq=128, cache_dtype=jnp.float32,
+        prefill_chunk=8, decode_block=4,
+    )
+    return cached, plain
+
+
+def run(gen, prompt, **kw):
+    return [t for t, _ in gen.generate_step(prompt, **kw)]
+
+
+def test_chat_turn_pattern(pair):
+    """Turn 2 re-sends turn 1's prompt + reply + new text — the realistic
+    chat shape. The hit must cover at least the whole first prompt and the
+    stream must match an uncached generator exactly."""
+    cached, plain = pair
+    p1 = [5, 9, 2, 44, 17, 80, 3, 14, 9, 9, 31]
+    reply = run(cached, p1, max_tokens=9)
+    assert cached.last_prefix_hit == 0  # cold start
+
+    p2 = p1 + reply + [77, 12, 5]
+    want = run(plain, p2, max_tokens=8)
+    got = run(cached, p2, max_tokens=8)
+    assert got == want
+    assert cached.last_prefix_hit >= len(p1)
+
+
+def test_exact_repeat(pair):
+    cached, plain = pair
+    p = [8, 1, 99, 42, 6, 13, 27]
+    run(cached, p, max_tokens=5)
+    want = run(plain, p, max_tokens=5)
+    got = run(cached, p, max_tokens=5)
+    assert got == want
+    assert cached.last_prefix_hit == len(p) - 1  # one token must prefill
+
+
+def test_mismatched_prompt_is_safe(pair):
+    """A completely different prompt: no reuse, stream still exact (the old
+    buffer is recycled at offset 0, stale rows never attended)."""
+    cached, plain = pair
+    run(cached, [5, 9, 2, 44, 17], max_tokens=6)
+    p = [200, 201, 202, 203]
+    want = run(plain, p, max_tokens=6)
+    got = run(cached, p, max_tokens=6)
+    assert got == want
+    assert cached.last_prefix_hit == 0
+
+
+def test_partial_prefix(pair):
+    """Divergence mid-prompt: reuse exactly the common part."""
+    cached, plain = pair
+    p1 = [5, 9, 2, 44, 17, 80, 3, 14]
+    run(cached, p1, max_tokens=4)
+    p2 = p1[:5] + [150, 151, 152]
+    want = run(plain, p2, max_tokens=6)
+    got = run(cached, p2, max_tokens=6)
+    assert got == want
+    assert cached.last_prefix_hit == 5
+
+
+def test_sampled_with_cache(pair):
+    """Seeded sampling over a reused prefix: the PRNG chain starts fresh per
+    request, so streams match the uncached generator token-for-token."""
+    cached, plain = pair
+    p1 = [5, 9, 2, 44, 17, 80]
+    run(cached, p1, max_tokens=5)
+    p2 = p1 + [60, 61]
+    kw = dict(max_tokens=7, temperature=0.8, top_p=0.9, seed=3,
+              repetition_penalty=1.2)
+    want = run(plain, p2, **kw)
+    got = run(cached, p2, **kw)
+    assert got == want
+    assert cached.last_prefix_hit >= len(p1) - 1
+
+
+def test_early_close_then_reuse(pair):
+    """Abandoning a stream mid-generation (stop sequence / disconnect) must
+    leave a usable, correctly-accounted cache."""
+    cached, plain = pair
+    p1 = [5, 9, 2, 44, 17, 80, 3]
+    g = cached.generate_step(p1, max_tokens=12)
+    first = [next(g) for _ in range(3)]
+    g.close()  # consumer walks away after 3 tokens
+    taken = [t for t, _ in first]
+
+    p2 = p1 + taken + [90]
+    want = run(plain, p2, max_tokens=6)
+    got = run(cached, p2, max_tokens=6)
+    assert got == want
+    assert cached.last_prefix_hit >= len(p1)
+
+
+def test_logprobs_with_cache(pair):
+    cached, plain = pair
+    p1 = [5, 9, 2, 44]
+    run(cached, p1, max_tokens=4)
+    p2 = p1 + [10, 11]
+    want = list(plain.generate_step(p2, max_tokens=5, want_logprobs=True))
+    got = list(cached.generate_step(p2, max_tokens=5, want_logprobs=True))
+    assert [t for t, _ in got] == [t for t, _ in want]
+    for (_, a), (_, b) in zip(got, want):
+        assert a.chosen == pytest.approx(b.chosen, abs=1e-5)
+        assert list(a.top_indices) == list(b.top_indices)
+
+
+def test_capacity_edge_unaligned_hit():
+    """A non-chunk-aligned prefix hit whose padded suffix would cross
+    max_seq must not clamp-overwrite valid rows (the hit aligns down to a
+    chunk boundary instead). prefill_chunk=8, max_seq=16: 5-token shared
+    prefix + 15-token prompt was the exact overflow shape."""
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    cached = Generator(
+        model, params, max_seq=16, cache_dtype=jnp.float32,
+        prefill_chunk=8, decode_block=4, prompt_cache=True,
+    )
+    plain = Generator(
+        model, params, max_seq=16, cache_dtype=jnp.float32,
+        prefill_chunk=8, decode_block=4,
+    )
+    p1 = [5, 9, 2, 44, 17]
+    run(cached, p1, max_tokens=2)
+    p2 = p1 + [30, 31, 32, 33, 34, 35, 36, 37, 38, 39]  # 15 tokens
+    want = run(plain, p2, max_tokens=1)
+    got = run(cached, p2, max_tokens=1)
+    assert got == want
+    # the 5-token hit would overflow (5 + 2*8 > 16); it must align to 0
+    assert cached.last_prefix_hit == 0

@@ -379,3 +379,21 @@ def test_speculative_rejects_mismatched_vocab():
     dparams = draft.init_params(jax.random.PRNGKey(1), jnp.float32)
     with pytest.raises(ValueError, match="vocab"):
         SpeculativeGenerator(model, params, draft, dparams, max_seq=64)
+
+
+def test_keep_quantized_dense_checkpoint_rejected(tmp_path):
+    """keep_quantized on a checkpoint with no quantization config must fail
+    loudly — a silent dense load would quietly cost 4x the expected HBM."""
+    import transformers
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    )
+    transformers.LlamaForCausalLM(cfg).save_pretrained(
+        tmp_path, safe_serialization=True
+    )
+    from mlx_sharding_tpu.loading import load_model
+
+    with pytest.raises(ValueError, match="quantized checkpoint"):
+        load_model(str(tmp_path), dtype=jnp.float32, keep_quantized=True)
