@@ -663,6 +663,10 @@ class PipelineEngine:
             m_write = jnp.where(active, jnp.arange(M), M)  # inactive → scratch
             offset_m = offsets_pad[m_write]
 
+            if tokens.ndim == 2:
+                # the continuous-batching step passes (M, B) single tokens
+                # (the tick body relied on where() broadcasting them up)
+                tokens = tokens[..., None]
             h_all = self._vs_embed(s, vparts, tokens).astype(k.dtype)  # (M, B, T, H)
 
             def read(mw):

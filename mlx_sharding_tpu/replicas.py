@@ -1,0 +1,98 @@
+"""Data-parallel serving: independent engine replicas behind one dispatcher.
+
+The mesh axis story (parallel/mesh.py) gives dp to the training step; this
+module gives it to SERVING — `--replicas R` builds R fully independent
+engines (each a PipelineEngine [+ ContinuousBatcher] on its own slice of
+``jax.devices()``) and routes each request to the least-loaded replica.
+Replication multiplies aggregate throughput by R at identical per-request
+latency, the standard inference-serving dp recipe; the reference's topology
+has no equivalent (one gRPC chain serves one request at a time,
+ref: shard/openai_api.py:543-563).
+
+Each replica holds its own copy of the weights (device_put onto its own
+mesh by PipelineEngine) and its own KV state; requests never migrate, so
+per-request streams are exactly what the replica alone would produce.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class ReplicaSet:
+    """``generate_step`` dispatcher over independent replica generators.
+
+    Routing: least in-flight requests, ties to the lowest index — a
+    deterministic, state-light policy (no cross-replica queues; a replica's
+    own ContinuousBatcher provides intra-replica queueing when built with
+    ``--concurrent``)."""
+
+    concurrent = True  # the server must not serialize requests around us
+
+    def __init__(self, replicas: list):
+        if not replicas:
+            raise ValueError("ReplicaSet needs at least one replica")
+        self.replicas = list(replicas)
+        self._inflight = [0] * len(self.replicas)
+        self.served = [0] * len(self.replicas)  # lifetime request counts
+        self._lock = threading.Lock()
+        # non-concurrent replicas (plain engines) serve one request at a
+        # time each; per-replica locks replace the server's global one
+        self._serial_locks: list[Optional[threading.Lock]] = [
+            None if getattr(r, "concurrent", False) else threading.Lock()
+            for r in self.replicas
+        ]
+
+    def _pick(self) -> int:
+        with self._lock:
+            i = min(range(len(self.replicas)), key=lambda j: self._inflight[j])
+            self._inflight[i] += 1
+            self.served[i] += 1
+            return i
+
+    def _done(self, i: int):
+        with self._lock:
+            self._inflight[i] -= 1
+
+    def generate_step(self, prompt_tokens, **kw):
+        i = self._pick()
+        try:
+            serial = self._serial_locks[i]
+            if serial is not None:
+                with serial:
+                    yield from self.replicas[i].generate_step(
+                        prompt_tokens, **kw
+                    )
+            else:
+                yield from self.replicas[i].generate_step(prompt_tokens, **kw)
+        finally:
+            self._done(i)
+
+    # ------------------------------------------------------- observability
+    def stats(self):
+        """Aggregate (slots, active, queued) across replicas for /metrics.
+        Non-batcher replicas count as one slot each, active while a request
+        is in flight."""
+        slots = active = queued = 0
+        for i, r in enumerate(self.replicas):
+            if hasattr(r, "stats"):
+                s, a, q = r.stats()
+                slots, active, queued = slots + s, active + a, queued + q
+            else:
+                slots += 1
+                active += min(self._inflight[i], 1)
+                queued += max(self._inflight[i] - 1, 0)
+        return slots, active, queued
+
+    def page_stats(self):
+        totals = [r.page_stats() for r in self.replicas if hasattr(r, "page_stats")]
+        totals = [t for t in totals if t is not None]
+        if not totals:
+            return None
+        return tuple(sum(col) for col in zip(*totals))
+
+    def close(self):
+        for r in self.replicas:
+            if hasattr(r, "close"):
+                r.close()

@@ -113,7 +113,11 @@ class ModelProvider:
         draft_model: Optional[str] = None,
         spec_k: int = 4,
         prompt_cache: bool = False,
+        replicas: int = 1,
     ):
+        # data-parallel serving: R independent engine replicas, each on its
+        # own slice of jax.devices(), least-loaded request routing
+        self.replicas = max(1, replicas)
         # speculative decoding (single-chip generator path only)
         self.draft_model = draft_model
         self.spec_k = spec_k
@@ -206,28 +210,67 @@ class ModelProvider:
                     len(self.stage_bounds) if self.stage_bounds
                     else (self.num_stages or 1)
                 )
-                if stages > 1 or self.concurrent > 1 or self.tp > 1 or self.ep > 1:
+                if (
+                    stages > 1 or self.concurrent > 1 or self.tp > 1
+                    or self.ep > 1 or self.replicas > 1
+                ):
+                    import jax as _jax
+
                     from mlx_sharding_tpu.parallel.mesh import make_mesh
                     from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
-                    generator = PipelineEngine(
-                        model, params, make_mesh(pp=stages, tp=self.tp, ep=self.ep),
-                        stage_bounds=self.stage_bounds,
-                        microbatches=self.concurrent,
-                        max_seq=self.max_seq, cache_dtype=cache_dtype,
-                        prefill_chunk=self.prefill_chunk,
-                        decode_block=self.decode_block,
-                        pool_pages=self.paged_pool if self.concurrent > 1 else None,
-                        page_size=self.page_size,
-                    )
-                    if self.concurrent > 1:
-                        import jax
+                    per = stages * self.tp * self.ep
+                    devices = _jax.devices()
+                    if self.replicas * per > len(devices):
+                        raise ValueError(
+                            f"{self.replicas} replicas x {per} devices each "
+                            f"needs {self.replicas * per} devices, have "
+                            f"{len(devices)}"
+                        )
 
-                        if self.multihost and jax.process_index() > 0:
-                            # raw engine: serve_worker_batched wraps it in
-                            # its own mirror batcher
+                    def build_engine(dev_slice):
+                        engine = PipelineEngine(
+                            model, params,
+                            make_mesh(pp=stages, tp=self.tp, ep=self.ep,
+                                      devices=dev_slice),
+                            stage_bounds=self.stage_bounds,
+                            microbatches=self.concurrent,
+                            max_seq=self.max_seq, cache_dtype=cache_dtype,
+                            prefill_chunk=self.prefill_chunk,
+                            decode_block=self.decode_block,
+                            pool_pages=self.paged_pool
+                            if self.concurrent > 1 else None,
+                            page_size=self.page_size,
+                        )
+                        if self.concurrent > 1 and not self.multihost:
+                            from mlx_sharding_tpu.scheduler import (
+                                ContinuousBatcher,
+                            )
+
+                            engine = ContinuousBatcher(
+                                engine,
+                                decode_block=min(8, self.decode_block),
+                                policy=self.admission_policy,
+                            )
+                        return engine
+
+                    if self.replicas > 1:
+                        from mlx_sharding_tpu.replicas import ReplicaSet
+
+                        generator = ReplicaSet([
+                            build_engine(devices[i * per : (i + 1) * per])
+                            for i in range(self.replicas)
+                        ])
+                    else:
+                        generator = build_engine(devices[:per])
+                    if self.multihost:
+                        # (--replicas is rejected with --coordinator, so
+                        # `generator` here is the raw single engine)
+                        if _jax.process_index() > 0:
+                            # raw engine: serve_worker / serve_worker_batched
+                            # wraps it in its own mirror state
                             pass
-                        elif self.multihost:
+                        elif self.concurrent > 1:
                             from mlx_sharding_tpu.parallel.multihost import (
                                 make_multihost_batcher,
                             )
@@ -238,25 +281,11 @@ class ModelProvider:
                                 policy=self.admission_policy,
                             )
                         else:
-                            from mlx_sharding_tpu.scheduler import (
-                                ContinuousBatcher,
-                            )
-
-                            generator = ContinuousBatcher(
-                                generator,
-                                decode_block=min(8, self.decode_block),
-                                policy=self.admission_policy,
-                            )
-                    elif self.multihost:
-                        import jax
-
-                        if jax.process_index() == 0:
                             from mlx_sharding_tpu.parallel.multihost import (
                                 MultiHostPipeline,
                             )
 
                             generator = MultiHostPipeline(generator)
-                        # ranks > 0 keep the raw engine: serve_worker drives it
                 elif self.draft_model:
                     from mlx_sharding_tpu.loading import load_config
                     from mlx_sharding_tpu.speculative import (
@@ -857,6 +886,11 @@ def main(argv=None):
                              "Single-chip generator path only.")
     parser.add_argument("--spec-k", type=int, default=4,
                         help="speculation window (with --draft-model)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="data-parallel serving: N independent engine "
+                             "replicas, each on its own devices (stages x tp "
+                             "x ep each), least-loaded request routing — "
+                             "aggregate throughput scales with N")
     parser.add_argument("--prompt-cache", action="store_true",
                         help="reuse the previous request's KV cache for the "
                              "longest common prompt prefix (chat turns "
@@ -932,6 +966,14 @@ def main(argv=None):
         parser.error("--prompt-cache applies to the single-chip full-model "
                      "generator path (no --concurrent/--coordinator/--tp/"
                      "--ep/stage, layer-range, or --draft-model flags)")
+    if args.replicas > 1 and (
+        args.coordinator or args.engine == "chained" or args.draft_model
+        or args.prompt_cache
+        or args.start_layer is not None or args.end_layer is not None
+    ):
+        parser.error("--replicas requires the fused full-model engine path "
+                     "(no --coordinator/--engine chained/--draft-model/"
+                     "--prompt-cache/layer-range flags)")
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
     if args.paged_pool and args.engine == "chained":
@@ -951,7 +993,7 @@ def main(argv=None):
         decode_block=args.decode_block, paged_pool=args.paged_pool,
         page_size=args.page_size, admission_policy=args.admission_policy,
         draft_model=args.draft_model, spec_k=args.spec_k,
-        prompt_cache=args.prompt_cache,
+        prompt_cache=args.prompt_cache, replicas=args.replicas,
     )
     if multihost:
         import jax

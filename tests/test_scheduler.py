@@ -271,3 +271,33 @@ def test_concurrent_logprobs_summaries(setup):
     # parity with the default path's tokens
     plain = [t for t, _ in batcher.generate_step([3, 1, 4], max_tokens=6)]
     assert [t for t, _ in out] == plain
+
+
+def test_single_stage_batched_step_parity():
+    """pp=1 continuous batching takes the VECTORIZED engine body (one
+    vmapped forward for all slots — the aggregate-throughput path on a
+    single chip) instead of the tick rotation; streams must still match the
+    serial generator exactly, greedy and seeded-sampled, interleaved."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(1), microbatches=3, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    batcher = ContinuousBatcher(eng, decode_block=4)
+    ref = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    try:
+        jobs = [
+            ([3, 17, 42], dict(max_tokens=9, seed=1)),
+            ([9, 9, 31, 5], dict(max_tokens=7, temperature=0.8, seed=2)),
+            ([1, 2], dict(max_tokens=11, temperature=0.5, top_p=0.9, seed=3,
+                          repetition_penalty=1.2)),
+        ]
+        got = _concurrent(batcher, jobs)[0]
+        for (prompt, kw), toks in zip(jobs, got):
+            assert toks == _run(ref, prompt, **kw), (prompt, kw)
+    finally:
+        batcher.close()
