@@ -1,0 +1,33 @@
+"""Shared test utilities."""
+
+from __future__ import annotations
+
+import threading
+
+
+def run_concurrent(gen_like, jobs, timeout: float = 600.0):
+    """Run ``generate_step`` for every (prompt, kwargs) job in parallel
+    threads and return the token lists in job order. Worker exceptions
+    re-raise in the caller; a hung worker fails loudly instead of leaving
+    a non-daemon thread blocking interpreter exit."""
+    outs: list = [None] * len(jobs)
+
+    def run(i, prompt, kw):
+        try:
+            outs[i] = [t for t, _ in gen_like.generate_step(prompt, **kw)]
+        except Exception as e:  # noqa: BLE001 — surface in the caller
+            outs[i] = e
+
+    threads = [
+        threading.Thread(target=run, args=(i, p, kw), daemon=True)
+        for i, (p, kw) in enumerate(jobs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in threads), "generation thread hung"
+    for o in outs:
+        if isinstance(o, Exception):
+            raise o
+    return outs

@@ -129,3 +129,41 @@ def test_deepseek_fused_engine_with_ep():
         # shared experts: stage-sharded (pp) but fully replicated across ep
         sg = eng.layer_params["moe"]["shared_gate"]
         assert sg.sharding.shard_shape(sg.shape) == (1, *sg.shape[1:])
+
+
+def test_pp1_ep2_continuous_batching():
+    """S=1 x ep: the vectorized batched step with the expert psum inside the
+    vmap — slot streams must match the serial generator exactly."""
+    import jax.numpy as jnp
+
+    from mlx_sharding_tpu.config import MixtralConfig
+    from mlx_sharding_tpu.generate import Generator
+    from mlx_sharding_tpu.models.mixtral import MixtralModel
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+    from tests.helpers import run_concurrent
+
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+    )
+    model = MixtralModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(1), jnp.float32)
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=1, ep=2), microbatches=2, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    batcher = ContinuousBatcher(eng, decode_block=4)
+    try:
+        jobs = [
+            ([3, 17], dict(max_tokens=6, seed=4)),
+            ([9, 2, 7], dict(max_tokens=6, temperature=0.7, seed=5)),
+        ]
+        ref = Generator(model, params, max_seq=64, cache_dtype=jnp.float32,
+                        prefill_chunk=8)
+        for (p, kw), got in zip(jobs, run_concurrent(batcher, jobs)):
+            assert got == [t for t, _ in ref.generate_step(p, **kw)]
+    finally:
+        batcher.close()

@@ -2,8 +2,6 @@
 a least-loaded dispatcher. Streams must match what each replica would
 produce solo; concurrent requests land on different replicas."""
 
-import threading
-
 import jax
 import jax.numpy as jnp
 import pytest
@@ -50,21 +48,7 @@ def _build(pp, n_replicas, concurrent=1):
     return ReplicaSet(engines), ref
 
 
-def _concurrent_runs(rs, jobs):
-    results = [None] * len(jobs)
-    threads = [
-        threading.Thread(
-            target=lambda i=i, p=p, kw=kw: results.__setitem__(
-                i, [t for t, _ in rs.generate_step(p, **kw)]
-            )
-        )
-        for i, (p, kw) in enumerate(jobs)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=600)
-    return results
+from tests.helpers import run_concurrent as _concurrent_runs  # noqa: E402
 
 
 def test_two_replicas_parity_and_balance():
