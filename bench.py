@@ -64,7 +64,7 @@ def log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
-def _probe_backend(timeout: int = 300) -> bool:
+def _probe_backend(timeout: int = 60) -> bool:
     """The axon tunnel can wedge; probe it in a subprocess so a hang can't
     take the bench (and the driver) down with it."""
     try:
@@ -79,6 +79,74 @@ def _probe_backend(timeout: int = 300) -> bool:
         return proc.returncode == 0 and "TPU" in proc.stdout.upper()
     except subprocess.TimeoutExpired:
         return False
+
+
+def _probe_backend_with_retries() -> bool:
+    """Probe the tunnel in a retry loop instead of one shot: the wedge is
+    intermittent (BASELINE.md round-1/2/3 notes) and a single failed probe
+    has twice cost a round its real-chip record. Budget defaults to 15 min
+    of once-a-minute probes; override with MST_BENCH_PROBE_BUDGET_S (0 =
+    single probe, for tests/CI)."""
+    budget = float(os.environ.get("MST_BENCH_PROBE_BUDGET_S", "900"))
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while True:
+        attempt += 1
+        # generous per-attempt timeout: a legitimately cold tunnel can take
+        # minutes to enumerate devices, and a wedged one burns its timeout
+        # either way — the overall budget, not the per-attempt cap, bounds
+        # total wait
+        if _probe_backend(timeout=300):
+            log(f"tunnel probe ok (attempt {attempt})")
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            log(f"tunnel probe failed after {attempt} attempts; giving up")
+            return False
+        log(f"tunnel probe failed (attempt {attempt}); retrying "
+            f"({remaining:.0f}s of budget left)")
+        time.sleep(min(60.0, max(0.0, remaining)))
+
+
+def _git_commit() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        return out.stdout.strip() if out.returncode == 0 else "unknown"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def _last_good_real_chip() -> dict | None:
+    """The last committed real-chip BENCH_DETAIL.json, if any — the
+    provenance block the fallback path attaches so a wedged tunnel at
+    snapshot time can no longer erase the round's real-chip evidence."""
+    try:
+        with open(DETAIL_PATH) as f:
+            detail = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if "TPU" not in str(detail.get("device", "")).upper():
+        return None
+    primary = detail.get("decode_bf16") or {}
+    if not primary.get("decode_tps"):
+        return None
+    return {
+        "decode_tps": primary["decode_tps"],
+        "ttft_ms": primary.get("ttft_ms"),
+        "measured_at": detail.get("measured_at", "unknown"),
+        "git_commit": detail.get("git_commit", "unknown"),
+        "device": detail.get("device"),
+        "best_config_tps": max(
+            (v.get("decode_tps", 0.0) for v in detail.values()
+             if isinstance(v, dict) and v.get("decode_tps")),
+            default=primary["decode_tps"],
+        ),
+        "source": "BENCH_DETAIL.json (committed last-good real-chip run)",
+    }
 
 
 CPU_FALLBACK_MODEL = dict(
@@ -288,7 +356,7 @@ def kernel_smoke(detail: dict) -> None:
 
 
 def main() -> int:
-    cpu_fallback = not _probe_backend()
+    cpu_fallback = not _probe_backend_with_retries()
     if cpu_fallback:
         # The axon tunnel can be down for reasons outside this repo; a
         # clearly-labeled CPU number beats a hung or absent benchmark.
@@ -313,7 +381,11 @@ def main() -> int:
     from mlx_sharding_tpu.generate import Generator
     from mlx_sharding_tpu.models import build_model
 
-    detail: dict = {"device": str(jax.devices())}
+    detail: dict = {
+        "device": str(jax.devices()),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_commit(),
+    }
     log(f"devices={jax.devices()}")
     cfg_dict = dict(CPU_FALLBACK_MODEL if cpu_fallback else BENCH_MODEL)
     model, cfg = build_model(cfg_dict)
@@ -488,21 +560,57 @@ def main() -> int:
         json.dump(detail, f, indent=1)
     log(f"detail written to {detail_path}")
 
-    metric = (
-        "decode_tokens_per_sec_tiny_cpu_fallback"
-        if cpu_fallback
-        else "decode_tokens_per_sec_3b_bf16_1chip"
-    )
-    # vs_baseline is only meaningful against the documented nominal on the
-    # real chip; the CPU fallback reports 0 there.
-    vs = 0.0 if cpu_fallback else round(primary["decode_tps"] / NOMINAL_SINGLE_HOST_MLX_TOKS, 3)
+    if not cpu_fallback:
+        print(
+            json.dumps(
+                {
+                    "metric": "decode_tokens_per_sec_3b_bf16_1chip",
+                    "value": primary["decode_tps"],
+                    "unit": "tokens/sec",
+                    "vs_baseline": round(
+                        primary["decode_tps"] / NOMINAL_SINGLE_HOST_MLX_TOKS, 3
+                    ),
+                }
+            )
+        )
+        return 0
+
+    # Tunnel down for the whole probe budget. If a committed real-chip
+    # detail file exists, the headline metric carries it forward with full
+    # provenance — a wedge at snapshot time must not erase real evidence
+    # (round 3 lost a 102-tok/s record to exactly that). The fresh CPU run
+    # above is attached so the artifact also proves the code still works.
+    last_good = _last_good_real_chip()
+    if last_good is not None:
+        print(
+            json.dumps(
+                {
+                    "metric": "decode_tokens_per_sec_3b_bf16_1chip_last_good",
+                    "value": last_good["decode_tps"],
+                    "unit": "tokens/sec",
+                    "vs_baseline": round(
+                        last_good["decode_tps"] / NOMINAL_SINGLE_HOST_MLX_TOKS, 3
+                    ),
+                    "provenance": "last_good_real_chip",
+                    "last_good_real_chip": last_good,
+                    "fresh_cpu_fallback": {
+                        "decode_tps": primary["decode_tps"],
+                        "note": "tunnel unreachable this run; tiny-model CPU "
+                                "sanity measurement, not comparable to the "
+                                "headline value",
+                    },
+                }
+            )
+        )
+        return 0
+
     print(
         json.dumps(
             {
-                "metric": metric,
+                "metric": "decode_tokens_per_sec_tiny_cpu_fallback",
                 "value": primary["decode_tps"],
                 "unit": "tokens/sec",
-                "vs_baseline": vs,
+                "vs_baseline": 0.0,
             }
         )
     )
