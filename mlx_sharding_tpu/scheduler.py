@@ -23,8 +23,10 @@ alone or interleaved with others (tested in tests/test_scheduler.py).
 
 from __future__ import annotations
 
+import hashlib
 import queue
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -61,6 +63,11 @@ class _Request:
     top_p: float = 1.0
     repetition_penalty: Optional[float] = None
     logit_bias: Optional[dict] = None
+    # prefix-cache scratch: rolling page keys (memoized for the request's
+    # lifetime) and the chain _fits matched, consumed by _assign_slot in the
+    # same admission pass
+    _pkeys: Optional[list] = None
+    _chain: Optional[list] = None
 
 
 class ContinuousBatcher:
@@ -75,11 +82,16 @@ class ContinuousBatcher:
     concurrent = True
 
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
-                 policy: str = "fifo"):
+                 policy: str = "fifo", prefix_cache: bool = False):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
         if policy not in ("fifo", "first_fit"):
             raise ValueError(f"unknown admission policy {policy!r}")
+        if prefix_cache and not getattr(engine, "paged", False):
+            raise ValueError(
+                "prefix_cache requires a paged engine (pool_pages): sharing "
+                "is page-granular"
+            )
         self.engine = engine
         self.M = engine.microbatches
         self.W = repetition_window
@@ -132,11 +144,28 @@ class ContinuousBatcher:
         # paging buys is packing mixed-length requests into far less HBM
         # than M dense max_seq allocations.
         self.paged = getattr(engine, "paged", False)
+        self.prefix_cache = bool(prefix_cache)
         if self.paged:
             self.cache, self.table = engine.init_cache_paged()
             self._free_pages = list(range(engine.pool_pages - 1, -1, -1))
-            self._pages_of: dict[int, list[int]] = {}  # slot → reserved pages
+            self._pages_of: dict[int, list[int]] = {}  # slot → mapped pages
             self.pages_high_water = 0
+            # Prompt-prefix sharing (vLLM-style content-addressed pages):
+            # a FULL page of prompt KV is registered under the hash of the
+            # whole token prefix it closes; a later request whose prompt
+            # matches a chain of registered pages maps them read-only and
+            # prefills only the suffix (its slot offset starts past them).
+            # Refcount = #slots mapping the page + 1 if the index holds it;
+            # index-only pages are "cached": not free, evictable LRU when
+            # admission runs short. The reference resets remote caches per
+            # request (ref: shard/utils.py:122-124) — this is the beaten
+            # semantics; Generator._pc is the single-stream analogue.
+            self._page_ref: dict[int, int] = {}
+            self._prefix_index: "OrderedDict[bytes, int]" = OrderedDict()
+            self.prefix_queries = 0
+            self.prefix_hits = 0
+            self.prefix_tokens_reused = 0
+            self.prefix_evictions = 0
         else:
             self.cache = engine.init_cache()
             # dummy for the step arg
@@ -249,6 +278,81 @@ class ContinuousBatcher:
         page = self.engine.page_size
         return -(-(n_prompt + max_tokens) // page)
 
+    def prefix_stats(self) -> Optional[tuple[int, int, int, int, int]]:
+        """(queries, hits, tokens reused, evictions, cached pages) for
+        /metrics; None unless the prefix cache is on."""
+        if not (self.paged and self.prefix_cache):
+            return None
+        return (
+            self.prefix_queries, self.prefix_hits, self.prefix_tokens_reused,
+            self.prefix_evictions, len(self._prefix_index),
+        )
+
+    def _prefix_keys(self, req: _Request) -> list[bytes]:
+        """Rolling content-addressed key per FULL prompt page (the vLLM
+        block-hash scheme): key_i = blake2b over pages 0..i, chained so the
+        whole prompt is hashed once — O(prompt) total, 16 bytes retained per
+        page. Memoized on the request (recomputing per _fits poll would make
+        a blocked fifo head quadratic)."""
+        if req._pkeys is None:
+            page = self.engine.page_size
+            h = hashlib.blake2b(digest_size=16)
+            keys = []
+            for i in range(int(req.prompt.size) // page):
+                h.update(req.prompt[i * page : (i + 1) * page].tobytes())
+                keys.append(h.digest())
+            req._pkeys = keys
+        return req._pkeys
+
+    def _prefix_lookup(self, req: _Request) -> list[tuple[bytes, int]]:
+        """Longest chain of registered pages covering a page-aligned prefix
+        of the request's prompt. Capped one token short of the full prompt:
+        the last prompt token must go through prefill to produce the logits
+        the first sample needs."""
+        if not self.prefix_cache:
+            return []
+        page = self.engine.page_size
+        keys = self._prefix_keys(req)
+        chain: list[tuple[bytes, int]] = []
+        for i in range((int(req.prompt.size) - 1) // page):
+            p = self._prefix_index.get(keys[i])
+            if p is None:
+                break
+            chain.append((keys[i], p))
+        return chain
+
+    def _evictable_pages(self, exclude: tuple = ()) -> int:
+        ex = set(exclude)
+        return sum(
+            1 for p in self._prefix_index.values()
+            if self._page_ref.get(p) == 1 and p not in ex
+        )
+
+    def _evict_for(self, n_needed: int):
+        """Drop LRU index entries whose page no live slot maps until the
+        free list can cover ``n_needed`` pages."""
+        while len(self._free_pages) < n_needed:
+            victim = next(
+                (k for k, p in self._prefix_index.items()
+                 if self._page_ref.get(p) == 1),
+                None,
+            )
+            if victim is None:
+                return
+            p = self._prefix_index.pop(victim)
+            self._page_ref.pop(p, None)
+            self._free_pages.append(p)
+            self.prefix_evictions += 1
+
+    def _release_pages(self, slot: int):
+        for p in self._pages_of.pop(slot, []):
+            r = self._page_ref.get(p, 1) - 1
+            if r <= 0:
+                self._page_ref.pop(p, None)
+                self._free_pages.append(p)
+            else:
+                self._page_ref[p] = r
+
     def close(self):
         self._stop = True
         if self._thread is not None:
@@ -295,9 +399,31 @@ class ContinuousBatcher:
         per scheduler tick — so active slots keep decoding during admission."""
         prompt = req.prompt
         slot_arr = self._put(jnp.asarray(slot, jnp.int32))
+        reused_tokens = 0
         if self.paged:
             n = self._pages_needed(prompt.size, req.max_tokens)
-            pages = [self._free_pages.pop() for _ in range(n)]
+            chain = req._chain if req._chain is not None else self._prefix_lookup(req)
+            req._chain = None
+            if self.prefix_cache:
+                self.prefix_queries += 1
+                if chain:
+                    self.prefix_hits += 1
+                    reused_tokens = len(chain) * self.engine.page_size
+                    self.prefix_tokens_reused += reused_tokens
+                for key, _ in chain:
+                    self._prefix_index.move_to_end(key)
+            shared = [p for _, p in chain]
+            # claim the chain BEFORE evicting: at ref 2 its pages are
+            # invisible to _evict_for, which must only reclaim OTHER
+            # index-only pages (matching the _fits exclude accounting)
+            for p in shared:
+                self._page_ref[p] += 1
+            self._evict_for(n - len(shared))
+            pages = shared + [
+                self._free_pages.pop() for _ in range(n - len(shared))
+            ]
+            for p in pages[len(shared):]:
+                self._page_ref[p] = 1
             self._pages_of[slot] = pages
             in_use = self.engine.pool_pages - len(self._free_pages)
             self.pages_high_water = max(self.pages_high_water, in_use)
@@ -312,7 +438,7 @@ class ContinuousBatcher:
         self.cache = self.cache._replace(
             offset=self._row_set(
                 self.cache.offset, slot_arr,
-                self._put(jnp.asarray(0, jnp.int32)),
+                self._put(jnp.asarray(reused_tokens, jnp.int32)),
             )
         )
         # pad the request's sampler params to the batched width host-side,
@@ -332,7 +458,8 @@ class ContinuousBatcher:
         )
         self._slots[slot] = req
         req.slot = slot
-        req.prefill_pos = 0
+        # prefill starts past the reused prefix — its KV is already mapped
+        req.prefill_pos = reused_tokens
 
     def _prefill_one_chunk(self, req: _Request):
         """Run ONE prefill chunk for a mid-admission request; on the last
@@ -353,6 +480,19 @@ class ContinuousBatcher:
         req.prefill_pos += n_valid
         if req.prefill_pos < req.prompt.size:
             return
+
+        if self.prefix_cache:
+            # Register every FULL prompt page under its whole-prefix content
+            # key. Decode writes start at prompt.size, past all of them, so a
+            # registered page is immutable for its pool lifetime. Pages a
+            # concurrent identical prompt registered first just get touched.
+            pages = self._pages_of.get(req.slot, [])
+            for i, key in enumerate(self._prefix_keys(req)):
+                if key in self._prefix_index:
+                    self._prefix_index.move_to_end(key)
+                    continue
+                self._prefix_index[key] = pages[i]
+                self._page_ref[pages[i]] = self._page_ref.get(pages[i], 0) + 1
 
         # Seed the PRNG key and repetition window only NOW: decode ticks for
         # other slots ran between this request's chunks and they split/shift
@@ -400,8 +540,10 @@ class ContinuousBatcher:
             if self.paged:
                 # the slot is inactive from the next block on (garbage ticks
                 # route to the scratch table row), so its pages can be
-                # reused immediately
-                self._free_pages.extend(self._pages_of.pop(req.slot, []))
+                # reused immediately; index-registered prompt pages survive
+                # as cache entries (their index ref keeps them off the free
+                # list) until LRU eviction needs them back
+                self._release_pages(req.slot)
             self._slots[req.slot] = None
             req.slot = -1
         req.out.put(None)
@@ -474,9 +616,13 @@ class ContinuousBatcher:
     def _fits(self, req: _Request) -> bool:
         if not self.paged:
             return True
-        return (
-            self._pages_needed(req.prompt.size, req.max_tokens)
-            <= len(self._free_pages)
+        need = self._pages_needed(req.prompt.size, req.max_tokens)
+        chain = self._prefix_lookup(req)
+        req._chain = chain  # consumed by _assign_slot this admission pass
+        # the chain's own pages must not double as eviction fodder: they're
+        # about to be mapped, so only OTHER cached pages can be reclaimed
+        return need - len(chain) <= len(self._free_pages) + self._evictable_pages(
+            exclude=[p for _, p in chain]
         )
 
     def _admit_waiting(self):
@@ -553,9 +699,12 @@ class ContinuousBatcher:
                 req.out.put(exc)
         self.active = self._zeros_like(self.active)
         if self.paged:
-            for pages in self._pages_of.values():
-                self._free_pages.extend(pages)
+            # cache contents are unreliable after a failure: reset the pool
+            # wholesale (all pages free, index dropped)
             self._pages_of.clear()
+            self._page_ref.clear()
+            self._prefix_index.clear()
+            self._free_pages = list(range(self.engine.pool_pages - 1, -1, -1))
         for req in self._waiting:
             req.out.put(exc)
         self._waiting.clear()

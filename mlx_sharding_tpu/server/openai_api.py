@@ -251,6 +251,8 @@ class ModelProvider:
                                 engine,
                                 decode_block=min(8, self.decode_block),
                                 policy=self.admission_policy,
+                                prefix_cache=self.prompt_cache
+                                and self.paged_pool is not None,
                             )
                         return engine
 
@@ -892,10 +894,12 @@ def main(argv=None):
                              "x ep each), least-loaded request routing — "
                              "aggregate throughput scales with N")
     parser.add_argument("--prompt-cache", action="store_true",
-                        help="reuse the previous request's KV cache for the "
-                             "longest common prompt prefix (chat turns "
+                        help="reuse KV for shared prompt prefixes (chat turns "
                              "re-send their whole history: TTFT becomes "
-                             "O(new tokens)). Single-chip generator path.")
+                             "O(new tokens)). Single-chip generator path, or "
+                             "with --concurrent --paged-pool: content-"
+                             "addressed page sharing across interleaved "
+                             "requests")
     parser.add_argument("--decode-block", type=int, default=16,
                         help="decode steps fused per program launch (token "
                              "pulls amortize over this many tokens; set 1 "
@@ -957,15 +961,21 @@ def main(argv=None):
         parser.error("--draft-model applies to the single-chip full-model "
                      "generator (no --concurrent/--coordinator/--tp/--ep/"
                      "stage or layer-range flags)")
-    if args.prompt_cache and (
-        args.concurrent > 1 or args.coordinator or args.tp > 1
+    if args.prompt_cache and args.concurrent > 1 and not args.paged_pool:
+        parser.error("--prompt-cache with --concurrent requires --paged-pool "
+                     "(prefix sharing is page-granular)")
+    if args.prompt_cache and args.concurrent <= 1 and (
+        args.coordinator or args.tp > 1
         or args.ep > 1 or args.stage_bounds or (args.num_stages or 1) > 1
         or args.engine == "chained" or args.draft_model
         or args.start_layer is not None or args.end_layer is not None
     ):
         parser.error("--prompt-cache applies to the single-chip full-model "
-                     "generator path (no --concurrent/--coordinator/--tp/"
-                     "--ep/stage, layer-range, or --draft-model flags)")
+                     "generator path or to --concurrent --paged-pool serving "
+                     "(no --coordinator/--tp/--ep/stage, layer-range, or "
+                     "--draft-model flags)")
+    if args.prompt_cache and args.concurrent > 1 and args.coordinator:
+        parser.error("--prompt-cache is not supported in multi-host serving")
     if args.replicas > 1 and (
         args.coordinator or args.engine == "chained" or args.draft_model
         or args.prompt_cache

@@ -138,6 +138,21 @@ class ServingMetrics:
                         "# TYPE mst_kv_pool_pages_high_water gauge",
                         f"mst_kv_pool_pages_high_water {high}",
                     ]
+                prefix = getattr(b, "prefix_stats", lambda: None)()
+                if prefix is not None:
+                    queries, hits, reused, evictions, cached = prefix
+                    lines += [
+                        "# TYPE mst_prefix_cache_queries_total counter",
+                        f"mst_prefix_cache_queries_total {queries}",
+                        "# TYPE mst_prefix_cache_hits_total counter",
+                        f"mst_prefix_cache_hits_total {hits}",
+                        "# TYPE mst_prefix_cache_tokens_reused_total counter",
+                        f"mst_prefix_cache_tokens_reused_total {reused}",
+                        "# TYPE mst_prefix_cache_evictions_total counter",
+                        f"mst_prefix_cache_evictions_total {evictions}",
+                        "# TYPE mst_prefix_cache_pages gauge",
+                        f"mst_prefix_cache_pages {cached}",
+                    ]
             spec = self.spec_fn() if self.spec_fn is not None else None
             if spec is not None:
                 # accepted/round ∈ [1, spec_k]: the draft-quality dial the

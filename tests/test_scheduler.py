@@ -301,3 +301,135 @@ def test_single_stage_batched_step_parity():
             assert toks == _run(ref, prompt, **kw), (prompt, kw)
     finally:
         batcher.close()
+
+
+# ---------------------------------------------------------------- prefix cache
+def _paged_cached_batcher(pool_pages=24, microbatches=2, **kw):
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(2), microbatches=microbatches,
+        max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8,
+        pool_pages=pool_pages, page_size=8,
+    )
+    ref = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    return ContinuousBatcher(eng, decode_block=3, prefix_cache=True, **kw), ref
+
+
+def test_prefix_cache_requires_paged(setup):
+    batcher, _ = setup  # dense engine from the module fixture
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(batcher.engine, prefix_cache=True)
+
+
+def test_prefix_cache_hit_token_exact():
+    """A repeated prompt reuses its full prompt pages (minus the final
+    token's page) and still matches the serial generator token-for-token."""
+    batcher, ref = _paged_cached_batcher()
+    try:
+        prompt = [((7 * i) % 251) + 1 for i in range(20)]  # 2 full pages + 4
+        want = _run(ref, prompt, max_tokens=8)
+        first = _run(batcher, prompt, max_tokens=8)
+        assert first == want
+        q0, h0, reused0, _, cached0 = batcher.prefix_stats()
+        assert h0 == 0 and cached0 >= 2  # cold query registered its pages
+        second = _run(batcher, prompt, max_tokens=8)
+        assert second == want
+        q1, h1, reused1, _, _ = batcher.prefix_stats()
+        assert (q1, h1) == (q0 + 1, 1)
+        assert reused1 == 16  # two 8-token pages; the tail re-prefills
+    finally:
+        batcher.close()
+
+
+def test_prefix_cache_interleaved_token_exact():
+    """Two concurrent requests sharing a 16-token system prefix with
+    different suffixes: token-exact vs the serial path, with a prefix hit
+    recorded for whichever admits second."""
+    batcher, ref = _paged_cached_batcher()
+    try:
+        system = [((11 * i) % 250) + 1 for i in range(16)]
+        jobs = [
+            (system + [61, 62, 63], dict(max_tokens=8, seed=5,
+                                         temperature=0.7)),
+            (system + [71, 72], dict(max_tokens=10)),
+        ]
+        # warm the cache with a third request sharing the prefix, so BOTH
+        # concurrent requests hit regardless of admission order
+        warm = _run(batcher, system + [99], max_tokens=2)
+        assert len(warm) == 2
+        got, _ = _concurrent(batcher, jobs)
+        for (prompt, kw), toks in zip(jobs, got):
+            assert toks == _run(ref, prompt, **kw), (prompt, kw)
+        _, hits, reused, _, _ = batcher.prefix_stats()
+        assert hits >= 2
+        assert reused >= 2 * 16
+    finally:
+        batcher.close()
+
+
+def test_prefix_cache_eviction_and_no_leaks():
+    """Distinct prompts big enough to overflow the pool force LRU eviction
+    of cached pages; accounting stays exact: after everything finishes,
+    free + cached == pool."""
+    batcher, ref = _paged_cached_batcher(pool_pages=8)
+    try:
+        prompts = [
+            [((13 * i + s) % 250) + 1 for i in range(17)] for s in range(4)
+        ]
+        for p in prompts:
+            assert _run(batcher, p, max_tokens=4) == _run(ref, p, max_tokens=4)
+        _, _, _, evictions, cached = batcher.prefix_stats()
+        assert evictions > 0
+        total, in_use, _ = batcher.page_stats()
+        assert in_use == cached  # only cache entries hold pages now
+        assert len(batcher._free_pages) + cached == total
+        # and a cached prompt still hits after the shuffle
+        hits_before = batcher.prefix_stats()[1]
+        assert _run(batcher, prompts[-1], max_tokens=4) == _run(
+            ref, prompts[-1], max_tokens=4
+        )
+        assert batcher.prefix_stats()[1] == hits_before + 1
+    finally:
+        batcher.close()
+
+
+def test_prefix_cache_own_chain_not_evicted_under_pressure():
+    """Regression: when the only evictable cached pages ARE the incoming
+    request's prefix chain, the request must wait for capacity, not evict
+    its own chain out from under itself (which popped the page's refcount
+    entry and KeyError'd the scheduler thread, failing every request)."""
+    batcher, ref = _paged_cached_batcher(pool_pages=6)
+    try:
+        shared_prompt = [((7 * i) % 251) + 1 for i in range(17)]  # 2 cached pages
+        assert _run(batcher, shared_prompt, max_tokens=4) == _run(
+            ref, shared_prompt, max_tokens=4
+        )
+        assert batcher.prefix_stats()[4] == 2  # two pages cached
+
+        # occupy 3 of the remaining pages with a long-running request, so
+        # free=1 and the only other pages are the cached chain itself
+        hog_prompt = [((5 * i) % 250) + 2 for i in range(9)]
+        hog_done = threading.Event()
+        hog_out = []
+
+        def hog():
+            hog_out.extend(_run(batcher, hog_prompt, max_tokens=20))
+            hog_done.set()
+
+        th = threading.Thread(target=hog)
+        th.start()
+        time.sleep(0.5)  # let the hog admit
+        # chain=2 shared, needs 2 fresh pages, free=1, nothing else
+        # evictable -> must WAIT (crash = _fail_all = exception here)
+        toks = _run(batcher, shared_prompt, max_tokens=15)
+        th.join(timeout=120)
+        assert hog_done.is_set()
+        assert hog_out == _run(ref, hog_prompt, max_tokens=20)
+        assert toks == _run(ref, shared_prompt, max_tokens=15)
+        assert batcher.prefix_stats()[1] >= 1  # the chain WAS reused
+    finally:
+        batcher.close()
