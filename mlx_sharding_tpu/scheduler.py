@@ -68,6 +68,14 @@ class _Request:
     # same admission pass
     _pkeys: Optional[list] = None
     _chain: Optional[list] = None
+    # over-commit admission state: order ticket (oldest admitted request is
+    # never preempted), tokens emitted since the last (re)admission (folded
+    # into the prompt on preemption so resume re-prefills them), and the
+    # stashed device-side sampler state for token-exact resume
+    admit_seq: Optional[int] = None
+    history: list = field(default_factory=list)
+    resume_keys: Optional[np.ndarray] = None
+    resume_recent: Optional[np.ndarray] = None
 
 
 class ContinuousBatcher:
@@ -82,7 +90,8 @@ class ContinuousBatcher:
     concurrent = True
 
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
-                 policy: str = "fifo", prefix_cache: bool = False):
+                 policy: str = "fifo", prefix_cache: bool = False,
+                 overcommit: bool = False):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
         if policy not in ("fifo", "first_fit"):
@@ -91,6 +100,10 @@ class ContinuousBatcher:
             raise ValueError(
                 "prefix_cache requires a paged engine (pool_pages): sharing "
                 "is page-granular"
+            )
+        if overcommit and not getattr(engine, "paged", False):
+            raise ValueError(
+                "overcommit admission requires a paged engine (pool_pages)"
             )
         self.engine = engine
         self.M = engine.microbatches
@@ -145,6 +158,20 @@ class ContinuousBatcher:
         # than M dense max_seq allocations.
         self.paged = getattr(engine, "paged", False)
         self.prefix_cache = bool(prefix_cache)
+        # Admission accounting mode. "reserve" (default) claims a request's
+        # whole page need (prompt + max_tokens) up front: deadlock-free by
+        # construction, but a request that asks for max_tokens=4096 and emits
+        # 20 holds ~64x its real need. Over-commit admits on CURRENT need
+        # (prompt + one decode block), grows per block, and on pool
+        # exhaustion preempts the newest-admitted slot back to the waiting
+        # line (its emitted tokens fold into its prompt; device sampler
+        # state is stashed, so resume is token-exact). The oldest admitted
+        # request is never preempted, so progress is guaranteed: worst case
+        # the pool drains to one request, which the absolute capacity check
+        # in generate_step proves fits alone.
+        self.overcommit = bool(overcommit)
+        self.preemptions = 0
+        self._admit_counter = 0
         if self.paged:
             self.cache, self.table = engine.init_cache_paged()
             self._free_pages = list(range(engine.pool_pages - 1, -1, -1))
