@@ -105,6 +105,13 @@ class ContinuousBatcher:
             raise ValueError(
                 "overcommit admission requires a paged engine (pool_pages)"
             )
+        if overcommit and jax.process_count() > 1:
+            # preemption stashes device sampler rows host-side (device_get)
+            # and rewrites table/active rows outside the mirrored multihost
+            # op stream — worker ranks would desync into a collective hang
+            raise ValueError(
+                "overcommit admission is not supported in multi-host serving"
+            )
         self.engine = engine
         self.M = engine.microbatches
         self.W = repetition_window
@@ -151,11 +158,9 @@ class ContinuousBatcher:
         self._zeros_like = jax.jit(jnp.zeros_like)
 
         # device-side per-slot state. Paged engines share a page pool across
-        # slots: the scheduler RESERVES a request's full page need (prompt +
-        # max_tokens) at admission, so allocation can never fail mid-stream
-        # and oversubscription deadlock is impossible by construction; what
-        # paging buys is packing mixed-length requests into far less HBM
-        # than M dense max_seq allocations.
+        # slots — packing mixed-length requests into far less HBM than M
+        # dense max_seq allocations; the admission accounting mode below
+        # decides how much of a request's need is claimed up front.
         self.paged = getattr(engine, "paged", False)
         self.prefix_cache = bool(prefix_cache)
         # Admission accounting mode. "reserve" (default) claims a request's
@@ -371,6 +376,21 @@ class ContinuousBatcher:
             self._free_pages.append(p)
             self.prefix_evictions += 1
 
+    def _write_table_row(self, slot: int, pages: list):
+        """Publish a slot's page mapping to the device table and bump the
+        pool high-water mark. Unmapped tail entries stay at the scratch
+        page (index pool_pages): overshoot writes past the mapping land
+        there harmlessly."""
+        row = np.full((self.engine.slot_pages,), self.engine.pool_pages,
+                      np.int32)
+        row[: len(pages)] = pages
+        self.table = self._row_set(
+            self.table, self._put(jnp.asarray(slot, jnp.int32)),
+            self._put(jnp.asarray(row)),
+        )
+        in_use = self.engine.pool_pages - len(self._free_pages)
+        self.pages_high_water = max(self.pages_high_water, in_use)
+
     def _release_pages(self, slot: int):
         for p in self._pages_of.pop(slot, []):
             r = self._page_ref.get(p, 1) - 1
@@ -427,8 +447,10 @@ class ContinuousBatcher:
         prompt = req.prompt
         slot_arr = self._put(jnp.asarray(slot, jnp.int32))
         reused_tokens = 0
+        req.admit_seq = self._admit_counter
+        self._admit_counter += 1
         if self.paged:
-            n = self._pages_needed(prompt.size, req.max_tokens)
+            n = self._need_pages(req)
             chain = req._chain if req._chain is not None else self._prefix_lookup(req)
             req._chain = None
             if self.prefix_cache:
@@ -452,16 +474,7 @@ class ContinuousBatcher:
             for p in pages[len(shared):]:
                 self._page_ref[p] = 1
             self._pages_of[slot] = pages
-            in_use = self.engine.pool_pages - len(self._free_pages)
-            self.pages_high_water = max(self.pages_high_water, in_use)
-            # unreserved tail entries stay at the scratch page: overshoot
-            # writes past the reservation land there harmlessly
-            row = np.full((self.engine.slot_pages,), self.engine.pool_pages,
-                          np.int32)
-            row[:n] = pages
-            self.table = self._row_set(
-                self.table, slot_arr, self._put(jnp.asarray(row))
-            )
+            self._write_table_row(slot, pages)
         self.cache = self.cache._replace(
             offset=self._row_set(
                 self.cache.offset, slot_arr,
@@ -527,18 +540,33 @@ class ContinuousBatcher:
         # mangled state by prefill completion and break the deterministic
         # serial-parity guarantee for multi-chunk prompts.
         W = self.W
-        row = np.full((W,), -1, np.int32)
-        tail = (
-            req.prompt[-req.rep_context:] if req.rep_context else req.prompt[:0]
-        )
-        if tail.size:
-            row[W - tail.size:] = tail
-        self.recent = self._row_set(
-            self.recent, slot_arr, self._put(jnp.asarray(row))
-        )
-        self.keys = self._row_set(
-            self.keys, slot_arr, self._put(jax.random.PRNGKey(req.seed))
-        )
+        if req.resume_keys is not None:
+            # resuming a preempted request: restore the stashed sampler state
+            # so the sample below continues the request's exact PRNG chain
+            # and repetition window — the token it emits is the one the
+            # uninterrupted run would have produced next
+            self.recent = self._row_set(
+                self.recent, slot_arr, self._put(jnp.asarray(req.resume_recent))
+            )
+            self.keys = self._row_set(
+                self.keys, slot_arr, self._put(jnp.asarray(req.resume_keys))
+            )
+            req.resume_keys = None
+            req.resume_recent = None
+        else:
+            row = np.full((W,), -1, np.int32)
+            tail = (
+                req.prompt[-req.rep_context:] if req.rep_context
+                else req.prompt[:0]
+            )
+            if tail.size:
+                row[W - tail.size:] = tail
+            self.recent = self._row_set(
+                self.recent, slot_arr, self._put(jnp.asarray(row))
+            )
+            self.keys = self._row_set(
+                self.keys, slot_arr, self._put(jax.random.PRNGKey(req.seed))
+            )
 
         tok, logprobs, self.keys, self.recent = self._first_sample(
             logits, self.keys, self.sp, self.recent, self.rep_sizes, slot_arr
@@ -551,6 +579,8 @@ class ContinuousBatcher:
 
     def _emit(self, req: _Request, token: int, logprobs):
         req.produced += 1
+        if self.overcommit:
+            req.history.append(int(token))
         # decode blocks emit TokenLogprobs summaries (or None); the first
         # token of a request still carries a lazy (1, V) device row from its
         # prefill sample — the server handles both forms
@@ -615,8 +645,90 @@ class ContinuousBatcher:
             )
         return self._decode_block_progs[want_lp]
 
+    def _preempt(self, req: _Request):
+        """Evict an admitted request back to the head of the waiting line,
+        releasing its pages. Mid-decode, its emitted tokens fold into its
+        prompt (resume re-prefills them — the recompute strategy: the KV
+        pages are gone) and the device-side sampler state is stashed so the
+        next sampled token continues the exact PRNG/repetition chain.
+        Mid-prefill there is nothing to stash; the prefill restarts."""
+        slot = req.slot
+        self.preemptions += 1
+        if req.prefill_pos >= req.prompt.size:
+            req.resume_keys = np.asarray(jax.device_get(self.keys)[slot])
+            req.resume_recent = np.asarray(jax.device_get(self.recent)[slot])
+            if req.history:
+                req.prompt = np.concatenate(
+                    [req.prompt, np.asarray(req.history, np.int32)]
+                )
+                req.history = []
+                req._pkeys = None  # prompt changed: content keys are stale
+        req._chain = None
+        req.prefill_pos = 0
+        self.active = self._row_set(
+            self.active, self._put(jnp.asarray(slot, jnp.int32)),
+            self._put(jnp.asarray(False)),
+        )
+        self._release_pages(slot)
+        self._slots[slot] = None
+        req.slot = -1
+        # head of the waiting line: preemption goes newest-first, so
+        # repeated inserts at 0 restore admission order among the victims
+        self._waiting.insert(0, req)
+
+    def _grow_for_decode(self):
+        """Over-commit page growth: before a decode block runs, every
+        decoding slot must have pages covering the block's KV writes. Grow
+        oldest-first from the free list (evicting cached prefix pages as
+        needed); on pool exhaustion preempt the newest-admitted request.
+        The oldest admitted request is never preempted, and generate_step's
+        absolute capacity check proves a lone request's full need fits the
+        pool, so it can always grow to completion — progress is guaranteed."""
+        page = self.engine.page_size
+        K = self.decode_block
+        decoding = sorted(
+            (
+                (slot, req)
+                for slot, req in enumerate(self._slots)
+                if req is not None and req.prefill_pos >= req.prompt.size
+            ),
+            key=lambda t: t[1].admit_seq,
+        )
+        for slot, req in decoding:
+            while self._slots[slot] is req:  # a victim skips its own growth
+                have = len(self._pages_of.get(slot, ()))
+                emitted = len(req.history)
+                # next KV write lands at prompt + emitted - 1 (the first
+                # sampled token writes no KV; each block step writes one)
+                offset = req.prompt.size + max(0, emitted - 1)
+                # total pages this request can ever touch — same quantity
+                # generate_step bounded by the pool size at submission
+                cap = self._pages_needed(
+                    req.prompt.size, emitted + (req.max_tokens - req.produced)
+                )
+                want = min(-(-(offset + K) // page), cap)
+                n_more = want - have
+                if n_more <= 0:
+                    break
+                self._evict_for(n_more)
+                if len(self._free_pages) >= n_more:
+                    fresh = [self._free_pages.pop() for _ in range(n_more)]
+                    for p in fresh:
+                        self._page_ref[p] = 1
+                    pages = self._pages_of[slot]
+                    pages.extend(fresh)
+                    self._write_table_row(slot, pages)
+                    break
+                victims = [r for r in self._slots if r is not None]
+                if len(victims) <= 1:
+                    break  # only this request left; cap ≤ pool makes this
+                    # unreachable — defensive against accounting drift
+                self._preempt(max(victims, key=lambda r: r.admit_seq))
+
     def _decode_once(self):
         eng = self.engine
+        if self.paged and self.overcommit:
+            self._grow_for_decode()
         # snapshot of slots active for this block, in slot order
         live = [
             (slot, req) for slot, req in enumerate(self._slots)
@@ -640,17 +752,32 @@ class ContinuousBatcher:
                     lp = block_token_logprobs(outs, j, slot)
                 self._emit(req, int(toks[j, slot, 0]), lp)
 
+    def _need_pages(self, req: _Request) -> int:
+        """Pages to map at admission. Reserve mode (default) claims the whole
+        prompt+max_tokens need up front; over-commit claims only the CURRENT
+        need — prompt plus one decode block (capped by what's left to emit) —
+        and grows per block in _grow_for_decode."""
+        if self.overcommit:
+            remaining = max(1, req.max_tokens - req.produced)
+            return self._pages_needed(
+                req.prompt.size, min(self.decode_block, remaining)
+            )
+        return self._pages_needed(req.prompt.size, req.max_tokens)
+
     def _fits(self, req: _Request) -> bool:
         if not self.paged:
             return True
-        need = self._pages_needed(req.prompt.size, req.max_tokens)
+        need = self._need_pages(req)
         chain = self._prefix_lookup(req)
-        req._chain = chain  # consumed by _assign_slot this admission pass
         # the chain's own pages must not double as eviction fodder: they're
         # about to be mapped, so only OTHER cached pages can be reclaimed
-        return need - len(chain) <= len(self._free_pages) + self._evictable_pages(
+        ok = need - len(chain) <= len(self._free_pages) + self._evictable_pages(
             exclude=[p for _, p in chain]
         )
+        # only a fitting request hands its chain to _assign_slot (same
+        # admission pass); a stale chain could reference since-evicted pages
+        req._chain = chain if ok else None
+        return ok
 
     def _admit_waiting(self):
         """Admit from the waiting line into free slots under the admission

@@ -110,6 +110,7 @@ class ModelProvider:
         paged_pool: Optional[int] = None,
         page_size: Optional[int] = None,
         admission_policy: str = "fifo",
+        overcommit: bool = False,
         draft_model: Optional[str] = None,
         spec_k: int = 4,
         prompt_cache: bool = False,
@@ -134,6 +135,7 @@ class ModelProvider:
         self.paged_pool = paged_pool
         self.page_size = page_size
         self.admission_policy = admission_policy
+        self.overcommit = overcommit
         self.default_model = default_model
         self.start_layer = start_layer
         self.end_layer = end_layer
@@ -253,6 +255,7 @@ class ModelProvider:
                                 policy=self.admission_policy,
                                 prefix_cache=self.prompt_cache
                                 and self.paged_pool is not None,
+                                overcommit=self.overcommit,
                             )
                         return engine
 
@@ -881,6 +884,13 @@ def main(argv=None):
                         help="waiting-line policy when a request doesn't fit "
                              "the page pool: strict order vs let smaller "
                              "requests jump a blocked head")
+    parser.add_argument("--overcommit", action="store_true",
+                        help="with --paged-pool: admit on current page need "
+                             "(prompt + one decode block) and grow per "
+                             "block, preempting the newest-admitted request "
+                             "on pool exhaustion (token-exact resume) — "
+                             "higher slot occupancy than reserving every "
+                             "request's full prompt+max_tokens need")
     parser.add_argument("--draft-model", default=None,
                         help="speculative decoding: a small draft model "
                              "proposes --spec-k tokens per round (greedy "
@@ -992,6 +1002,13 @@ def main(argv=None):
         parser.error("--page-size requires --paged-pool")
     if args.admission_policy != "fifo" and not args.paged_pool:
         parser.error("--admission-policy requires --paged-pool")
+    if args.overcommit and not args.paged_pool:
+        parser.error("--overcommit requires --paged-pool")
+    if args.overcommit and args.coordinator and (args.num_processes or 1) > 1:
+        # preemption stashes device sampler rows host-side (device_get) and
+        # rewrites table rows outside the mirrored multihost op stream;
+        # workers would desync — reserve admission only across hosts
+        parser.error("--overcommit is not supported in multi-host serving")
     multihost = bool(args.coordinator) and (args.num_processes or 1) > 1
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
@@ -1002,6 +1019,7 @@ def main(argv=None):
         chat_template=chat_template, keep_quantized=args.keep_quantized,
         decode_block=args.decode_block, paged_pool=args.paged_pool,
         page_size=args.page_size, admission_policy=args.admission_policy,
+        overcommit=args.overcommit,
         draft_model=args.draft_model, spec_k=args.spec_k,
         prompt_cache=args.prompt_cache, replicas=args.replicas,
     )

@@ -138,6 +138,11 @@ class ServingMetrics:
                         "# TYPE mst_kv_pool_pages_high_water gauge",
                         f"mst_kv_pool_pages_high_water {high}",
                     ]
+                if pages is not None and getattr(b, "overcommit", False):
+                    lines += [
+                        "# TYPE mst_preemptions_total counter",
+                        f"mst_preemptions_total {b.preemptions}",
+                    ]
                 prefix = getattr(b, "prefix_stats", lambda: None)()
                 if prefix is not None:
                     queries, hits, reused, evictions, cached = prefix

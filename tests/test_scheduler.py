@@ -303,6 +303,111 @@ def test_single_stage_batched_step_parity():
         batcher.close()
 
 
+# ----------------------------------------------------------------- over-commit
+def _paged_batcher(pool_pages=8, microbatches=2, **kw):
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(2), microbatches=microbatches,
+        max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8,
+        pool_pages=pool_pages, page_size=8,
+    )
+    ref = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    return ContinuousBatcher(eng, decode_block=3, **kw), ref
+
+
+@pytest.fixture(scope="module")
+def oc_setup():
+    """One 8-page pool where each test request's FULL need is 6 pages — two
+    can never be co-resident under reserve admission, but over-commit admits
+    both on current need and preempts under pressure."""
+    batcher, ref = _paged_batcher(pool_pages=8, overcommit=True)
+    yield batcher, ref
+    batcher.close()
+
+
+def test_overcommit_requires_paged(setup):
+    batcher, _ = setup  # dense engine from the module fixture
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(batcher.engine, overcommit=True)
+
+
+def test_overcommit_interleaves_where_reserve_serializes(oc_setup):
+    """Two requests whose reserved needs (6 pages each) exceed the 8-page
+    pool: reserve admission runs them strictly one-after-another, over-commit
+    runs them concurrently (higher slot occupancy) and stays token-exact
+    through the preemption the pool pressure eventually forces."""
+    jobs = [
+        ([3, 17, 42, 9], dict(max_tokens=40)),   # full need ceil(44/8)=6
+        ([5, 11, 2, 8], dict(max_tokens=40)),
+    ]
+    # reserve-mode control: same pool, no overcommit — strict serialization
+    reserve, ref = _paged_batcher(pool_pages=8)
+    try:
+        refs = [_run(ref, p, **kw) for p, kw in jobs]
+        got_r, times_r = _concurrent(reserve, jobs)
+        assert got_r == refs
+        # one request's stream finished entirely before the other started
+        starts = [t[0] for t in times_r]
+        ends = [t[-1] for t in times_r]
+        assert min(ends) <= max(starts), "reserve admission co-ran 2x6 pages in an 8-page pool"
+    finally:
+        reserve.close()
+
+    batcher, _ = oc_setup
+    before = batcher.preemptions
+    got, times = _concurrent(batcher, jobs)
+    assert got == refs  # token-exact through preemption + resume
+    # genuine interleaving: each produced a token before the other finished
+    assert times[0][0] < times[1][-1] and times[1][0] < times[0][-1]
+    assert batcher.preemptions > before  # pool pressure forced a preemption
+
+
+def test_overcommit_preempt_resume_seeded_exact(oc_setup):
+    """A seeded stochastic request that gets preempted and resumed must
+    continue its exact PRNG chain and repetition window: its stream matches
+    the uninterrupted solo run token-for-token."""
+    batcher, ref = oc_setup
+    jobs = [
+        ([7, 7, 2, 1], dict(max_tokens=40)),  # greedy hog, admitted first
+        ([9, 4, 4, 6], dict(temperature=0.9, top_p=0.85, seed=321,
+                            repetition_penalty=1.3, repetition_context_size=8,
+                            max_tokens=36)),
+    ]
+    refs = [_run(ref, p, **kw) for p, kw in jobs]
+    before = batcher.preemptions
+    got, _ = _concurrent(batcher, jobs)
+    assert got == refs
+    assert batcher.preemptions > before
+    # pool accounting intact after the churn: everything back on the free list
+    total, in_use, _ = batcher.page_stats()
+    assert in_use == 0 and len(batcher._free_pages) == total
+
+
+def test_overcommit_prefix_cache_compose():
+    """Over-commit + prefix cache: a preempted request's registered prompt
+    pages survive as cache entries and its resume re-prefill hits them;
+    streams stay exact."""
+    batcher, ref = _paged_batcher(
+        pool_pages=8, overcommit=True, prefix_cache=True
+    )
+    try:
+        shared = [((7 * i) % 251) + 1 for i in range(12)]  # 1 full page + 4
+        jobs = [
+            (shared + [61, 62], dict(max_tokens=30)),
+            (shared + [71], dict(max_tokens=30)),
+        ]
+        refs = [_run(ref, p, **kw) for p, kw in jobs]
+        got, _ = _concurrent(batcher, jobs)
+        assert got == refs
+        assert batcher.prefix_stats()[0] >= 2  # both queried the index
+    finally:
+        batcher.close()
+
+
 # ---------------------------------------------------------------- prefix cache
 def _paged_cached_batcher(pool_pages=24, microbatches=2, **kw):
     cfg = LlamaConfig(**TINY)
