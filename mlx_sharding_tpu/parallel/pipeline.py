@@ -44,8 +44,10 @@ from mlx_sharding_tpu.sample import (
     SamplerParams,
     init_recent_tokens,
     make_sampler_params,
+    nucleus_logits_batched,
     sample_token,
     sample_token_batched,
+    transform_logits_batched,
     update_recent_tokens,
 )
 
@@ -391,6 +393,7 @@ class PipelineEngine:
         self._decode_cb = None
         self._prefill_slot = None
         self._decode_blocks: dict = {}  # (k_steps, want_lp) → jitted block
+        self._spec_progs: dict = {}  # ("propose"|"verify", K) → jitted prog
 
     def decode_cb(self):
         if self._decode_cb is None:
@@ -534,19 +537,26 @@ class PipelineEngine:
             outs.append(g.reshape(*g.shape[:2], -1, *g.shape[4:]))
         return tuple(outs)
 
-    def _paged_writeback(self, pool, buf, table_row, offset):
-        """Scatter the one dirty page (the one containing ``offset``) of a
-        slot's contiguous buffer back into the pool. Chunk writes never
-        straddle pages (page_size % prefill_chunk == 0 and offsets are
-        chunk-aligned), so a single page is always enough."""
+    def _paged_writeback(self, pool, buf, table_row, offset, n_pages=1):
+        """Scatter the dirty page(s) of a slot's contiguous buffer back into
+        the pool, starting at the page containing ``offset``. Chunk writes
+        never straddle pages (page_size % prefill_chunk == 0 and offsets are
+        chunk-aligned), so prefill and T=1 decode pass n_pages=1; a T=K
+        speculative verify writes K rows at an arbitrary offset and passes
+        the worst-case straddle count. Writing back a page the step didn't
+        touch is idempotent (it holds exactly what the gather read)."""
         l, b = buf.shape[:2]
         page = self.page_size
         buf6 = buf.reshape(l, b, self.slot_pages, page, *buf.shape[3:])
-        pidx = offset // page
-        dirty = jax.lax.dynamic_index_in_dim(buf6, pidx, 2, keepdims=False)
-        return jax.lax.dynamic_update_index_in_dim(
-            pool, dirty.astype(pool.dtype), table_row[pidx], 1
-        )
+        for i in range(n_pages):
+            # out-of-range pidx clamps (dynamic_index semantics) to the last
+            # buffer page and its table entry — an idempotent re-write
+            pidx = jnp.minimum(offset // page + i, self.slot_pages - 1)
+            dirty = jax.lax.dynamic_index_in_dim(buf6, pidx, 2, keepdims=False)
+            pool = jax.lax.dynamic_update_index_in_dim(
+                pool, dirty.astype(pool.dtype), table_row[pidx], 1
+            )
+        return pool
 
     def _kv_read(self, paged, k, v, table, m_write):
         """One slot's contiguous KV view: page-table gather (paged) or
@@ -559,13 +569,13 @@ class PipelineEngine:
         v_m = jax.lax.dynamic_index_in_dim(v, m_write, 1, keepdims=False)
         return k_m, v_m, None
 
-    def _kv_write(self, paged, k, v, k_m, v_m, row, m_write, offset):
-        """Inverse of _kv_read: scatter the dirty page back (paged) or
+    def _kv_write(self, paged, k, v, k_m, v_m, row, m_write, offset, n_pages=1):
+        """Inverse of _kv_read: scatter the dirty page(s) back (paged) or
         update the slot slice (dense)."""
         if paged:
             return (
-                self._paged_writeback(k, k_m, row, offset),
-                self._paged_writeback(v, v_m, row, offset),
+                self._paged_writeback(k, k_m, row, offset, n_pages),
+                self._paged_writeback(v, v_m, row, offset, n_pages),
             )
         return (
             jax.lax.dynamic_update_index_in_dim(k, k_m, m_write, 1),
@@ -576,9 +586,16 @@ class PipelineEngine:
         smapped = self._build_smapped(t_len)
         return self._finish_step(smapped, t_len, with_sampling)
 
-    def _build_smapped(self, t_len: int, paged: bool = False):
+    def _build_smapped(self, t_len: int, paged: bool = False,
+                       keep_all: bool = False):
+        """``keep_all`` banks logits for EVERY position instead of only the
+        last valid one — the T=K speculative verify needs all K scores. Only
+        the S == 1 vectorized body supports it (speculative continuous
+        batching is gated to pp=1)."""
         model, S, M, B = self.model, self.num_stages, self.microbatches, self.batch
         rl_kwargs = self._rl_kwargs
+        if keep_all and S != 1:
+            raise ValueError("keep_all logits need the S == 1 vectorized body")
 
         def body(layer_params, masks, vparts, shared, tokens, k, v, offsets, active, n_valid, table):
             # Per-device views: layer_params (1, L, …) → (L, …); k/v
@@ -682,18 +699,30 @@ class PipelineEngine:
 
             h_outs, k_ms, v_ms = jax.vmap(micro)(h_all, k_ms, v_ms, offset_m)
 
+            # T=K writes at a decode (non-chunk-aligned) offset can straddle
+            # pages; prefill/decode offsets never do (page % chunk == 0)
+            wb = (
+                (t_len + self.page_size - 2) // self.page_size + 1
+                if paged and keep_all else 1
+            )
+
             def wr(i, kv):
                 k, v = kv
                 return self._kv_write(
                     paged, k, v, k_ms[i], v_ms[i],
-                    rows[i] if paged else None, m_write[i], offset_m[i],
+                    rows[i] if paged else None, m_write[i], offset_m[i], wb,
                 )
 
             k, v = jax.lax.fori_loop(0, M, wr, (k, v))
-            out = jax.lax.dynamic_index_in_dim(
-                h_outs, n_valid - 1, 2, keepdims=False
-            )  # (M, B, H)
-            out = jnp.where(active[:, None, None], out, 0).astype(k.dtype)
+            if keep_all:
+                out = jnp.where(
+                    active[:, None, None, None], h_outs, 0
+                ).astype(k.dtype)  # (M, B, T, H) — every position's hidden
+            else:
+                out = jax.lax.dynamic_index_in_dim(
+                    h_outs, n_valid - 1, 2, keepdims=False
+                )  # (M, B, H)
+                out = jnp.where(active[:, None, None], out, 0).astype(k.dtype)
             out = jax.lax.psum(out, AXIS_PP)  # identity at S=1; keeps the
             # body shape identical to the rotated one
             logits = self._vs_head(shared, vparts, out)
@@ -733,7 +762,7 @@ class PipelineEngine:
                 active, n_valid, dummy_table,
             )
 
-        if t_len == 1:
+        if t_len == 1 and not keep_all:
             self._smapped_decode = smapped  # shared by the continuous-batching step
         return smapped
 
@@ -812,6 +841,173 @@ class PipelineEngine:
             return tok.reshape(M, B), logprobs, new_cache, recent, keys
 
         return jax.jit(step, donate_argnums=(5, 7, 8))
+
+    # ------------------------------------ speculative continuous batching
+    def spec_propose_cb(self, K: int):
+        """K draft proposals for every continuous-batching slot in ONE
+        program — the draft side of speculative x continuous batching,
+        running on the DRAFT engine. Greedy slots (temperature == 0) draft
+        with plain argmax (transforms live on the verify side, where
+        exactness is decided — speculative.py draft_block_fn); sampled slots
+        draft from their fully-transformed per-slot distribution and record
+        its log-probs q, the rejection-sampling denominator
+        (speculative.py draft_sampled_fn), each evolving a LOCAL copy of its
+        repetition window with its own proposals. Returns a jitted
+        ``prog(layer_params, masks, vparts, shared, tok, cache, active,
+        recent, dkeys, sp, rep_sizes) -> (drafts (K, M), q_logprobs
+        (K, M, V), cache)``."""
+        key = ("propose", K)
+        if key not in self._spec_progs:
+            M, B = self.microbatches, self.batch
+            if self.num_stages != 1:
+                raise ValueError(
+                    "speculative continuous batching needs a pp=1 engine"
+                )
+            if B != 1:
+                raise ValueError("continuous batching expects batch=1 per slot")
+            if self.paged:
+                raise ValueError("the draft engine must be dense (no pool_pages)")
+            if self._smapped_decode is None:
+                self._build_step(t_len=1, with_sampling=True)
+            dense = self._smapped_decode
+            one = jnp.asarray(1, jnp.int32)
+
+            def prog(layer_params, masks, vparts, shared, tok, cache, active,
+                     recent, dkeys, sp, rep_sizes):
+                W = recent.shape[1]
+                valid = jnp.arange(W)[None, :] >= (W - rep_sizes)[:, None]
+
+                def step(carry, _):
+                    tok, k, v, offsets, recent, dkeys = carry
+                    logits, k, v = dense(
+                        layer_params, masks, vparts, shared, tok, k, v,
+                        offsets, active, one,
+                    )
+                    flat = logits.reshape(M, -1)
+                    split = jax.vmap(jax.random.split)(dkeys)
+                    dkeys, subs = split[:, 0], split[:, 1]
+                    f = nucleus_logits_batched(
+                        transform_logits_batched(
+                            flat, jnp.where(valid, recent, -1), sp
+                        ),
+                        sp,
+                    )
+                    qlp = jax.nn.log_softmax(f, axis=-1)
+                    drawn = jax.vmap(
+                        lambda kk, lo: jax.random.categorical(kk, lo)
+                    )(subs, f)
+                    tok = jnp.where(
+                        sp.temperature > 0, drawn, jnp.argmax(flat, axis=-1)
+                    ).astype(jnp.int32)
+                    recent = update_recent_tokens(recent, tok)
+                    offsets = offsets + active.astype(jnp.int32)
+                    return (tok.reshape(M, B), k, v, offsets, recent, dkeys), (
+                        tok, qlp,
+                    )
+
+                (tok, k, v, offsets, _, _), (drafts, qlps) = jax.lax.scan(
+                    step,
+                    (tok, cache.k, cache.v, cache.offset, recent, dkeys),
+                    None, length=K,
+                )
+                return drafts, qlps, KVCache(k=k, v=v, offset=offsets)
+
+            self._spec_progs[key] = jax.jit(prog, donate_argnums=(5,))
+        return self._spec_progs[key]
+
+    def spec_verify_cb(self, K: int):
+        """One T=K target forward over ``[t0, d1..d_{K-1}]`` per slot scores
+        every draft position for all M slots at once (keep_all logits body);
+        acceptance per slot is the exact greedy agreement prefix
+        (temperature 0 — every emitted token is what plain decode would
+        produce) or Leviathan rejection sampling with the slot's own PRNG
+        key (sampled — emitted tokens distributed exactly as the slot's
+        transformed target distribution). The rollback is one per-slot
+        scalar: offset += count keeps exactly the verified prefix
+        (speculative.py verify_fn/verify_sampled_fn vectorized over slots).
+        Returns a jitted ``prog(layer_params, masks, vparts, shared, tok,
+        drafts, qlps, cache, active, recent, vkeys, sp, rep_sizes, table)
+        -> (gs (K, M), count (M,), next_tok (M, 1), cache, recent)``."""
+        cache_key = ("verify", K)
+        if cache_key not in self._spec_progs:
+            from mlx_sharding_tpu.speculative import rejection_round
+
+            M, B = self.microbatches, self.batch
+            if B != 1:
+                raise ValueError("continuous batching expects batch=1 per slot")
+            inner = self._build_smapped(t_len=K, paged=self.paged, keep_all=True)
+            if not self.paged:
+                dense = inner
+                inner = lambda *args: dense(*args[:-1])  # drop the table arg
+            n_valid = jnp.asarray(K, jnp.int32)
+
+            def prog(layer_params, masks, vparts, shared, tok, drafts, qlps,
+                     cache, active, recent, vkeys, sp, rep_sizes, table):
+                x = jnp.concatenate([tok, drafts[:-1].T], axis=1)  # (M, K)
+                off0 = cache.offset
+                logits_all, k, v = inner(
+                    layer_params, masks, vparts, shared, x[:, None, :],
+                    cache.k, cache.v, off0, active, n_valid, table,
+                )  # (M, 1, K, V)
+                logits_all = logits_all.reshape(M, K, -1)
+                W = recent.shape[1]
+                valid = jnp.arange(W)[None, :] >= (W - rep_sizes)[:, None]
+                sampled = sp.temperature > 0  # (M,)
+
+                def score(rec, i):
+                    tl = transform_logits_batched(
+                        logits_all[:, i], jnp.where(valid, rec, -1), sp
+                    )
+                    g = jnp.argmax(tl, axis=-1).astype(jnp.int32)
+                    plp = jax.nn.log_softmax(
+                        nucleus_logits_batched(tl, sp), axis=-1
+                    )
+                    # the token consumed at position i+1: the draft's
+                    # proposal (sampled — exact on the accepted prefix,
+                    # discarded past it) or the greedy verdict
+                    rec = update_recent_tokens(
+                        rec, jnp.where(sampled, drafts[i], g)
+                    )
+                    return rec, (g, plp)
+
+                _, (gs_g, plps) = jax.lax.scan(score, recent, jnp.arange(K))
+                # greedy: longest agreement prefix, then the correction token
+                mism = gs_g != drafts
+                any_m = mism.any(axis=0)
+                m_g = jnp.where(any_m, jnp.argmax(mism, axis=0), K - 1)
+
+                # rejection sampling, one vmapped lane per slot
+                def rr(key_s, d, q, p):
+                    gs, m, _ = rejection_round(
+                        key_s, d[:, None], q[:, None], p[:, None]
+                    )
+                    return gs[:, 0], m[0]
+
+                gs_s, m_s = jax.vmap(rr, in_axes=(0, 1, 1, 1), out_axes=(1, 0))(
+                    vkeys, drafts, qlps, plps
+                )
+                gs = jnp.where(sampled[None, :], gs_s, gs_g)
+                m = jnp.where(sampled, m_s, m_g)
+                count = jnp.where(active, m + 1, 0).astype(jnp.int32)
+
+                # replay ONLY the emitted tokens into the pre-round window
+                # (the score scan's evolution was provisional)
+                def replay(rec, i):
+                    upd = update_recent_tokens(rec, gs[i])
+                    keep = (i <= m) & active
+                    return jnp.where(keep[:, None], upd, rec), None
+
+                recent, _ = jax.lax.scan(replay, recent, jnp.arange(K))
+                nxt = jnp.take_along_axis(gs, m[None, :], axis=0)[0]  # (M,)
+                next_tok = jnp.where(active, nxt, tok[:, 0])[:, None]
+                return gs, count, next_tok, KVCache(
+                    k=k, v=v, offset=off0 + count
+                ), recent
+
+            self._spec_progs[cache_key] = jax.jit(
+                prog, donate_argnums=(7, 9)
+            )
+        return self._spec_progs[cache_key]
 
     def _build_prefill_slot(self):
         """Prefill one chunk of ONE slot's request while other slots' state

@@ -208,6 +208,31 @@ def set_sampler_slot(
     return jax.tree.map(lambda full, x: full.at[slot].set(x), batched, one)
 
 
+def transform_logits_batched(
+    logits: jax.Array,  # (B, V)
+    recent_tokens: jax.Array,  # (B, W) int32, -1 padded
+    params: SamplerParams,  # every leaf with leading (B,)
+) -> jax.Array:
+    """Per-row bias → repetition penalty — the batched transform_logits
+    (one continuous-batching slot per row)."""
+    logits = logits.astype(jnp.float32)
+    logits = jax.vmap(lambda l, i, v: l.at[i].add(v))(
+        logits, params.bias_indices, params.bias_values
+    )
+    return jax.vmap(
+        lambda l, r, p: apply_repetition_penalty(l[None], r[None], p)[0]
+    )(logits, recent_tokens, params.repetition_penalty)
+
+
+def nucleus_logits_batched(lo: jax.Array, params: SamplerParams) -> jax.Array:
+    """Per-row temperature + top-p on transformed logits — the batched
+    nucleus_logits; with transform_logits_batched it defines each slot's
+    full sampling distribution (the p and q of batched speculative
+    rejection sampling)."""
+    safe_temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    return jax.vmap(top_p_filter)(lo / safe_temp, params.top_p)
+
+
 def sample_token_batched(
     keys: jax.Array,  # (B, 2) uint32 — one PRNG key per row
     logits: jax.Array,  # (B, V) f32
@@ -218,18 +243,11 @@ def sample_token_batched(
     continuous-batching slot behaves exactly like a solo request with that
     seed, so draining a slot and re-running the request serially reproduces
     its tokens."""
-    logits = logits.astype(jnp.float32)
-    logits = jax.vmap(lambda l, i, v: l.at[i].add(v))(
-        logits, params.bias_indices, params.bias_values
-    )
-    logits = jax.vmap(
-        lambda l, r, p: apply_repetition_penalty(l[None], r[None], p)[0]
-    )(logits, recent_tokens, params.repetition_penalty)
+    logits = transform_logits_batched(logits, recent_tokens, params)
 
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
-    safe_temp = jnp.maximum(params.temperature, 1e-6)[:, None]
-    filtered = jax.vmap(top_p_filter)(logits / safe_temp, params.top_p)
+    filtered = nucleus_logits_batched(logits, params)
     sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(keys, filtered)
     token = jnp.where(params.temperature > 0, sampled, greedy)
     return token.astype(jnp.int32), logprobs

@@ -19,6 +19,13 @@ batching, the thing the fused engine's ``M`` axis was designed for:
 Determinism: each slot samples with its own PRNG-key chain seeded from the
 request's seed, so a request's token stream is identical whether it ran
 alone or interleaved with others (tested in tests/test_scheduler.py).
+One carve-out: with a draft engine attached, a SAMPLED request's key chain
+advances per speculative round (3 splits) vs per plain step (1 split), and
+a neighbor that pauses speculation for a tick (want_logprobs, or within K
+of max_seq — see _spec_ok) shifts where those rounds fall — so a sampled
+stream is replay-stable only among spec-compatible neighbors. Every stream
+remains distribution-exact regardless, and GREEDY streams never consume
+keys, so their token-exactness holds unconditionally.
 """
 
 from __future__ import annotations
@@ -91,9 +98,45 @@ class ContinuousBatcher:
 
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
                  policy: str = "fifo", prefix_cache: bool = False,
-                 overcommit: bool = False):
+                 overcommit: bool = False, draft_engine=None, spec_k: int = 4):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
+        if draft_engine is not None:
+            # speculative x continuous batching: the draft engine mirrors the
+            # target's slot structure (same M, same chunking) with its own
+            # dense KV cache; pp=1 only (the verify needs the keep_all
+            # vectorized body)
+            if engine.num_stages != 1 or draft_engine.num_stages != 1:
+                raise ValueError(
+                    "speculative continuous batching needs pp=1 engines"
+                )
+            tv = getattr(engine.model.config, "vocab_size", None)
+            dv = getattr(draft_engine.model.config, "vocab_size", None)
+            if tv != dv:
+                # a mismatched pair would silently emit clamped-index
+                # garbage: draft token ids index the target's embedding and
+                # logprob rows (speculative.py:131-139 enforces the same)
+                raise ValueError(
+                    f"draft vocab ({dv}) must match target vocab ({tv}) — "
+                    "speculation exchanges raw token ids between the models"
+                )
+            if getattr(draft_engine, "paged", False):
+                raise ValueError("the draft engine must be dense (no pool_pages)")
+            if draft_engine.microbatches != engine.microbatches:
+                raise ValueError("draft engine must match the target's slots")
+            if draft_engine.prefill_chunk != engine.prefill_chunk:
+                raise ValueError("draft engine must match the target's "
+                                 "prefill chunk")
+            if draft_engine.max_seq < engine.max_seq:
+                raise ValueError("draft engine max_seq must cover the target's")
+            if prefix_cache:
+                # a prefix hit skips target prefill for reused pages, but the
+                # draft has no page sharing and must see the whole prompt
+                raise ValueError(
+                    "prefix_cache does not compose with a draft engine"
+                )
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         if policy not in ("fifo", "first_fit"):
             raise ValueError(f"unknown admission policy {policy!r}")
         if prefix_cache and not getattr(engine, "paged", False):
@@ -177,6 +220,33 @@ class ContinuousBatcher:
         self.overcommit = bool(overcommit)
         self.preemptions = 0
         self._admit_counter = 0
+        # speculative decoding across slots: per tick, the draft proposes K
+        # tokens for every active slot and the target verifies all of them
+        # in one T=K forward; each slot emits its accepted prefix + one
+        # correction/resample token. Greedy slots stay token-exact vs plain
+        # decode; sampled slots are distribution-exact (the PRNG is consumed
+        # differently than non-speculative decode, as in speculative.py).
+        self.draft = draft_engine
+        self.spec_k = spec_k
+        # over-commit page growth must cover whichever step writes furthest
+        # ahead: a decode block (1 write/step) or a T=K speculative verify
+        self._grow_ahead = (
+            max(decode_block, spec_k) if draft_engine is not None
+            else self.decode_block
+        )
+        if draft_engine is not None:
+            self.rounds = 0          # spec telemetry: verify rounds x slots
+            self.accepted_tokens = 0  # tokens emitted by those rounds
+            self.dcache = draft_engine.init_cache()
+            k_ = spec_k
+            self._split3 = jax.jit(
+                lambda ks: jax.vmap(lambda k: jax.random.split(k, 3))(ks)
+            )
+            # draft consumed [t0, d1..d_{K-1}] = K rows; keep the verified
+            # prefix (the accepted tokens ARE the draft's inputs there)
+            self._drewind = jax.jit(
+                lambda off, count, act: off + jnp.where(act, count - k_, 0)
+            )
         if self.paged:
             self.cache, self.table = engine.init_cache_paged()
             self._free_pages = list(range(engine.pool_pages - 1, -1, -1))
@@ -496,6 +566,14 @@ class ContinuousBatcher:
             self.rep_sizes, slot_arr,
             self._put(jnp.asarray(req.rep_context, jnp.int32)),
         )
+        if self.draft is not None:
+            # the draft mirrors the slot from position 0 (no page sharing)
+            self.dcache = self.dcache._replace(
+                offset=self._row_set(
+                    self.dcache.offset, slot_arr,
+                    self._put(jnp.asarray(0, jnp.int32)),
+                )
+            )
         self._slots[slot] = req
         req.slot = slot
         # prefill starts past the reused prefix — its KV is already mapped
@@ -517,6 +595,13 @@ class ContinuousBatcher:
             self.cache, self._put(jnp.asarray(n_valid, jnp.int32)),
             self.table if self.paged else None,
         )
+        if self.draft is not None:
+            d = self.draft
+            _, self.dcache = d.prefill_slot()(
+                d.layer_params, d.layer_masks, d.vocab_parts, d.shared_params,
+                self._put(jnp.asarray(chunk[None])), slot_arr, self.dcache,
+                self._put(jnp.asarray(n_valid, jnp.int32)), None,
+            )
         req.prefill_pos += n_valid
         if req.prefill_pos < req.prompt.size:
             return
@@ -685,7 +770,7 @@ class ContinuousBatcher:
         absolute capacity check proves a lone request's full need fits the
         pool, so it can always grow to completion — progress is guaranteed."""
         page = self.engine.page_size
-        K = self.decode_block
+        K = self._grow_ahead
         decoding = sorted(
             (
                 (slot, req)
@@ -760,9 +845,69 @@ class ContinuousBatcher:
         if self.overcommit:
             remaining = max(1, req.max_tokens - req.produced)
             return self._pages_needed(
-                req.prompt.size, min(self.decode_block, remaining)
+                req.prompt.size, min(self._grow_ahead, remaining)
             )
         return self._pages_needed(req.prompt.size, req.max_tokens)
+
+    def _spec_ok(self) -> bool:
+        """A tick can take the speculative round iff no decoding slot wants
+        logprob summaries (the verify doesn't compute them) and every
+        decoding slot has K rows of KV headroom — the verify writes K
+        positions speculatively, and past max_seq the dynamic-slice clamp
+        would corrupt valid rows. Ticks that fail the check run a plain
+        decode block (all slots still advance, just unspeculated)."""
+        K, ms = self.spec_k, self.engine.max_seq
+        for req in self._slots:
+            if req is None or req.prefill_pos < req.prompt.size:
+                continue
+            if req.want_logprobs:
+                return False
+            since = len(req.history) if self.overcommit else req.produced
+            if req.prompt.size + max(0, since - 1) + K > ms:
+                return False
+        return True
+
+    def _spec_once(self):
+        """One speculative round for every decoding slot: K batched draft
+        proposals, one T=K target verify, per-slot acceptance (greedy exact
+        prefix / rejection sampling with the slot's own key chain), emitted
+        counts pulled host-side. The draft's cache rewinds to each slot's
+        verified prefix — rollback is one scalar per slot, same as the
+        single-stream SpeculativeGenerator."""
+        eng, d, K = self.engine, self.draft, self.spec_k
+        if self.paged and self.overcommit:
+            self._grow_for_decode()
+        live = [
+            (slot, req) for slot, req in enumerate(self._slots)
+            if req is not None and req.prefill_pos >= req.prompt.size
+        ]
+        if not live:
+            return
+        keys3 = self._split3(self.keys)
+        self.keys, dkeys, vkeys = keys3[:, 0], keys3[:, 1], keys3[:, 2]
+        drafts, qlps, self.dcache = d.spec_propose_cb(K)(
+            d.layer_params, d.layer_masks, d.vocab_parts, d.shared_params,
+            self.last_tok, self.dcache, self.active, self.recent, dkeys,
+            self.sp, self.rep_sizes,
+        )
+        gs, count, self.last_tok, self.cache, self.recent = eng.spec_verify_cb(K)(
+            eng.layer_params, eng.layer_masks, eng.vocab_parts,
+            eng.shared_params, self.last_tok, drafts, qlps, self.cache,
+            self.active, self.recent, vkeys, self.sp, self.rep_sizes,
+            self.table,
+        )
+        self.dcache = self.dcache._replace(
+            offset=self._drewind(self.dcache.offset, count, self.active)
+        )
+        counts = np.asarray(jax.device_get(count))
+        gs_h = np.asarray(jax.device_get(gs))
+        self.rounds += len(live)
+        for slot, req in live:
+            self.accepted_tokens += int(counts[slot])
+            for j in range(int(counts[slot])):
+                if req.slot != slot:
+                    break  # finished (max_tokens) earlier in this round
+                self._emit(req, int(gs_h[j, slot]), None)
 
     def _fits(self, req: _Request) -> bool:
         if not self.paged:
@@ -839,7 +984,10 @@ class ContinuousBatcher:
                 for req in prefilling:
                     self._prefill_one_chunk(req)
         if bool(np.asarray(self.active).any()):
-            self._decode_once()
+            if self.draft is not None and self._spec_ok():
+                self._spec_once()
+            else:
+                self._decode_once()
         elif not any(self._slots):
             # idle: block until the next request arrives
             self._drain_submissions(block=True)

@@ -160,6 +160,25 @@ class ModelProvider:
         if default_model:
             self.load("default_model")
 
+    def _load_draft(self, cache_dtype):
+        """Load the draft model pair for speculative decoding. The draft
+        rides the packed path only if IT is a quantized checkpoint — a
+        dense draft next to a quantized target is a legitimate pairing."""
+        from mlx_sharding_tpu.loading import (
+            get_model_path,
+            load_config,
+            load_model,
+        )
+
+        draft_quant = (
+            load_config(get_model_path(self.draft_model))
+            .get("quantization") is not None
+        )
+        return load_model(
+            self.draft_model, dtype=cache_dtype,
+            keep_quantized=self.keep_quantized and draft_quant,
+        )
+
     def _validate(self, name: str) -> str:
         if name == "default_model":
             if not self.default_model:
@@ -221,6 +240,11 @@ class ModelProvider:
                     from mlx_sharding_tpu.parallel.mesh import make_mesh
                     from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
+                    draft_pair = (
+                        self._load_draft(cache_dtype)
+                        if self.draft_model and self.concurrent > 1 else None
+                    )
+
                     per = stages * self.tp * self.ep
                     devices = _jax.devices()
                     if self.replicas * per > len(devices):
@@ -249,6 +273,18 @@ class ModelProvider:
                                 ContinuousBatcher,
                             )
 
+                            draft_eng = None
+                            if draft_pair is not None:
+                                dmodel, dparams = draft_pair
+                                draft_eng = PipelineEngine(
+                                    dmodel, dparams,
+                                    make_mesh(pp=1, tp=1, ep=1,
+                                              devices=dev_slice),
+                                    microbatches=self.concurrent,
+                                    max_seq=self.max_seq,
+                                    cache_dtype=cache_dtype,
+                                    prefill_chunk=self.prefill_chunk,
+                                )
                             engine = ContinuousBatcher(
                                 engine,
                                 decode_block=min(8, self.decode_block),
@@ -256,6 +292,8 @@ class ModelProvider:
                                 prefix_cache=self.prompt_cache
                                 and self.paged_pool is not None,
                                 overcommit=self.overcommit,
+                                draft_engine=draft_eng,
+                                spec_k=self.spec_k,
                             )
                         return engine
 
@@ -292,23 +330,11 @@ class ModelProvider:
 
                             generator = MultiHostPipeline(generator)
                 elif self.draft_model:
-                    from mlx_sharding_tpu.loading import load_config
                     from mlx_sharding_tpu.speculative import (
                         SpeculativeGenerator,
                     )
 
-                    # the draft rides the packed path only if IT is a
-                    # quantized checkpoint — a dense draft next to a
-                    # quantized target is a legitimate pairing
-                    draft_quant = (
-                        load_config(
-                            get_model_path(self.draft_model)
-                        ).get("quantization") is not None
-                    )
-                    dmodel, dparams = load_model(
-                        self.draft_model, dtype=cache_dtype,
-                        keep_quantized=self.keep_quantized and draft_quant,
-                    )
+                    dmodel, dparams = self._load_draft(cache_dtype)
                     generator = SpeculativeGenerator(
                         model, params, dmodel, dparams, spec_k=self.spec_k,
                         max_seq=self.max_seq, cache_dtype=cache_dtype,
@@ -963,14 +989,19 @@ def main(argv=None):
     if chat_template and chat_template.startswith("@"):
         chat_template = Path(chat_template[1:]).read_text()
     if args.draft_model and (
-        args.concurrent > 1 or args.coordinator or args.tp > 1
+        args.coordinator or args.tp > 1
         or args.ep > 1 or args.stage_bounds or (args.num_stages or 1) > 1
         or args.engine == "chained"
         or args.start_layer is not None or args.end_layer is not None
     ):
         parser.error("--draft-model applies to the single-chip full-model "
-                     "generator (no --concurrent/--coordinator/--tp/--ep/"
-                     "stage or layer-range flags)")
+                     "generator or to --concurrent serving "
+                     "(no --coordinator/--tp/--ep/stage or "
+                     "layer-range flags)")
+    if args.draft_model and args.prompt_cache and args.concurrent > 1:
+        parser.error("--draft-model does not compose with --prompt-cache "
+                     "(a prefix hit skips target prefill the draft "
+                     "still needs)")
     if args.prompt_cache and args.concurrent > 1 and not args.paged_pool:
         parser.error("--prompt-cache with --concurrent requires --paged-pool "
                      "(prefix sharing is page-granular)")
@@ -987,13 +1018,15 @@ def main(argv=None):
     if args.prompt_cache and args.concurrent > 1 and args.coordinator:
         parser.error("--prompt-cache is not supported in multi-host serving")
     if args.replicas > 1 and (
-        args.coordinator or args.engine == "chained" or args.draft_model
+        args.coordinator or args.engine == "chained"
         or args.prompt_cache
+        or (args.draft_model and args.concurrent <= 1)
         or args.start_layer is not None or args.end_layer is not None
     ):
         parser.error("--replicas requires the fused full-model engine path "
-                     "(no --coordinator/--engine chained/--draft-model/"
-                     "--prompt-cache/layer-range flags)")
+                     "(no --coordinator/--engine chained/--prompt-cache/"
+                     "layer-range flags; --draft-model only with "
+                     "--concurrent)")
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
     if args.paged_pool and args.engine == "chained":

@@ -408,6 +408,156 @@ def test_overcommit_prefix_cache_compose():
         batcher.close()
 
 
+# --------------------------------------------- speculative continuous batching
+def _spec_batcher(microbatches=3, spec_k=3, pool_pages=None, draft_seed=7,
+                  **kw):
+    """Target + draft of the same tiny arch; ``draft_seed`` controls
+    agreement (same seed → perfect draft, different → imperfect, so both
+    the accept and the reject/correction paths run)."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    dparams = model.init_params(jax.random.PRNGKey(draft_seed), jnp.float32)
+    mesh = pipeline_mesh(1)
+    eng = PipelineEngine(
+        model, params, mesh, microbatches=microbatches, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+        pool_pages=pool_pages, page_size=8 if pool_pages else None,
+    )
+    deng = PipelineEngine(
+        model, dparams, mesh, microbatches=microbatches, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    ref = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    return (
+        ContinuousBatcher(eng, decode_block=4, draft_engine=deng,
+                          spec_k=spec_k, **kw),
+        ref,
+    )
+
+
+@pytest.fixture(scope="module")
+def spec_setup():
+    batcher, ref = _spec_batcher()
+    yield batcher, ref
+    batcher.close()
+
+
+def test_spec_cb_greedy_token_exact(spec_setup):
+    """Speculative continuous batching emits exactly the tokens plain
+    (non-speculative) greedy decode would, for every interleaved request —
+    whatever the draft proposes only throughput may change, never content."""
+    batcher, ref = spec_setup
+    jobs = [
+        ([3, 17, 42], dict(max_tokens=12)),
+        (list(range(1, 20)), dict(max_tokens=9)),  # multi-chunk admission
+        ([9, 1, 4, 7], dict(max_tokens=11,
+                            repetition_penalty=1.3,
+                            repetition_context_size=8)),
+    ]
+    refs = [_run(ref, p, **kw) for p, kw in jobs]
+    r0, a0 = batcher.rounds, batcher.accepted_tokens
+    got, times = _concurrent(batcher, jobs)
+    assert got == refs
+    assert batcher.rounds > r0
+    assert batcher.accepted_tokens - a0 >= batcher.rounds - r0  # >= 1/round
+    # genuinely interleaved, not serialized
+    assert times[0][0] < times[1][-1] and times[1][0] < times[0][-1]
+
+
+def test_spec_cb_perfect_draft_accepts_k(spec_setup):
+    """A draft identical to the target agrees at every position: every
+    round emits the full window K (the acceptance gauge's upper bound)."""
+    batcher, ref = _spec_batcher(microbatches=2, spec_k=3, draft_seed=0)
+    try:
+        jobs = [([3, 17, 42], dict(max_tokens=13)),
+                ([5, 11, 2], dict(max_tokens=13))]
+        refs = [_run(ref, p, **kw) for p, kw in jobs]
+        got, _ = _concurrent(batcher, jobs)
+        assert got == refs
+        assert batcher.accepted_tokens == batcher.spec_k * batcher.rounds
+    finally:
+        batcher.close()
+
+
+def test_spec_cb_sampled_interleaving_independent(spec_setup):
+    """Sampled requests under speculation: per-slot PRNG chains make a
+    seeded request's stream identical run solo or interleaved with
+    spec-compatible neighbors (both through the speculative path; matching
+    NON-speculative streams is not promised — the PRNG is consumed
+    differently — and a neighbor that pauses speculation shifts sampled
+    chains too, per the scheduler docstring carve-out)."""
+    batcher, _ = spec_setup
+    jobs = [
+        ([5, 6, 2], dict(temperature=0.9, top_p=0.8, seed=11, max_tokens=9)),
+        ([8, 8, 1], dict(temperature=1.2, top_p=0.95, seed=97, max_tokens=8)),
+        ([2, 4], dict(max_tokens=10)),  # greedy neighbor in the same rounds
+    ]
+    solo = [_run(batcher, p, **kw) for p, kw in jobs]
+    got, _ = _concurrent(batcher, jobs)
+    assert got == solo
+
+
+def test_spec_cb_paged_overcommit_compose():
+    """Speculation x paged pool x over-commit: verify writes straddle page
+    boundaries (multi-page writeback) and pool pressure preempts + resumes
+    a request mid-speculation; greedy streams stay exact throughout."""
+    batcher, ref = _spec_batcher(microbatches=2, spec_k=3, pool_pages=8,
+                                 overcommit=True)
+    try:
+        jobs = [
+            ([3, 17, 42, 9], dict(max_tokens=40)),  # full need 6 pages
+            ([5, 11, 2, 8], dict(max_tokens=40)),
+        ]
+        refs = [_run(ref, p, **kw) for p, kw in jobs]
+        before = batcher.preemptions
+        got, _ = _concurrent(batcher, jobs)
+        assert got == refs
+        assert batcher.preemptions > before
+        total, in_use, _ = batcher.page_stats()
+        assert in_use == 0 and len(batcher._free_pages) + 0 == total
+    finally:
+        batcher.close()
+
+
+def test_spec_cb_logprobs_falls_back_unspeculated(spec_setup):
+    """A want_logprobs request pauses speculation (the verify computes no
+    summaries): tokens still exact, summaries well-formed, rounds frozen."""
+    from mlx_sharding_tpu.generate import TokenLogprobs
+
+    batcher, ref = spec_setup
+    r0 = batcher.rounds
+    out = list(batcher.generate_step([3, 1, 4], max_tokens=6,
+                                     want_logprobs=True))
+    assert [t for t, _ in out] == _run(ref, [3, 1, 4], max_tokens=6)
+    assert batcher.rounds == r0
+    assert all(isinstance(lp, TokenLogprobs) for _, lp in out[1:])
+
+
+def test_spec_cb_guards():
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    eng2 = PipelineEngine(
+        model, params, pipeline_mesh(2), microbatches=2, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    deng = PipelineEngine(
+        model, params, pipeline_mesh(1), microbatches=2, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    with pytest.raises(ValueError, match="pp=1"):
+        ContinuousBatcher(eng2, draft_engine=deng)
+    eng1 = PipelineEngine(
+        model, params, pipeline_mesh(1), microbatches=2, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8, pool_pages=16, page_size=8,
+    )
+    with pytest.raises(ValueError, match="prefix_cache"):
+        ContinuousBatcher(eng1, draft_engine=deng, prefix_cache=True)
+
+
 # ---------------------------------------------------------------- prefix cache
 def _paged_cached_batcher(pool_pages=24, microbatches=2, **kw):
     cfg = LlamaConfig(**TINY)
