@@ -182,8 +182,8 @@ class Generator:
             if not supports_sp_prefill(model):
                 raise ValueError(
                     f"{type(model).__name__} does not support sequence-"
-                    "parallel prefill (needs layer_attn_inputs/layer_finish "
-                    "on a full first+last stage)"
+                    "parallel prefill (needs supports_sp = True with the "
+                    "sp_layer/sp_groups hooks, on a full first+last stage)"
                 )
             self._sp_prefill = SpPrefill(
                 model, params, sp_mesh, prefill_chunk, keep_sharded=sp_decode

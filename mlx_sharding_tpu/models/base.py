@@ -160,6 +160,31 @@ class BaseModel:
         stacks with masked padding for uneven/heterogeneous splits."""
         return {None: (0, self.config.num_hidden_layers)}
 
+    # -- sequence parallelism ---------------------------------------------
+    #: architectures wired for the sequence-parallel paths (sp_prefill's
+    #: ring attention, sp_decode's partial-softmax merge) set this True
+    supports_sp = False
+
+    def sp_groups(self) -> list:
+        """Layer-group keys the sp paths scan over, in forward order.
+        ``[None]`` = ``params["layers"]`` is one homogeneous stack;
+        DeepSeek returns its present ["dense", "moe"] sub-stacks."""
+        return [None]
+
+    def sp_layer(self, p, h, offset, attn_fn, group=None):
+        """One decoder layer with the attention op INJECTED — the shared
+        body of both sp paths. ``attn_fn(q, k_new, v_new, **opts) -> attn``
+        is ring attention (prefill: k/v are this shard's T_local rows) or
+        the sharded-KV partial-softmax attention (decode: the backend
+        owner-writes k/v into its shard first). Supported opts:
+        ``logit_softcap``, ``sliding_window`` (per-layer traced scalars ok),
+        and ``values_from_k`` (attend values = keys[..., :n] — MLA's
+        latent-as-values trick; v_new is then a dummy). Returns
+        ``(h, k_new, v_new)`` — the new rows double as the prefill scan's
+        cache ys. Default: the Llama-family hook pair."""
+        q, k, v = self.layer_attn_inputs(p, h, offset)
+        return self.layer_finish(p, h, attn_fn(q, k, v)), k, v
+
     # -- forward ----------------------------------------------------------
     def __call__(self, params, x, cache: KVCache):
         raise NotImplementedError

@@ -44,6 +44,7 @@ class DeepseekV2Model(BaseModel):
     # kv_b (absorbed into einsums as a tensor) load dense via
     # packed_keep_dense_re.
     supports_packed = True
+    supports_sp = True  # sp_layer below (MLA-aware, grouped dense/moe scan)
 
     def __init__(self, config: DeepseekV2Config):
         super().__init__(config)
@@ -147,14 +148,12 @@ class DeepseekV2Model(BaseModel):
         return out
 
     # ------------------------------------------------------------------
-    def _attention(self, h, p, k_buf, v_buf, offset, tp_axis=None):
-        """MLA under tensor parallelism: the low-rank latent path
-        (kv_a_proj / kv_a_norm and the single rope head) is REPLICATED —
-        it is head-count independent — while the per-head projections
-        (q/q_b, kv_b, o) shard over tp. Head counts derive from the
-        projection shard shapes, so this code runs the full model and any
-        tp slice unchanged; one psum after o_proj completes the row-parallel
-        output projection."""
+    def _attn_qkv(self, p, h, offset):
+        """Shared MLA projection math of the causal and sequence-parallel
+        attention paths. Compressed mode returns ``(q_cat (B,T,H,rank+rope),
+        k_new (B,T,1,rank+rope), None, w_bv (rank,H,v_d))`` — kv_b absorbed
+        into the query side, values are the latent slice of the keys.
+        Decompressed: ``(q_full, k, v, None)`` with per-head K/V."""
         cfg = self.config
         b, t, _ = h.shape
         nope, rope_d, v_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
@@ -180,10 +179,6 @@ class DeepseekV2Model(BaseModel):
         )  # single shared rope head
 
         if cfg.mla_cache_mode == "compressed":
-            # Cache the latent, not per-head K/V: per token only
-            # rank + rope_d numbers, independent of head count. kv_b is
-            # absorbed into the query (scores) and output (values) sides, so
-            # the math is identical to the decompressed path.
             w_b = p["kv_b_proj"].reshape(rank, -1, nope + v_d)
             w_bk, w_bv = w_b[..., :nope], w_b[..., nope:]
             q_lat = jnp.einsum(
@@ -191,30 +186,80 @@ class DeepseekV2Model(BaseModel):
             ).astype(h.dtype)
             q_cat = jnp.concatenate([q_lat, q_pe], axis=-1)  # (B,T,H,rank+rope)
             k_new = jnp.concatenate([latent[:, :, None, :], k_pe], axis=-1)
+            return q_cat, k_new, None, w_bv
+        kv = self._linear(latent, p["kv_b_proj"]).reshape(b, t, -1, nope + v_d)
+        k_nope, v = kv[..., :nope], kv[..., nope:]
+        k = jnp.concatenate(
+            [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], rope_d))],
+            axis=-1,
+        )
+        q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
+        return q_full, k, v, None
+
+    def _attention(self, h, p, k_buf, v_buf, offset, tp_axis=None):
+        """MLA under tensor parallelism: the low-rank latent path
+        (kv_a_proj / kv_a_norm and the single rope head) is REPLICATED —
+        it is head-count independent — while the per-head projections
+        (q/q_b, kv_b, o) shard over tp. Head counts derive from the
+        projection shard shapes, so this code runs the full model and any
+        tp slice unchanged; one psum after o_proj completes the row-parallel
+        output projection."""
+        cfg = self.config
+        b, t, _ = h.shape
+        rank = cfg.kv_lora_rank
+        q, k_new, v_new, w_bv = self._attn_qkv(p, h, offset)
+        if cfg.mla_cache_mode == "compressed":
+            # Cache the latent, not per-head K/V: per token only
+            # rank + rope_d numbers, independent of head count. kv_b is
+            # absorbed into the query (scores) and output (values) sides, so
+            # the math is identical to the decompressed path.
             dummy_v = jnp.zeros((b, t, 1, 1), v_buf.dtype)
             k_buf, v_buf = write_layer_kv(k_buf, v_buf, k_new, dummy_v, offset)
             # MQA over the single latent head; "values" are the latent slice
             # of the key buffer, so no second buffer is stored.
             out_lat = causal_attention(
-                q_cat, k_buf, k_buf[..., :rank], offset, self.scale
+                q, k_buf, k_buf[..., :rank], offset, self.scale
             )  # (B,T,H,rank)
             attn = jnp.einsum(
                 "bthr,rhv->bthv", out_lat, w_bv, preferred_element_type=jnp.float32
             ).astype(h.dtype)
         else:
-            kv = self._linear(latent, p["kv_b_proj"]).reshape(b, t, -1, nope + v_d)
-            k_nope, v = kv[..., :nope], kv[..., nope:]
-            k = jnp.concatenate(
-                [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], rope_d))],
-                axis=-1,
-            )
-            q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
-            k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
-            attn = causal_attention(q_full, k_buf, v_buf, offset, self.scale)
+            k_buf, v_buf = write_layer_kv(k_buf, v_buf, k_new, v_new, offset)
+            attn = causal_attention(q, k_buf, v_buf, offset, self.scale)
         attn_out = self._linear(attn.reshape(b, t, -1), p["o_proj"])
         if tp_axis is not None:
             attn_out = jax.lax.psum(attn_out, tp_axis)
         return h + attn_out, k_buf, v_buf
+
+    def sp_groups(self):
+        return list(self.layer_group_ranges().keys())
+
+    def sp_layer(self, p, h, offset, attn_fn, group=None):
+        """Sequence-parallel MLA layer. Compressed mode rides the injected
+        attention as MQA over the single latent head with ``values_from_k``
+        (the latent slice of the key rows serves as values — the same kv_b
+        absorption as _attention), so ring prefill and sharded-KV decode
+        both work on the compressed cache layout; the returned rows match
+        it (latent+rope keys, dummy values)."""
+        cfg = self.config
+        b, t, _ = h.shape
+        rank = cfg.kv_lora_rank
+        q, k_new, v_new, w_bv = self._attn_qkv(p, h, offset)
+        if cfg.mla_cache_mode == "compressed":
+            v_new = jnp.zeros((b, t, 1, 1), h.dtype)
+            out_lat = attn_fn(q, k_new, v_new, values_from_k=rank)
+            attn = jnp.einsum(
+                "bthr,rhv->bthv", out_lat, w_bv, preferred_element_type=jnp.float32
+            ).astype(h.dtype)
+        else:
+            attn = attn_fn(q, k_new, v_new)
+        h = h + self._linear(attn.reshape(b, t, -1), p["o_proj"])
+        r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
+        if group == "moe":
+            ff = self._moe_mlp(r.reshape(b * t, -1), p).reshape(b, t, -1)
+        else:
+            ff = self._swiglu(r, p["gate_proj"], p["up_proj"], p["down_proj"])
+        return h + ff, k_new, v_new
 
     def _swiglu(self, r, gate, up, down):
         return self._linear(
@@ -230,14 +275,11 @@ class DeepseekV2Model(BaseModel):
             ff = jax.lax.psum(ff, tp_axis)
         return h + ff, k_buf, v_buf
 
-    def _moe_layer(self, h, p, k_buf, v_buf, offset, tp_axis=None, ep_axis=None):
+    def _moe_mlp(self, flat, p, tp_axis=None, ep_axis=None):
+        """Routed + shared experts over (N, hidden) rows. Routing is
+        replicated over ep (router weights replicated, global expert ids);
+        only the expert stacks shard."""
         cfg = self.config
-        b, t, hidden = h.shape
-        h, k_buf, v_buf = self._attention(h, p, k_buf, v_buf, offset, tp_axis)
-        r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
-        flat = r.reshape(b * t, hidden)
-        # routing is replicated over ep (router weights replicated, global
-        # expert ids); only the expert stacks shard
         weights, idx = deepseek_routing(
             flat, p["router"], cfg.num_experts_per_tok,
             norm_topk_prob=cfg.norm_topk_prob,
@@ -259,14 +301,19 @@ class DeepseekV2Model(BaseModel):
             if ep_axis is None:
                 # experts shard their intermediate dim over tp: routed AND
                 # shared are both partial products — one combined psum
-                combined = jax.lax.psum(routed + shared, tp_axis)
-            else:
-                # tp x ep: expert stacks shard over ep (full after the ep
-                # psum inside apply_experts, replicated across tp); only the
-                # tp-sharded shared experts need the tp psum
-                combined = routed + jax.lax.psum(shared, tp_axis)
-        else:
-            combined = routed + shared
+                return jax.lax.psum(routed + shared, tp_axis)
+            # tp x ep: expert stacks shard over ep (full after the ep
+            # psum inside apply_experts, replicated across tp); only the
+            # tp-sharded shared experts need the tp psum
+            return routed + jax.lax.psum(shared, tp_axis)
+        return routed + shared
+
+    def _moe_layer(self, h, p, k_buf, v_buf, offset, tp_axis=None, ep_axis=None):
+        cfg = self.config
+        b, t, hidden = h.shape
+        h, k_buf, v_buf = self._attention(h, p, k_buf, v_buf, offset, tp_axis)
+        r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
+        combined = self._moe_mlp(r.reshape(b * t, hidden), p, tp_axis, ep_axis)
         return h + combined.reshape(b, t, hidden), k_buf, v_buf
 
     # ------------------------------------------------------------------

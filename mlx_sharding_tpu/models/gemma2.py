@@ -29,6 +29,7 @@ _GLOBAL_WINDOW = 1 << 30  # "no window" encoded as a huge traced window
 
 class Gemma2Model(BaseModel):
     supports_packed = True
+    supports_sp = True  # sp_layer below carries the window/softcap opts
 
     def __init__(self, config: Gemma2Config):
         super().__init__(config)
@@ -38,29 +39,33 @@ class Gemma2Model(BaseModel):
         self.scale = config.query_pre_attn_scalar**-0.5
 
     # ------------------------------------------------------------------
-    def _layer(self, h, p, k_buf, v_buf, offset, layer_idx, tp_axis=None):
+    def _window(self, layer_idx):
+        # sliding window on even layers, global on odd (HF Gemma-2 layout)
+        return jnp.where(
+            layer_idx % 2 == 0, self.config.sliding_window, _GLOBAL_WINDOW
+        )
+
+    def layer_attn_inputs(self, p, h, offset):
+        """Pre-attention half: zero-centered norm + QKV + RoPE. Head counts
+        derive from the projection shards, so the same code runs the full
+        model and any tp slice (heads split over tp)."""
         cfg = self.config
         b, t, _ = h.shape
         d = cfg.head_dim
-        eps = cfg.rms_norm_eps
-
-        # sliding window on even layers, global on odd (HF Gemma-2 layout)
-        window = jnp.where(layer_idx % 2 == 0, cfg.sliding_window, _GLOBAL_WINDOW)
-
-        # head counts derive from the projection shards, so the same code
-        # runs the full model and any tp slice (heads split over tp)
-        r = rms_norm(h, p["input_norm"], eps, offset=1.0)
+        r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps, offset=1.0)
         q = self._linear(r, p["q_proj"]).reshape(b, t, -1, d)
         k = self._linear(r, p["k_proj"]).reshape(b, t, -1, d)
         v = self._linear(r, p["v_proj"]).reshape(b, t, -1, d)
         q = apply_rope(q, self.inv_freq, offset)
         k = apply_rope(k, self.inv_freq, offset)
-        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
-        attn = causal_attention(
-            q, k_buf, v_buf, offset, self.scale,
-            logit_softcap=cfg.attn_logit_softcapping,
-            sliding_window=window,
-        )
+        return q, k, v
+
+    def layer_finish(self, p, h, attn, tp_axis=None):
+        """Post-attention half: O projection into the POST-attention norm
+        (sandwich norms), then GeGLU into the post-ffw norm."""
+        cfg = self.config
+        b, t, _ = h.shape
+        eps = cfg.rms_norm_eps
         attn_out = self._linear(attn.reshape(b, t, -1), p["o_proj"])
         if tp_axis is not None:
             # the post-attention norm is NONLINEAR: partial row-parallel
@@ -76,8 +81,32 @@ class Gemma2Model(BaseModel):
         )
         if tp_axis is not None:
             ff = jax.lax.psum(ff, tp_axis)
-        h = h + rms_norm(ff, p["post_ffw_norm"], eps, offset=1.0)
-        return h, k_buf, v_buf
+        return h + rms_norm(ff, p["post_ffw_norm"], eps, offset=1.0)
+
+    def sp_layer(self, p, h, offset, attn_fn, group=None):
+        """Sequence-parallel layer: the injected attention gets Gemma-2's
+        logit softcap and the layer's sliding/global window — the ring
+        backend skips K/V blocks entirely behind a window (VERDICT r4 #4:
+        window-aware ring block skipping)."""
+        cfg = self.config
+        q, k, v = self.layer_attn_inputs(p, h, offset)
+        attn = attn_fn(
+            q, k, v,
+            logit_softcap=cfg.attn_logit_softcapping,
+            sliding_window=self._window(p["layer_idx"]),
+        )
+        return self.layer_finish(p, h, attn), k, v
+
+    def _layer(self, h, p, k_buf, v_buf, offset, layer_idx, tp_axis=None):
+        cfg = self.config
+        q, k, v = self.layer_attn_inputs(p, h, offset)
+        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
+        attn = causal_attention(
+            q, k_buf, v_buf, offset, self.scale,
+            logit_softcap=cfg.attn_logit_softcapping,
+            sliding_window=self._window(layer_idx),
+        )
+        return self.layer_finish(p, h, attn, tp_axis), k_buf, v_buf
 
     def run_layers(self, layer_params, h, k, v, offset, mask=None, tp_axis=None):
         # The GLOBAL layer index travels inside the param stack

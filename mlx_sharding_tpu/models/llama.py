@@ -26,6 +26,9 @@ class LlamaModel(BaseModel):
     # decoder-layer projections may stay 4-bit packed in HBM
     # (loading.load_model(keep_quantized=True) → ops.quant.linear dispatch)
     supports_packed = True
+    # sequence-parallel paths use the default sp_layer over the
+    # layer_attn_inputs/layer_finish hook pair below
+    supports_sp = True
 
     def __init__(self, config: LlamaConfig):
         super().__init__(config)

@@ -40,7 +40,8 @@ def _block_update(scores, v_blk, o, m, l):
 
 
 def ring_attention_local(
-    q, k, v, scale: float, axis_name: str = AXIS_SP, block_k: int = 512
+    q, k, v, scale: float, axis_name: str = AXIS_SP, block_k: int = 512,
+    logit_softcap=None, sliding_window=None, values_from_k=None,
 ):
     """shard_map-level kernel: q/k/v are this device's (B, T_local, H, D)
     blocks of a sequence sharded over ``axis_name``. Causal, GQA-aware.
@@ -49,7 +50,21 @@ def ring_attention_local(
     Within each ring step the received K/V block is processed in ``block_k``
     sub-tiles through the same streaming-softmax update, so the live score
     tensor is (B, Hkv, G, T_local, block_k) — per-device activation memory
-    stays O(T_local * block_k), never O(T_local^2)."""
+    stays O(T_local * block_k), never O(T_local^2).
+
+    ``logit_softcap`` applies Gemma-2-style cap*tanh(s/cap) to the scores
+    (before masking — tanh of a masked -inf would be NaN); ``sliding_window``
+    (may be a traced per-layer scalar) restricts each query to the last W
+    positions. Ring steps whose whole K/V block is irrelevant — strictly in
+    the causal future, or entirely behind every query's window — skip their
+    block matmuls via lax.cond (the rotation still runs): the causal skip
+    alone halves the ring's compute, and a sliding window prunes most of the
+    rest for long sequences.
+
+    ``values_from_k`` (MLA's latent-as-values): attend values =
+    keys[..., :n]; ``v`` is ignored and only the key blocks rotate around
+    the ring — compressed MLA pays ~half the ICI bytes it would rotating a
+    redundant value copy."""
     import math
 
     b, t, hq, dk = q.shape
@@ -64,12 +79,17 @@ def ring_attention_local(
     bk = math.gcd(t, block_k)  # largest aligned sub-tile <= block_k
     nb = t // bk
 
-    o = jnp.zeros((b, hkv, groups, t, v.shape[-1]), jnp.float32)
+    dv = values_from_k if values_from_k is not None else v.shape[-1]
+    o = jnp.zeros((b, hkv, groups, t, dv), jnp.float32)
     m = jnp.full((b, hkv, groups, t), -jnp.inf, jnp.float32)
     l = jnp.zeros((b, hkv, groups, t), jnp.float32)
 
     def step(carry, j):
-        o, m, l, k_blk, v_blk = carry
+        if values_from_k is None:
+            o, m, l, k_blk, v_blk = carry
+        else:
+            o, m, l, k_blk = carry
+            v_blk = k_blk[..., :values_from_k]
         blk = (idx - j) % size
 
         # (B, T, H, D) -> (nb, B, bk, H, D) sub-tiles for the inner scan
@@ -83,22 +103,41 @@ def ring_attention_local(
             scores = jnp.einsum(
                 "bthgd,bkhd->bhgtk", qg, ks, preferred_element_type=jnp.float32
             ) * scale
+            if logit_softcap is not None:  # same gate as ops.attention
+                scores = logit_softcap * jnp.tanh(scores / logit_softcap)
             allowed = k_pos[None, :] <= q_pos[:, None]  # (T, bk) global causal
+            if sliding_window is not None:
+                allowed &= k_pos[None, :] > q_pos[:, None] - sliding_window
             scores = jnp.where(allowed[None, None, None], scores, -jnp.inf)
             return _block_update(scores, vs, o, m, l), None
 
-        (o, m, l), _ = jax.lax.scan(
-            sub, (o, m, l), (k_sub, v_sub, jnp.arange(nb))
-        )
+        def compute(oml):
+            out, _ = jax.lax.scan(sub, oml, (k_sub, v_sub, jnp.arange(nb)))
+            return out
+
+        # whole-block relevance: its oldest position vs the newest query
+        # (causal future) and its newest position vs the oldest query's
+        # window edge — a fully-masked block would contribute exactly
+        # nothing through the streaming update, so skipping is lossless
+        in_future = blk * t > idx * t + (t - 1)
+        relevant = ~in_future
+        if sliding_window is not None:
+            behind = (blk * t + t - 1) < (idx * t - sliding_window + 1)
+            relevant &= ~behind
+        o, m, l = jax.lax.cond(relevant, compute, lambda oml: oml, (o, m, l))
         k_next = jax.lax.ppermute(
             k_blk, axis_name, [(i, (i + 1) % size) for i in range(size)]
         )
+        if values_from_k is not None:
+            return (o, m, l, k_next), None
         v_next = jax.lax.ppermute(
             v_blk, axis_name, [(i, (i + 1) % size) for i in range(size)]
         )
         return (o, m, l, k_next, v_next), None
 
-    (o, m, l, _, _), _ = jax.lax.scan(step, (o, m, l, k, v), jnp.arange(size))
+    init = (o, m, l, k) if values_from_k is not None else (o, m, l, k, v)
+    outs, _ = jax.lax.scan(step, init, jnp.arange(size))
+    o, m, l = outs[0], outs[1], outs[2]
     o = o / jnp.maximum(l[..., None], 1e-30)
     # (B, Hkv, G, T, Dv) -> (B, T, Hq, Dv)
     return o.transpose(0, 3, 1, 2, 4).reshape(b, t, hq, -1).astype(q.dtype)

@@ -24,8 +24,10 @@ device runs the same projections/MLP redundantly; what's sharded is the KV
 is written only by the device whose shard owns position ``offset``.
 
 The reference has no analogue (its long-context story is a dense T×T mask,
-SURVEY §5); this is a capability beyond parity. Wired for the same model
-hooks as sp_prefill (layer_attn_inputs/layer_finish — Llama family).
+SURVEY §5); this is a capability beyond parity. Wired through the same
+model hooks as sp_prefill (``sp_layer``/``sp_groups``): Llama family,
+Gemma-2 (per-layer window/softcap) and DeepSeek-V2 MLA (compressed-latent
+MQA, values_from_k, grouped dense/moe scan).
 """
 
 from __future__ import annotations
@@ -40,11 +42,14 @@ from mlx_sharding_tpu.parallel.mesh import AXIS_SP
 from mlx_sharding_tpu.sample import sample_token, update_recent_tokens
 
 
-def sp_decode_attention(q, k_buf, v_buf, offset, scale, axis_name=AXIS_SP):
+def sp_decode_attention(q, k_buf, v_buf, offset, scale, axis_name=AXIS_SP,
+                        logit_softcap=None, sliding_window=None):
     """Distributed T=1..T attention: local partial softmax over this device's
     KV shard rows (global positions ``idx*cap + j``), merged exactly across
     ``axis_name``. q (B, T, Hq, Dk); k_buf/v_buf (B, cap_local, Hkv, D).
-    Validity: global position <= offset + (query index)."""
+    Validity: global position <= offset + (query index); ``sliding_window``
+    further restricts to the last W positions (Gemma-2), ``logit_softcap``
+    caps the scores before masking."""
     b, t, hq, dk = q.shape
     cap, hkv = k_buf.shape[1], k_buf.shape[2]
     groups = hq // hkv
@@ -54,9 +59,14 @@ def sp_decode_attention(q, k_buf, v_buf, offset, scale, axis_name=AXIS_SP):
     scores = jnp.einsum(
         "bthgd,bshd->bhgts", qg, k_buf, preferred_element_type=jnp.float32
     ) * scale
+    if logit_softcap is not None:  # same gate as ops.attention (bit parity)
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
     q_pos = offset + jnp.arange(t)[:, None]  # (T, 1) global
     k_pos = idx * cap + jnp.arange(cap)[None, :]  # (1, cap) global
-    scores = jnp.where((k_pos <= q_pos)[None, None, None], scores, -jnp.inf)
+    allowed = k_pos <= q_pos
+    if sliding_window is not None:
+        allowed &= k_pos > q_pos - sliding_window
+    scores = jnp.where(allowed[None, None, None], scores, -jnp.inf)
 
     m_loc = scores.max(axis=-1)  # (B, Hkv, G, T)
     m_glob = jax.lax.pmax(m_loc, axis_name)
@@ -118,12 +128,15 @@ class SpDecode:
                 f"sp={self.size} must divide the cache capacity {max_seq}"
             )
         cfg = self.model.config
-        shape = (
-            cfg.num_local_layers, batch, max_seq,
-            cfg.num_key_value_heads, cfg.head_dim,
-        )
+        # model-declared cache layout: per-tensor head dims (MLA's K dim ≠
+        # V dim) and head count (the compressed latent's single head)
+        hd = self.model.cache_head_dim()
+        k_dim, v_dim = (hd, hd) if not isinstance(hd, (tuple, list)) else hd
+        heads = self.model.cache_num_heads()
+        base = (cfg.num_local_layers, batch, max_seq, heads)
         return KVCache(
-            k=self._zeros(shape, dtype), v=self._zeros(shape, dtype),
+            k=self._zeros((*base, k_dim), dtype),
+            v=self._zeros((*base, v_dim), dtype),
             offset=jax.device_put(jnp.zeros((), jnp.int32), self._rep),
         )
 
@@ -150,24 +163,73 @@ class SpDecode:
                 cap = k_c.shape[2]
                 h = model.embed(params, tok[:, None])
 
-                def layer(h, p, k_buf, v_buf):
-                    q, k, v = model.layer_attn_inputs(p, h, offset)
-                    # owner-only write of the new row at global ``offset``
-                    local = offset - idx * cap
-                    in_range = (local >= 0) & (local < cap)
-                    lp = jnp.clip(local, 0, cap - 1)
-                    old_k = jax.lax.dynamic_slice_in_dim(k_buf, lp, 1, 1)
-                    old_v = jax.lax.dynamic_slice_in_dim(v_buf, lp, 1, 1)
-                    k_row = jnp.where(in_range, k.astype(k_buf.dtype), old_k)
-                    v_row = jnp.where(in_range, v.astype(v_buf.dtype), old_v)
-                    k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k_row, lp, 1)
-                    v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v_row, lp, 1)
-                    attn = sp_decode_attention(q, k_buf, v_buf, offset, model.scale)
-                    return model.layer_finish(p, h, attn), k_buf, v_buf
-
                 from mlx_sharding_tpu.models.base import scan_layers
 
-                h, k_c, v_c = scan_layers(layer, h, params["layers"], k_c, v_c)
+                def make_layer(g):
+                    def layer(h, p, k_buf, v_buf):
+                        # the injected attention owner-writes the new row at
+                        # global ``offset`` into this shard, then attends;
+                        # the updated buffers escape through ``done`` to
+                        # become the scan's cache ys
+                        done = {}
+
+                        def attn_fn(q, k_new, v_new, logit_softcap=None,
+                                    sliding_window=None, values_from_k=None):
+                            local = offset - idx * cap
+                            in_range = (local >= 0) & (local < cap)
+                            lp = jnp.clip(local, 0, cap - 1)
+                            old_k = jax.lax.dynamic_slice_in_dim(k_buf, lp, 1, 1)
+                            old_v = jax.lax.dynamic_slice_in_dim(v_buf, lp, 1, 1)
+                            k_row = jnp.where(
+                                in_range, k_new.astype(k_buf.dtype), old_k
+                            )
+                            v_row = jnp.where(
+                                in_range, v_new.astype(v_buf.dtype), old_v
+                            )
+                            kb = jax.lax.dynamic_update_slice_in_dim(
+                                k_buf, k_row, lp, 1
+                            )
+                            vb = jax.lax.dynamic_update_slice_in_dim(
+                                v_buf, v_row, lp, 1
+                            )
+                            done["k"], done["v"] = kb, vb
+                            vv = (
+                                kb[..., :values_from_k]
+                                if values_from_k is not None else vb
+                            )
+                            return sp_decode_attention(
+                                q, kb, vv, offset, model.scale,
+                                logit_softcap=logit_softcap,
+                                sliding_window=sliding_window,
+                            )
+
+                        h2, _, _ = model.sp_layer(p, h, offset, attn_fn, group=g)
+                        return h2, done["k"], done["v"]
+
+                    return layer
+
+                # per-group scans over the stacked layer sub-trees, the
+                # cache buffers sliced to each group's layer range
+                lo = 0
+                k_parts, v_parts = [], []
+                for g in model.sp_groups():
+                    stack = params["layers"] if g is None else params["layers"][g]
+                    n_g = jax.tree.leaves(stack)[0].shape[0]
+                    h, k_g, v_g = scan_layers(
+                        make_layer(g), h, stack,
+                        k_c[lo : lo + n_g], v_c[lo : lo + n_g],
+                    )
+                    k_parts.append(k_g)
+                    v_parts.append(v_g)
+                    lo += n_g
+                k_c = (
+                    jnp.concatenate(k_parts, axis=0)
+                    if len(k_parts) > 1 else k_parts[0]
+                )
+                v_c = (
+                    jnp.concatenate(v_parts, axis=0)
+                    if len(v_parts) > 1 else v_parts[0]
+                )
                 logits = model.apply_head(params, h)
                 key, sub = jax.random.split(key)
                 tok, logprobs = sample_token(sub, logits[:, -1], sp, recent)

@@ -18,8 +18,11 @@ which removes the single-chip KV bound entirely. Contract: bit-compatible
 logits with the dense prefill (tested sp=4 vs sp=1 in
 tests/test_sp_prefill.py; decode parity in tests/test_sp_decode.py).
 
-Currently wired for the Llama family (layer_attn_inputs/layer_finish
-hooks); other architectures keep the chunked path.
+Wired through the model-level ``sp_layer``/``sp_groups`` hooks: the Llama
+family (default hook pair), Gemma-2 (per-layer sliding/global windows +
+logit softcap, window-aware ring block skipping) and DeepSeek-V2 (MLA —
+compressed-latent MQA with values_from_k, grouped dense/moe scan).
+Architectures without ``supports_sp`` keep the chunked path.
 """
 
 from __future__ import annotations
@@ -37,11 +40,28 @@ from mlx_sharding_tpu.parallel.ring_attention import ring_attention_local
 def supports_sp_prefill(model) -> bool:
     cfg = model.config
     return (
-        hasattr(model, "layer_attn_inputs")
-        and hasattr(model, "layer_finish")
+        getattr(model, "supports_sp", False)
         and cfg.is_first_stage
         and cfg.is_last_stage  # needs embed + head in-params
     )
+
+
+def sp_ring_attn_fn(model):
+    """The prefill-side attention injected into ``model.sp_layer``: exact
+    ring attention over the sp axis, honoring the model's per-layer options
+    (Gemma-2 softcap/window; MLA's values-live-in-keys)."""
+
+    def attn_fn(q, k, v, logit_softcap=None, sliding_window=None,
+                values_from_k=None):
+        # values_from_k passes straight through: the ring then rotates ONLY
+        # the key blocks and slices values per step (half the ICI bytes)
+        return ring_attention_local(
+            q, k, v, model.scale,
+            logit_softcap=logit_softcap, sliding_window=sliding_window,
+            values_from_k=values_from_k,
+        )
+
+    return attn_fn
 
 
 def build_sp_prefill(model, mesh: Mesh, gather: bool = True):
@@ -54,6 +74,8 @@ def build_sp_prefill(model, mesh: Mesh, gather: bool = True):
     overwrites/never attends).
     """
 
+    attn_fn = sp_ring_attn_fn(model)
+
     def body(params, tokens, n_valid):
         idx = jax.lax.axis_index(AXIS_SP)
         t_local = tokens.shape[1]
@@ -61,12 +83,28 @@ def build_sp_prefill(model, mesh: Mesh, gather: bool = True):
 
         h = model.embed(params, tokens)
 
-        def layer_body(h, p):
-            q, k, v = model.layer_attn_inputs(p, h, offset)
-            attn = ring_attention_local(q, k, v, model.scale)
-            return model.layer_finish(p, h, attn), (k, v)
+        # one scan per structurally distinct layer group (DeepSeek's
+        # dense/moe split; [None] = the whole homogeneous stack), cache
+        # rows concatenated back in layer order
+        ks_groups, vs_groups = [], []
+        for g in model.sp_groups():
+            stack = params["layers"] if g is None else params["layers"][g]
 
-        h, (ks, vs) = jax.lax.scan(layer_body, h, params["layers"])
+            def layer_body(h, p, _g=g):
+                h, k, v = model.sp_layer(p, h, offset, attn_fn, group=_g)
+                return h, (k, v)
+
+            h, (ks, vs) = jax.lax.scan(layer_body, h, stack)
+            ks_groups.append(ks)
+            vs_groups.append(vs)
+        ks = (
+            jnp.concatenate(ks_groups, axis=0)
+            if len(ks_groups) > 1 else ks_groups[0]
+        )
+        vs = (
+            jnp.concatenate(vs_groups, axis=0)
+            if len(vs_groups) > 1 else vs_groups[0]
+        )
 
         # last REAL position lives on device (n_valid-1) // t_local
         local_last = jnp.clip(n_valid - 1 - offset, 0, t_local - 1)

@@ -109,17 +109,19 @@ def test_short_prompt_stays_on_chunked_path(model_and_params):
 
 
 def test_unsupported_arch_raises():
-    from mlx_sharding_tpu.config import DeepseekV2Config
-    from mlx_sharding_tpu.models.deepseek_v2 import DeepseekV2Model
+    """Mixtral has no sp wiring (supports_sp False) — the Generator must
+    reject sp_mesh up front rather than fail inside the program. (DeepSeek
+    and Gemma-2 used to be the unsupported examples; their sp hooks landed
+    in round 5 — see test_sp_prefill_archs.py.)"""
+    from mlx_sharding_tpu.config import MixtralConfig
+    from mlx_sharding_tpu.models.mixtral import MixtralModel
 
-    model = DeepseekV2Model(
-        DeepseekV2Config(
-            vocab_size=64, hidden_size=32, intermediate_size=64,
-            moe_intermediate_size=16, num_hidden_layers=2,
-            num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=16,
-            q_lora_rank=None, qk_rope_head_dim=8, qk_nope_head_dim=16,
-            v_head_dim=12, n_routed_experts=4, n_shared_experts=1,
-            num_experts_per_tok=2, first_k_dense_replace=1,
+    model = MixtralModel(
+        MixtralConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2,
         )
     )
     assert not supports_sp_prefill(model)
