@@ -87,7 +87,11 @@ def _probe_backend_with_retries() -> bool:
     has twice cost a round its real-chip record. Budget defaults to 15 min
     of once-a-minute probes; override with MST_BENCH_PROBE_BUDGET_S (0 =
     single probe, for tests/CI)."""
-    budget = float(os.environ.get("MST_BENCH_PROBE_BUDGET_S", "900"))
+    try:
+        budget = float(os.environ.get("MST_BENCH_PROBE_BUDGET_S", "900"))
+    except ValueError:
+        log("bad MST_BENCH_PROBE_BUDGET_S; using the 900s default")
+        budget = 900.0
     deadline = time.monotonic() + budget
     attempt = 0
     while True:
@@ -120,6 +124,14 @@ def _git_commit() -> str:
         return "unknown"
 
 
+def _is_real_chip_detail(detail: dict) -> bool:
+    """One predicate for 'this detail file came from a real TPU run' —
+    shared by the carry-forward reader and the clobber guard, so a device
+    repr change can never split their verdicts (and case-insensitive, so
+    'TpuDevice'-style reprs still count)."""
+    return "TPU" in str(detail.get("device", "")).upper()
+
+
 def _last_good_real_chip() -> dict | None:
     """The last committed real-chip BENCH_DETAIL.json, if any — the
     provenance block the fallback path attaches so a wedged tunnel at
@@ -129,7 +141,7 @@ def _last_good_real_chip() -> dict | None:
             detail = json.load(f)
     except (OSError, ValueError):
         return None
-    if "TPU" not in str(detail.get("device", "")).upper():
+    if not _is_real_chip_detail(detail):
         return None
     primary = detail.get("decode_bf16") or {}
     if not primary.get("decode_tps"):
@@ -550,7 +562,7 @@ def main() -> int:
     if cpu_fallback and os.path.exists(DETAIL_PATH):
         try:
             with open(DETAIL_PATH) as f:
-                if "TPU" in json.load(f).get("device", ""):
+                if _is_real_chip_detail(json.load(f)):
                     # never clobber real-chip evidence with a fallback run —
                     # the tunnel wedges intermittently (BASELINE.md)
                     detail_path = DETAIL_PATH.replace(".json", "_CPU.json")

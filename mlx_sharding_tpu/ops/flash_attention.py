@@ -12,6 +12,20 @@ Scope: standard causal GQA attention (Llama/Mistral/Qwen2/Mixtral/DeepSeek).
 Gemma-2's softcap + sliding-window layers stay on the XLA path. K/V arrive
 as the full-capacity cache buffers; blocks entirely in the future of the
 query tile are skipped without compute.
+
+Real-chip status (BENCH_DETAIL.json kernels block, v5e): per-kernel timing
+through the axon tunnel is NOISY — the committed record shows flash prefill
+880.4 µs vs fused-XLA 338.3 µs and T=1 decode 951.5 vs 310.0 µs at the
+bench shapes, while earlier runs of the same A/B showed the opposite
+(397 vs 744 µs). Two consequences: (1) T=1 decode stays OFF by default
+(the end-to-end A/B was also a loss: 97.6 vs 101.6 tok/s —
+MST_FLASH_DECODE=1 to opt in); (2) prefill dispatch is decided by the
+END-TO-END prompt-tps/TTFT A/B bench.py runs (decode_bf16_no_flash_prefill),
+not the noisy per-kernel numbers. The adaptive VMEM-budget q-tile below
+(round 5) attacks the same per-program-overhead failure mode the fixed
+128-tile dequant-matmul had before its picker (8x off roofline —
+ops/quant_matmul.py): every query tile of a head re-streams the whole
+(S, Dk+Dv) K/V row, so fewer/larger tiles amortize it.
 """
 
 from __future__ import annotations
@@ -26,6 +40,36 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+
+# Per-program VMEM budget for the adaptive q-tile picker (same sizing
+# rationale as ops/quant_matmul.py's _VMEM_BUDGET_BYTES: leave headroom in
+# the ~16MB VMEM for double-buffering and the compiler's own scratch).
+_VMEM_BUDGET_BYTES = 6 * 1024 * 1024
+
+
+def pick_block_q(t: int, s: int, dk: int, dv: int, itemsize: int,
+                 block_k: int = DEFAULT_BLOCK_K) -> int:
+    """Largest 128-multiple divisor of T (or T itself, when it fits) whose
+    per-program working set stays inside the VMEM budget. The whole-S K/V
+    row is a FIXED per-program cost re-paid by every query tile of a head;
+    growing the tile divides that cost across more queries — the lever that
+    took quant_matmul from 8x off its roofline to a 2.2x win."""
+    fixed = s * (dk + dv) * itemsize  # K/V rows resident per program
+    # per query row: q/o tile bytes, fp32 softmax state (m, l, acc), and the
+    # kernel's two (block_q, block_k) fp32 intermediates (scores s, probs p)
+    per_q = (
+        (dk + dv) * itemsize + (dv + 2 + dk) * 4 + 2 * block_k * 4
+    )
+    limit = max((_VMEM_BUDGET_BYTES - fixed) // per_q, 128)
+    if t <= limit:
+        return t
+    best = None
+    d = 128
+    while d <= limit:
+        if t % d == 0:
+            best = d
+        d += 128
+    return best if best is not None else min(t, DEFAULT_BLOCK_Q)
 
 
 def _kernel(off_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k, s_len):
@@ -87,18 +131,21 @@ def flash_attention(
     offset: jax.Array,  # scalar int32
     scale: float,
     *,
-    block_q: int = DEFAULT_BLOCK_Q,
+    block_q: int | None = None,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
     """Drop-in for ops.attention.causal_attention on the standard causal/GQA
     case. T must divide block_q*n and S must divide block_k*n (the callers'
-    chunked-prefill invariants guarantee this for multiples of 128)."""
+    chunked-prefill invariants guarantee this for multiples of 128).
+    ``block_q=None`` → the adaptive VMEM-budget picker."""
     b, t, hq, dk = q.shape
     s, hkv, dv = k.shape[1], k.shape[2], v.shape[-1]
     groups = hq // hkv
-    block_q = min(block_q, t)
     block_k = min(block_k, s)
+    if block_q is None:
+        block_q = pick_block_q(t, s, dk, dv, q.dtype.itemsize, block_k)
+    block_q = min(block_q, t)
     if t % block_q or s % block_k:
         raise ValueError(f"T={t} and S={s} must be multiples of the block sizes")
 

@@ -28,6 +28,8 @@ serde code, no message framing.
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Optional
 
 import jax
@@ -38,6 +40,13 @@ from mlx_sharding_tpu.sample import (
     init_recent_tokens,
     make_sampler_params,
 )
+
+
+class WorkerTimeoutError(RuntimeError):
+    """A control-plane collective did not complete in time — a peer rank is
+    dead or wedged. The plane is marked down: every later exchange fails
+    fast instead of stranding another thread in the collective, so rank 0
+    keeps answering (5xx + degraded /health) and can be restarted."""
 
 # control ops
 OP_IDLE = 0
@@ -104,12 +113,40 @@ def _unpack_bias(bias_idx, bias_val, n_bias: int):
 
 class ControlPlane:
     """Fixed-shape broadcast buffers; rank 0 publishes, all ranks receive the
-    same pytree (broadcast_one_to_all ignores non-zero ranks' inputs)."""
+    same pytree (broadcast_one_to_all ignores non-zero ranks' inputs).
+
+    Liveness (rank 0 only): a collective completes only when EVERY rank
+    arrives, so a SIGKILLed worker would block rank 0 in the broadcast
+    forever, invisible to /health. Rank 0 therefore runs each exchange on a
+    dedicated thread and bounds the wait (``MST_MULTIHOST_TIMEOUT_S``,
+    default 600s — generous enough for a worker's slowest compile between
+    two exchanges; 0 disables). On timeout the plane is marked ``dead``:
+    the in-flight request errors to its client, later exchanges fail fast,
+    and /health flips to degraded. Workers keep unbounded waits — an idle
+    deployment broadcasts nothing, and their liveness is rank 0's concern."""
 
     header_size = 8
 
-    def __init__(self, max_prompt: int):
+    def __init__(self, max_prompt: int, timeout_s: Optional[float] = None):
         self.max_prompt = max_prompt
+        if timeout_s is None:
+            try:
+                timeout_s = float(os.environ.get("MST_MULTIHOST_TIMEOUT_S", "600"))
+            except ValueError:
+                timeout_s = 600.0
+        if jax.process_index() != 0 or timeout_s <= 0:
+            timeout_s = None  # workers (and 0 = disabled) wait unbounded
+        self.timeout_s = timeout_s
+        self.dead = False
+        self.last_ok: Optional[float] = None  # monotonic stamp of the last
+        # completed collective — proof every rank was alive at that moment
+        self._thread = None  # lazy daemon worker (timed exchanges only)
+
+    @staticmethod
+    def _broadcast(buf):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(buf)
 
     def _zeros(self):
         return {
@@ -122,15 +159,66 @@ class ControlPlane:
 
     def exchange(self, msg: Optional[dict] = None) -> dict:
         """Collective: rank 0 passes ``msg`` (padded in), workers pass None.
-        Everyone gets rank 0's message back as host numpy."""
-        from jax.experimental import multihost_utils
-
+        Everyone gets rank 0's message back as host numpy. Raises
+        :class:`WorkerTimeoutError` (rank 0) when a peer doesn't show up
+        within the liveness budget, and instantly once the plane is dead."""
         buf = self._zeros()
         if msg is not None:
             for k, v in msg.items():
                 arr = np.asarray(v).reshape(-1)
                 buf[k][: arr.size] = arr
-        out = multihost_utils.broadcast_one_to_all(buf)
+        if self.timeout_s is None:
+            out = self._broadcast(buf)
+        else:
+            if self.dead:
+                raise WorkerTimeoutError(
+                    "multi-host control plane is down (a peer rank "
+                    "previously failed to respond) — restart the deployment"
+                )
+            import queue as _q
+
+            if self._thread is None:
+                # one DAEMON thread issuing collectives in program order: a
+                # timed-out broadcast stays blocked in it forever, and a
+                # daemon can be abandoned at interpreter exit — a
+                # ThreadPoolExecutor worker would be joined by the
+                # concurrent.futures atexit hook and wedge process shutdown
+                self._work: _q.Queue = _q.Queue()
+                self._out: _q.Queue = _q.Queue()
+
+                def run():
+                    while True:
+                        b = self._work.get()
+                        try:
+                            self._out.put(("ok", self._broadcast(b)))
+                        except BaseException as e:  # noqa: BLE001
+                            self._out.put(("err", e))
+
+                import threading
+
+                self._thread = threading.Thread(
+                    target=run, name="mst-ctrl", daemon=True
+                )
+                self._thread.start()
+            self._work.put(buf)
+            try:
+                kind, val = self._out.get(timeout=self.timeout_s)
+            except _q.Empty:
+                self.dead = True  # the broadcast thread stays stuck in the
+                # collective; being a daemon, it is abandoned, never joined
+                raise WorkerTimeoutError(
+                    f"multi-host collective did not complete within "
+                    f"{self.timeout_s:.0f}s — a worker rank is dead or "
+                    "wedged; failing the request and marking the control "
+                    "plane down (restart the deployment)"
+                ) from None
+            if kind == "err":
+                # the distributed runtime itself noticed the dead peer and
+                # errored the collective — same conclusion, better latency
+                self.dead = True
+                raise val
+            out = val
+        self.last_ok = time.monotonic()
         return {k: np.asarray(v) for k, v in out.items()}
 
 
@@ -274,13 +362,21 @@ class MultiHostPipeline:
                 state = _decode_step(self.engine, state)
         finally:
             # exactly one STOP per request, whether it ran to max_tokens or
-            # the consumer closed early (stop sequence / disconnect)
-            self.ctrl.exchange(
-                {"header": np.asarray([OP_STOP_REQUEST], np.int32)}
-            )
+            # the consumer closed early (stop sequence / disconnect). A dead
+            # control plane (worker timeout mid-request) must not let this
+            # raise over the original error — there is no one left to resync.
+            try:
+                self.ctrl.exchange(
+                    {"header": np.asarray([OP_STOP_REQUEST], np.int32)}
+                )
+            except WorkerTimeoutError:
+                pass
 
     def shutdown(self):
-        self.ctrl.exchange({"header": np.asarray([OP_SHUTDOWN], np.int32)})
+        try:
+            self.ctrl.exchange({"header": np.asarray([OP_SHUTDOWN], np.int32)})
+        except WorkerTimeoutError:
+            pass  # nobody is listening; the plane is already down
 
     close = shutdown
 
@@ -450,7 +546,10 @@ def _make_multihost_batcher():
             if not self._shut:
                 self._shut = True  # workers exit on the first SHUTDOWN; a
                 # second broadcast would hang awaiting departed peers
-                self._bcast(OP_SHUTDOWN)
+                try:
+                    self._bcast(OP_SHUTDOWN)
+                except WorkerTimeoutError:
+                    pass  # plane already down; nothing to shut down
 
         shutdown = close
 

@@ -420,7 +420,25 @@ class APIHandler(BaseHTTPRequestHandler):
         if path in ("/", "/index.html"):
             path = "/index.html"
         elif path == "/health":
-            return self._json(200, {"status": "ok"})
+            # multi-host deployments surface control-plane liveness: every
+            # completed collective proves all ranks were alive at that
+            # moment; a timed-out one marks the plane dead (multihost.py
+            # ControlPlane) and health goes degraded with a 503
+            ctrl = getattr(self.provider.generator, "ctrl", None)
+            if ctrl is None:
+                return self._json(200, {"status": "ok"})
+            import time as _time
+
+            last = getattr(ctrl, "last_ok", None)
+            mh = {
+                "workers_responsive": not getattr(ctrl, "dead", False),
+                "last_exchange_s_ago": (
+                    None if last is None else round(_time.monotonic() - last, 1)
+                ),
+            }
+            if getattr(ctrl, "dead", False):
+                return self._json(503, {"status": "degraded", "multihost": mh})
+            return self._json(200, {"status": "ok", "multihost": mh})
         elif path == "/metrics":
             body = self.metrics.render().encode()
             self.send_response(200)

@@ -80,7 +80,10 @@ def ckpt(tmp_path_factory):
     return str(make_tiny_checkpoint(tmp_path_factory.mktemp("mh_ckpt")))
 
 
-def _spawn_server(ckpt, port, extra, n_local_devices, log):
+def _spawn_server(ckpt, port, extra, n_local_devices, log, env_extra=None):
+    env = _env(n_local_devices)
+    if env_extra:
+        env.update(env_extra)
     return subprocess.Popen(
         [
             sys.executable, "-m", "mlx_sharding_tpu.server.openai_api",
@@ -88,9 +91,79 @@ def _spawn_server(ckpt, port, extra, n_local_devices, log):
             "--num-stages", "4", "--max-seq", "128", "--prefill-chunk", "16",
             *extra,
         ],
-        env=_env(n_local_devices), cwd=str(REPO),
+        env=env, cwd=str(REPO),
         stdout=log, stderr=subprocess.STDOUT,
     )
+
+
+@pytest.mark.quick
+def test_worker_death_fails_cleanly_not_hang(ckpt, tmp_path):
+    """SIGKILL rank 1 of a live 2-process deployment (VERDICT r4 ask #5):
+    the in-flight/next request must get a structured 5xx within the
+    liveness budget — NOT hang rank 0 in the broadcast collective forever —
+    /health must flip to degraded (503, workers_responsive false), and
+    later requests must fail fast off the dead-plane flag. Rank 0 stays
+    alive throughout: the driver is restartable, not wedged.
+
+    (Also the quick tier's one cross-process protocol case — VERDICT r4
+    ask #8: it exercises deployment, the broadcast control plane, a full
+    request, and the failure path in a single 2-process spawn.)"""
+    port0 = _free_port()
+    coord = f"localhost:{_free_port()}"
+    mh = ["--coordinator", coord, "--num-processes", "2"]
+    env_extra = {"MST_MULTIHOST_TIMEOUT_S": "60"}
+    log_r0 = open(tmp_path / "rank0.log", "w")
+    log_r1 = open(tmp_path / "rank1.log", "w")
+    r0 = _spawn_server(
+        ckpt, port0, [*mh, "--process-id", "0"], 2, log_r0, env_extra
+    )
+    r1 = _spawn_server(
+        ckpt, _free_port(), [*mh, "--process-id", "1"], 2, log_r1, env_extra
+    )
+    try:
+        _wait_health(port0, [r0, r1])
+        # one good request first: programs compiled, protocol healthy
+        status, ok = _post_completion(
+            port0, {"prompt": "the quick", "max_tokens": 4, "seed": 3}
+        )
+        assert status == 200 and isinstance(ok["choices"][0]["text"], str)
+
+        r1.kill()  # SIGKILL: no cleanup, no goodbye
+        r1.wait(timeout=10)
+
+        status, err = _post_completion(
+            port0, {"prompt": "hello", "max_tokens": 4}, timeout=240
+        )
+        assert status >= 500
+        assert "error" in err
+
+        # /health degrades instead of lying
+        conn = http.client.HTTPConnection("127.0.0.1", port0, timeout=10)
+        conn.request("GET", "/health")
+        resp = conn.getresponse()
+        health = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 503
+        assert health["status"] == "degraded"
+        assert health["multihost"]["workers_responsive"] is False
+
+        # later requests fail FAST off the dead flag (no fresh 60s wait)
+        t0 = time.time()
+        status2, err2 = _post_completion(
+            port0, {"prompt": "again", "max_tokens": 4}, timeout=60
+        )
+        assert status2 >= 500 and "error" in err2
+        assert time.time() - t0 < 30
+        assert r0.poll() is None  # the driver never wedged or died
+    finally:
+        for p in (r0, r1):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in (r0, r1):
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 def test_two_process_serving_matches_single_process(ckpt, tmp_path):
