@@ -268,6 +268,64 @@ def measure_cb(model, params, prompt, label: str, slots: int = 4) -> dict:
     return res
 
 
+def measure_cb_prefix(model, params, label: str) -> dict:
+    """Prefix-cache value measurement (VERDICT r4 weak #6): requests share a
+    512-token system prompt; after the first registers its pages, later
+    admissions map them read-only and prefill only the suffix. Reports the
+    hit rate and the cold-vs-warm TTFT delta at identical prompt lengths —
+    the delta's existence is the feature's value; its size scales with the
+    shared head (here 4 of 5 prefill chunks skipped)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=1), microbatches=2,
+        max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+        pool_pages=24, page_size=128,
+    )
+    batcher = ContinuousBatcher(eng, decode_block=8, prefix_cache=True)
+    try:
+        t0 = time.perf_counter()
+        for _ in batcher.generate_step(list(range(1, 100)), max_tokens=4):
+            pass
+        log(f"[{label}] warmup (incl. compiles) {time.perf_counter() - t0:.1f}s")
+
+        vocab = model.config.vocab_size
+        rng = np.random.default_rng(0)
+        sys_p = [int(x) for x in rng.integers(1, vocab - 64, 512)]
+
+        def ttft_ms(suffix_tok: int) -> float:
+            t0 = time.perf_counter()
+            first = None
+            for _tok, _ in batcher.generate_step(
+                sys_p + [suffix_tok], max_tokens=16
+            ):
+                if first is None:
+                    first = time.perf_counter() - t0
+            return first * 1e3
+
+        cold = ttft_ms(vocab - 2)  # registers the 4 full system-prompt pages
+        warms = sorted(ttft_ms(vocab - 3 - i) for i in range(3))
+        q, h, reused, _, _ = batcher.prefix_stats()
+    finally:
+        batcher.close()
+    res = dict(
+        label=label, ttft_cold_ms=round(cold, 1),
+        ttft_warm_ms=round(warms[1], 1),  # median of 3 prefix-hit requests
+        ttft_speedup=round(cold / max(warms[1], 1e-6), 2),
+        prefix_queries=q, prefix_hits=h, tokens_reused=reused,
+    )
+    log(f"[{label}] TTFT cold={res['ttft_cold_ms']}ms "
+        f"warm={res['ttft_warm_ms']}ms ({res['ttft_speedup']}x) "
+        f"hits={h}/{q} reused={reused} tokens")
+    return res
+
+
 def kernel_smoke(detail: dict) -> None:
     """Compile (for real) + numerically cross-check both Pallas kernels
     against the XLA paths they replace, and time them."""
@@ -368,7 +426,32 @@ def kernel_smoke(detail: dict) -> None:
 
 
 def main() -> int:
-    cpu_fallback = not _probe_backend_with_retries()
+    forced_cpu = os.environ.get("MST_BENCH_FORCED_CPU") == "1"
+    cpu_fallback = forced_cpu or not _probe_backend_with_retries()
+    if cpu_fallback and not forced_cpu:
+        # A wedged axon plugin can hang even a JAX_PLATFORMS=cpu process at
+        # backend discovery (observed round 5: jax.devices() blocked with
+        # the plugin merely ON PYTHONPATH) — re-exec the fallback with the
+        # plugin's site stripped so it cannot inherit the wedge, skipping
+        # the probe in the child.
+        log("no usable TPU backend — re-exec'ing the CPU fallback with the "
+            "axon site stripped from PYTHONPATH")
+        env = dict(os.environ)
+        env["MST_BENCH_FORCED_CPU"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        keep = [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and not any("axon_site" in seg for seg in p.split(os.sep))
+        ]
+        repo = os.path.dirname(os.path.abspath(__file__))
+        if repo not in keep:
+            keep.append(repo)
+        env["PYTHONPATH"] = os.pathsep.join(keep)
+        os.execve(
+            sys.executable,
+            [sys.executable, os.path.abspath(__file__)],
+            env,
+        )
     if cpu_fallback:
         # The axon tunnel can be down for reasons outside this repo; a
         # clearly-labeled CPU number beats a hung or absent benchmark.
@@ -557,6 +640,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["decode_bf16_cb4"] = dict(error=repr(e)[:300])
             log(f"[decode_bf16_cb4] FAILED: {e!r}")
+        gc.collect()
+        try:
+            detail["cb_prefix_cache"] = measure_cb_prefix(
+                model, params, "cb_prefix_cache"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["cb_prefix_cache"] = dict(error=repr(e)[:300])
+            log(f"[cb_prefix_cache] FAILED: {e!r}")
 
     detail_path = DETAIL_PATH
     if cpu_fallback and os.path.exists(DETAIL_PATH):

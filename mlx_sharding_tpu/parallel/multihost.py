@@ -214,9 +214,16 @@ class ControlPlane:
                 ) from None
             if kind == "err":
                 # the distributed runtime itself noticed the dead peer and
-                # errored the collective — same conclusion, better latency
+                # errored the collective — same conclusion, better latency.
+                # Normalized to WorkerTimeoutError (cause chained) so every
+                # dead-plane swallow site (STOP / SHUTDOWN / batcher close)
+                # behaves identically on both detection paths.
                 self.dead = True
-                raise val
+                raise WorkerTimeoutError(
+                    "multi-host collective failed — the distributed runtime "
+                    "reported a dead or unreachable peer rank; marking the "
+                    "control plane down (restart the deployment)"
+                ) from val
             out = val
         self.last_ok = time.monotonic()
         return {k: np.asarray(v) for k, v in out.items()}
@@ -562,10 +569,18 @@ def make_multihost_batcher(engine, **kw):
 
 
 def serve_worker_batched(engine, *, decode_block: int = 8,
-                         repetition_window: int = 64) -> None:
+                         repetition_window: int = 64,
+                         prefix_cache: bool = False) -> None:
     """Rank>0 loop for multi-host continuous batching: apply rank 0's op
-    stream to a mirror ContinuousBatcher. ``decode_block`` must match
-    rank 0's (it sets the scanned block program length).
+    stream to a mirror ContinuousBatcher. ``decode_block`` and
+    ``prefix_cache`` must match rank 0's (the block sets the scanned
+    program length; the cache changes the page-allocation sequence).
+
+    Prefix caching mirrors deterministically: every index mutation lives
+    inside a mirrored op — registration in OP_B_PREFILL, eviction +
+    move-to-end during OP_B_ASSIGN, releases in the counted max_tokens
+    finishes and OP_B_CANCEL — and rank 0's _fits polls are read-only, so
+    identical op streams yield identical page tables on every rank.
 
     Failure discipline matches :func:`serve_worker`: device-op failures are
     deterministic, so rank 0 hits the same error, fails its consumers and
@@ -577,7 +592,8 @@ def serve_worker_batched(engine, *, decode_block: int = 8,
 
     logger = logging.getLogger(__name__)
     batcher = ContinuousBatcher(
-        engine, decode_block=decode_block, repetition_window=repetition_window
+        engine, decode_block=decode_block,
+        repetition_window=repetition_window, prefix_cache=prefix_cache,
     )
     ctrl = BatchControlPlane(max_prompt=engine.max_seq)
     while True:

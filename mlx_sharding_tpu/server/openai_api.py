@@ -322,6 +322,8 @@ class ModelProvider:
                                 generator,
                                 decode_block=min(8, self.decode_block),
                                 policy=self.admission_policy,
+                                prefix_cache=self.prompt_cache
+                                and self.paged_pool is not None,
                             )
                         else:
                             from mlx_sharding_tpu.parallel.multihost import (
@@ -953,7 +955,10 @@ def main(argv=None):
                              "O(new tokens)). Single-chip generator path, or "
                              "with --concurrent --paged-pool: content-"
                              "addressed page sharing across interleaved "
-                             "requests")
+                             "requests (composes with --coordinator — the "
+                             "worker mirrors rebuild the same index from the "
+                             "op stream — and with --replicas, one cache per "
+                             "replica)")
     parser.add_argument("--decode-block", type=int, default=16,
                         help="decode steps fused per program launch (token "
                              "pulls amortize over this many tokens; set 1 "
@@ -1033,18 +1038,15 @@ def main(argv=None):
                      "generator path or to --concurrent --paged-pool serving "
                      "(no --coordinator/--tp/--ep/stage, layer-range, or "
                      "--draft-model flags)")
-    if args.prompt_cache and args.concurrent > 1 and args.coordinator:
-        parser.error("--prompt-cache is not supported in multi-host serving")
     if args.replicas > 1 and (
         args.coordinator or args.engine == "chained"
-        or args.prompt_cache
+        or (args.prompt_cache and args.concurrent <= 1)
         or (args.draft_model and args.concurrent <= 1)
         or args.start_layer is not None or args.end_layer is not None
     ):
         parser.error("--replicas requires the fused full-model engine path "
-                     "(no --coordinator/--engine chained/--prompt-cache/"
-                     "layer-range flags; --draft-model only with "
-                     "--concurrent)")
+                     "(no --coordinator/--engine chained/layer-range flags; "
+                     "--prompt-cache/--draft-model only with --concurrent)")
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
     if args.paged_pool and args.engine == "chained":
@@ -1090,6 +1092,8 @@ def main(argv=None):
                 serve_worker_batched(
                     provider.generator,
                     decode_block=min(8, args.decode_block),
+                    prefix_cache=args.prompt_cache
+                    and args.paged_pool is not None,
                 )
             else:
                 from mlx_sharding_tpu.parallel.multihost import serve_worker
