@@ -57,6 +57,47 @@ PROMPT_LEN = 64
 DECODE_TOKENS = 256
 MAX_SEQ = 1024
 
+# The BASELINE.json PRIMARY config: DeepSeek-Coder-V2-Lite's public
+# architecture (HF deepseek-ai/DeepSeek-Coder-V2-Lite-Instruct config.json;
+# the reference deploys it as the 0-14/14-27 split,
+# /root/reference/shard/utils.py:36-39). The actual checkpoint BYTES are
+# unobtainable here (zero-egress environment, no local copy — see
+# BASELINE.md round 5), so the headline measurement runs this real
+# architecture at real scale with synthetic packed-4-bit weights: decode
+# throughput is weight-value-independent (HBM bytes moved per token is the
+# roofline), and the layout is byte-identical to
+# load_model(keep_quantized=True) on the real 4-bit checkpoint.
+DSV2_LITE = dict(
+    model_type="deepseek_v2",
+    vocab_size=102400,
+    hidden_size=2048,
+    intermediate_size=10944,
+    moe_intermediate_size=1408,
+    num_hidden_layers=27,
+    num_attention_heads=16,
+    num_key_value_heads=16,
+    kv_lora_rank=512,
+    q_lora_rank=None,
+    qk_rope_head_dim=64,
+    qk_nope_head_dim=128,
+    v_head_dim=128,
+    n_routed_experts=64,
+    n_shared_experts=2,
+    num_experts_per_tok=6,
+    first_k_dense_replace=1,
+    norm_topk_prob=False,
+    routed_scaling_factor=1.0,
+    topk_method="greedy",
+    rope_theta=10000.0,
+    rope_scaling=dict(
+        type="yarn", factor=40,
+        original_max_position_embeddings=4096,
+        beta_fast=32, beta_slow=1, mscale=0.707, mscale_all_dim=0.707,
+    ),
+    max_position_embeddings=163840,
+    quantization=dict(group_size=64, bits=4),
+)
+
 DETAIL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
 
 
@@ -268,6 +309,95 @@ def measure_cb(model, params, prompt, label: str, slots: int = 4) -> dict:
     return res
 
 
+def synth_packed_deepseek(model, key):
+    """DeepSeek params in load_model(keep_quantized=True)'s exact layout,
+    generated DIRECTLY in packed form on the default device — no dense
+    tensor of the full model ever exists (the ~16B model is ~31 GB bf16,
+    which fits neither the chip nor a sane transfer through the tunnel;
+    packed it is ~10 GB). Weight VALUES are random (throughput is
+    value-independent); what matters is byte-exact layout parity: packed
+    {q, scales, biases} triples in MLX (out, in/8)/(out, in/64)
+    orientation for every projection, with kv_b_proj and the MoE router
+    kept dense exactly as packed_keep_dense_re does in compressed-MLA
+    mode, and the embedding/head packed as (V, H)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = model.config
+    keys = iter(jax.random.split(key, 256))
+
+    def packed(in_dim, out_dim, lead=()):
+        kq, ks, kb = jax.random.split(next(keys), 3)
+        return {
+            "q": jax.random.bits(
+                kq, (*lead, out_dim, in_dim // 8), jnp.uint32
+            ),
+            "scales": jax.random.uniform(
+                ks, (*lead, out_dim, in_dim // 64), jnp.float32, 2e-3, 8e-3
+            ),
+            "biases": jax.random.uniform(
+                kb, (*lead, out_dim, in_dim // 64), jnp.float32, -3e-2, 0.0
+            ),
+        }
+
+    def dense(in_dim, out_dim, lead=(), scale=None):
+        if scale is None:
+            scale = in_dim ** -0.5
+        return (
+            jax.random.normal(
+                next(keys), (*lead, in_dim, out_dim), jnp.float32
+            ) * scale
+        ).astype(jnp.bfloat16)
+
+    hd, heads = cfg.hidden_size, cfg.num_attention_heads
+    nope, rope_d, v_d = (
+        cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim,
+    )
+    rank = cfg.kv_lora_rank
+
+    def attn(L):
+        return {
+            "input_norm": jnp.ones((L, hd), jnp.bfloat16),
+            "post_norm": jnp.ones((L, hd), jnp.bfloat16),
+            "kv_a_proj": packed(hd, rank + rope_d, (L,)),
+            "kv_a_norm": jnp.ones((L, rank), jnp.bfloat16),
+            # dense: consumed as a raw tensor by the absorbed compressed-MLA
+            # einsums (models/deepseek_v2.py packed_keep_dense_re)
+            "kv_b_proj": dense(rank, heads * (nope + v_d), (L,)),
+            "o_proj": packed(heads * v_d, hd, (L,)),
+            "q_proj": packed(hd, heads * (nope + rope_d), (L,)),
+        }
+
+    n_dense = cfg.first_k_dense_replace
+    n_moe = cfg.num_hidden_layers - n_dense
+    e, mi = cfg.n_routed_experts, cfg.moe_intermediate_size
+    si = mi * (cfg.n_shared_experts or 1)
+    layers = {
+        "dense": {
+            **attn(n_dense),
+            "gate_proj": packed(hd, cfg.intermediate_size, (n_dense,)),
+            "up_proj": packed(hd, cfg.intermediate_size, (n_dense,)),
+            "down_proj": packed(cfg.intermediate_size, hd, (n_dense,)),
+        },
+        "moe": {
+            **attn(n_moe),
+            "router": dense(hd, e, (n_moe,)),  # dense: fp32 routing einsum
+            "w_gate": packed(hd, mi, (n_moe, e)),
+            "w_up": packed(hd, mi, (n_moe, e)),
+            "w_down": packed(mi, hd, (n_moe, e)),
+            "shared_gate": packed(hd, si, (n_moe,)),
+            "shared_up": packed(hd, si, (n_moe,)),
+            "shared_down": packed(si, hd, (n_moe,)),
+        },
+    }
+    return {
+        "layers": layers,
+        "embed": {"weight": packed(hd, cfg.vocab_size)},
+        "final_norm": {"weight": jnp.ones((hd,), jnp.bfloat16)},
+        "lm_head": {"weight": packed(hd, cfg.vocab_size)},
+    }
+
+
 def measure_cb_prefix(model, params, label: str) -> dict:
     """Prefix-cache value measurement (VERDICT r4 weak #6): requests share a
     512-token system prompt; after the first registers its pages, later
@@ -290,34 +420,43 @@ def measure_cb_prefix(model, params, label: str) -> dict:
     )
     batcher = ContinuousBatcher(eng, decode_block=8, prefix_cache=True)
     try:
-        t0 = time.perf_counter()
-        for _ in batcher.generate_step(list(range(1, 100)), max_tokens=4):
-            pass
-        log(f"[{label}] warmup (incl. compiles) {time.perf_counter() - t0:.1f}s")
-
         vocab = model.config.vocab_size
-        rng = np.random.default_rng(0)
-        sys_p = [int(x) for x in rng.integers(1, vocab - 64, 512)]
 
-        def ttft_ms(suffix_tok: int) -> float:
+        def head(seed: int) -> list:
+            rng = np.random.default_rng(seed)
+            return [int(x) for x in rng.integers(1, vocab - 64, 512)]
+
+        def ttft_ms(prefix: list, suffix_tok: int) -> float:
             t0 = time.perf_counter()
             first = None
             for _tok, _ in batcher.generate_step(
-                sys_p + [suffix_tok], max_tokens=16
+                prefix + [suffix_tok], max_tokens=16
             ):
                 if first is None:
                     first = time.perf_counter() - t0
             return first * 1e3
 
-        cold = ttft_ms(vocab - 2)  # registers the 4 full system-prompt pages
-        warms = sorted(ttft_ms(vocab - 3 - i) for i in range(3))
+        # warmup at the MEASURED shape with a head the measurement never
+        # reuses: compiles + first-request one-time costs land here, so
+        # cold-vs-warm below isolates the structural chunk-skip delta
+        t0 = time.perf_counter()
+        ttft_ms(head(99), vocab - 2)
+        log(f"[{label}] warmup (incl. compiles) {time.perf_counter() - t0:.1f}s")
+
+        # cold: distinct 512-token heads — every chunk prefills (median of 3)
+        colds = sorted(ttft_ms(head(i), vocab - 2) for i in range(3))
+        # warm: a shared head registered once, then hit (median of 3)
+        shared = head(7)
+        ttft_ms(shared, vocab - 3)  # registers the shared head's 4 pages
+        warms = sorted(ttft_ms(shared, vocab - 4 - i) for i in range(3))
         q, h, reused, _, _ = batcher.prefix_stats()
     finally:
         batcher.close()
+    cold, warm = colds[1], warms[1]
     res = dict(
         label=label, ttft_cold_ms=round(cold, 1),
-        ttft_warm_ms=round(warms[1], 1),  # median of 3 prefix-hit requests
-        ttft_speedup=round(cold / max(warms[1], 1e-6), 2),
+        ttft_warm_ms=round(warm, 1),
+        ttft_speedup=round(cold / max(warm, 1e-6), 2),
         prefix_queries=q, prefix_hits=h, tokens_reused=reused,
     )
     log(f"[{label}] TTFT cold={res['ttft_cold_ms']}ms "
@@ -648,6 +787,41 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["cb_prefix_cache"] = dict(error=repr(e)[:300])
             log(f"[cb_prefix_cache] FAILED: {e!r}")
+
+        # HEADLINE (BASELINE.json primary config): DeepSeek-Coder-V2-Lite at
+        # its real architecture and scale — 27 layers, 64-expert MoE + 2
+        # shared, compressed-MLA cache, packed 4-bit resident (~10 GB HBM) —
+        # single-chip decode. Weights are synthetic (synth_packed_deepseek;
+        # the checkpoint bytes are unobtainable in this zero-egress
+        # environment — BASELINE.md round 5) in the byte-exact
+        # keep_quantized layout; decode throughput is value-independent.
+        # LAST: needs the 3B model's HBM back first.
+        model = params = None
+        gc.collect()
+        try:
+            import numpy as _np
+
+            dmodel, _dcfg = build_model(DSV2_LITE)
+            dparams = synth_packed_deepseek(dmodel, jax.random.PRNGKey(11))
+            jax.block_until_ready(dparams)
+            dgen = Generator(
+                dmodel, dparams, max_seq=MAX_SEQ, prefill_chunk=128
+            )
+            dprompt = [
+                int(x) for x in
+                _np.random.default_rng(5).integers(1, 50000, PROMPT_LEN)
+            ]
+            detail["deepseek_v2_lite_4bit"] = dict(
+                measure_decode(dgen, dprompt, "deepseek_v2_lite_4bit"),
+                note="BASELINE primary arch at real scale, synthetic packed "
+                     "weights (zero-egress: no checkpoint bytes available); "
+                     "~2.4B activated params/token of ~15.7B total",
+            )
+            dgen = dparams = dmodel = None
+            gc.collect()
+        except Exception as e:  # noqa: BLE001
+            detail["deepseek_v2_lite_4bit"] = dict(error=repr(e)[:300])
+            log(f"[deepseek_v2_lite_4bit] FAILED: {e!r}")
 
     detail_path = DETAIL_PATH
     if cpu_fallback and os.path.exists(DETAIL_PATH):

@@ -160,6 +160,14 @@ class ModelProvider:
         if default_model:
             self.load("default_model")
 
+    @property
+    def prefix_cache_enabled(self) -> bool:
+        """--prompt-cache with a paged pool. The ONE definition every
+        consumer (rank-0 batcher, multi-host batcher, worker mirror) must
+        share: the cache changes the page-allocation sequence, so a
+        rank-divergent answer here is a multi-host desync."""
+        return bool(self.prompt_cache and self.paged_pool is not None)
+
     def _load_draft(self, cache_dtype):
         """Load the draft model pair for speculative decoding. The draft
         rides the packed path only if IT is a quantized checkpoint — a
@@ -289,8 +297,7 @@ class ModelProvider:
                                 engine,
                                 decode_block=min(8, self.decode_block),
                                 policy=self.admission_policy,
-                                prefix_cache=self.prompt_cache
-                                and self.paged_pool is not None,
+                                prefix_cache=self.prefix_cache_enabled,
                                 overcommit=self.overcommit,
                                 draft_engine=draft_eng,
                                 spec_k=self.spec_k,
@@ -322,8 +329,7 @@ class ModelProvider:
                                 generator,
                                 decode_block=min(8, self.decode_block),
                                 policy=self.admission_policy,
-                                prefix_cache=self.prompt_cache
-                                and self.paged_pool is not None,
+                                prefix_cache=self.prefix_cache_enabled,
                             )
                         else:
                             from mlx_sharding_tpu.parallel.multihost import (
@@ -1092,8 +1098,7 @@ def main(argv=None):
                 serve_worker_batched(
                     provider.generator,
                     decode_block=min(8, args.decode_block),
-                    prefix_cache=args.prompt_cache
-                    and args.paged_pool is not None,
+                    prefix_cache=provider.prefix_cache_enabled,
                 )
             else:
                 from mlx_sharding_tpu.parallel.multihost import serve_worker
