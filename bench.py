@@ -325,18 +325,22 @@ def synth_packed_deepseek(model, key):
 
     cfg = model.config
     keys = iter(jax.random.split(key, 256))
+    gs, bits = model._quant_args()  # stay in lockstep with cfg.quantization
+    per_word = 32 // bits
 
     def packed(in_dim, out_dim, lead=()):
         kq, ks, kb = jax.random.split(next(keys), 3)
         return {
             "q": jax.random.bits(
-                kq, (*lead, out_dim, in_dim // 8), jnp.uint32
+                kq, (*lead, out_dim, in_dim // per_word), jnp.uint32
             ),
+            # fp16, matching the checkpoint residency keep_quantized keeps
+            # (fp32 scales would add ~11% to the bytes streamed per token)
             "scales": jax.random.uniform(
-                ks, (*lead, out_dim, in_dim // 64), jnp.float32, 2e-3, 8e-3
+                ks, (*lead, out_dim, in_dim // gs), jnp.float16, 2e-3, 8e-3
             ),
             "biases": jax.random.uniform(
-                kb, (*lead, out_dim, in_dim // 64), jnp.float32, -3e-2, 0.0
+                kb, (*lead, out_dim, in_dim // gs), jnp.float16, -3e-2, 0.0
             ),
         }
 

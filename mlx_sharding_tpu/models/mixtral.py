@@ -26,6 +26,7 @@ class MixtralModel(BaseModel):
     # attention projections and the (E, …) expert stacks may stay 4-bit
     # packed; the router loads dense (fp32 routing matmul on a tiny weight)
     supports_packed = True
+    supports_sp = True  # sp_layer below (window-aware, replicated MoE MLP)
 
     def packed_keep_dense_re(self) -> str | None:
         return r"block_sparse_moe\.gate\.weight$"
@@ -38,24 +39,25 @@ class MixtralModel(BaseModel):
         self.scale = config.head_dim**-0.5
 
     # ------------------------------------------------------------------
-    def _layer(self, h, p, k_buf, v_buf, offset, tp_axis=None, ep_axis=None):
+    def layer_attn_inputs(self, p, h, offset):
+        """Pre-attention half: norm + QKV + RoPE. Head counts derive from
+        the projection shards, so the same code runs the full model and any
+        tp slice (heads split over tp)."""
         cfg = self.config
-        b, t, hidden = h.shape
+        b, t, _ = h.shape
         d = cfg.head_dim
-
-        # head counts derive from the projection shards, so the same code
-        # runs the full model and any tp slice (heads split over tp)
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
         q = self._linear(r, p["q_proj"]).reshape(b, t, -1, d)
         k = self._linear(r, p["k_proj"]).reshape(b, t, -1, d)
         v = self._linear(r, p["v_proj"]).reshape(b, t, -1, d)
         q = apply_rope(q, self.inv_freq, offset)
         k = apply_rope(k, self.inv_freq, offset)
-        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
-        attn = causal_attention(
-            q, k_buf, v_buf, offset, self.scale,
-            sliding_window=cfg.sliding_window,
-        )
+        return q, k, v
+
+    def layer_finish(self, p, h, attn, tp_axis=None, ep_axis=None):
+        """Post-attention half: O projection + routed top-k expert MLP."""
+        cfg = self.config
+        b, t, hidden = h.shape
         attn_out = self._linear(attn.reshape(b, t, -1), p["o_proj"])
         if tp_axis is not None:
             attn_out = jax.lax.psum(attn_out, tp_axis)
@@ -74,7 +76,24 @@ class MixtralModel(BaseModel):
             # shard over ep instead (ep overrides tp in the engine's merge)
             # and apply_experts' internal ep psum already made them full.
             moe = jax.lax.psum(moe, tp_axis)
-        return h + moe.reshape(b, t, hidden), k_buf, v_buf
+        return h + moe.reshape(b, t, hidden)
+
+    def sp_layer(self, p, h, offset, attn_fn, group=None):
+        """Sequence-parallel layer: the injected attention gets Mixtral's
+        (optional) sliding window; the MoE MLP runs replicated per sp
+        device on its local T/S rows."""
+        q, k, v = self.layer_attn_inputs(p, h, offset)
+        attn = attn_fn(q, k, v, sliding_window=self.config.sliding_window)
+        return self.layer_finish(p, h, attn), k, v
+
+    def _layer(self, h, p, k_buf, v_buf, offset, tp_axis=None, ep_axis=None):
+        q, k, v = self.layer_attn_inputs(p, h, offset)
+        k_buf, v_buf = write_layer_kv(k_buf, v_buf, k, v, offset)
+        attn = causal_attention(
+            q, k_buf, v_buf, offset, self.scale,
+            sliding_window=self.config.sliding_window,
+        )
+        return self.layer_finish(p, h, attn, tp_axis, ep_axis), k_buf, v_buf
 
     def run_layers(
         self, layer_params, h, k, v, offset, mask=None, tp_axis=None,

@@ -142,3 +142,43 @@ def test_dsv2_sp_decode_parity(dsv2):
     assert _toks(sp, prompt, max_tokens=12) == _toks(
         dense, prompt, max_tokens=12
     )
+
+
+# ------------------------------------------------------------------- Mixtral
+@pytest.fixture(scope="module")
+def mixtral():
+    from mlx_sharding_tpu.config import MixtralConfig
+    from mlx_sharding_tpu.models.mixtral import MixtralModel
+
+    model = MixtralModel(
+        MixtralConfig(
+            vocab_size=160, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=3, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2,
+            sliding_window=8,  # small so the window bites (None also valid)
+        )
+    )
+    params = model.init_params(jax.random.PRNGKey(9), jnp.float32)
+    return model, params
+
+
+def test_mixtral_sp_prefill_parity(mixtral):
+    """MoE + sliding window through the ring: routing runs replicated per
+    sp device on its local rows; the window masks/skips blocks."""
+    model, params = mixtral
+    assert supports_sp_prefill(model)
+    dense, sp = _gens(model, params)
+    prompt = [int(x) for x in np.random.default_rng(10).integers(1, 160, 30)]
+    assert _toks(sp, prompt, max_tokens=10) == _toks(
+        dense, prompt, max_tokens=10
+    )
+
+
+def test_mixtral_sp_decode_parity(mixtral):
+    model, params = mixtral
+    dense, sp = _gens(model, params, sp_decode=True)
+    prompt = [int(x) for x in np.random.default_rng(11).integers(1, 160, 42)]
+    assert _toks(sp, prompt, max_tokens=10) == _toks(
+        dense, prompt, max_tokens=10
+    )
