@@ -64,6 +64,12 @@ class _Request:
     slot: int = -1
     produced: int = 0
     prefill_pos: int = 0  # next prompt index to prefill; admission is chunked
+    # draft-engine prefill position (speculative CB): tracked separately —
+    # a prefix-cache hit starts the TARGET past the reused pages while the
+    # draft, which has no page sharing, prefills the whole prompt from 0
+    draft_pos: int = 0
+    # target prefill logits stashed while the draft catches up
+    _last_logits: Optional[object] = None
     # raw sampler request, kept so multi-host serving can broadcast the
     # request verbatim and workers rebuild an identical SamplerParams
     temperature: float = 0.0
@@ -129,12 +135,6 @@ class ContinuousBatcher:
                                  "prefill chunk")
             if draft_engine.max_seq < engine.max_seq:
                 raise ValueError("draft engine max_seq must cover the target's")
-            if prefix_cache:
-                # a prefix hit skips target prefill for reused pages, but the
-                # draft has no page sharing and must see the whole prompt
-                raise ValueError(
-                    "prefix_cache does not compose with a draft engine"
-                )
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         if policy not in ("fifo", "first_fit"):
@@ -579,32 +579,59 @@ class ContinuousBatcher:
         # prefill starts past the reused prefix — its KV is already mapped
         req.prefill_pos = reused_tokens
 
-    def _prefill_one_chunk(self, req: _Request):
-        """Run ONE prefill chunk for a mid-admission request; on the last
-        chunk, sample the first token and activate the slot for decode."""
-        eng = self.engine
-        c = eng.prefill_chunk
-        slot_arr = self._put(jnp.asarray(req.slot, jnp.int32))
-        chunk = req.prompt[req.prefill_pos : req.prefill_pos + c]
+    @staticmethod
+    def _chunk_at(prompt: np.ndarray, pos: int, c: int):
+        """Slice one right-padded prefill chunk at ``pos``; returns
+        (chunk (c,), n_valid) — shared by the target and draft branches so
+        their padding semantics can never diverge."""
+        chunk = prompt[pos : pos + c]
         n_valid = chunk.size
         if n_valid < c:
             chunk = np.pad(chunk, (0, c - n_valid))
-        logits, self.cache = eng.prefill_slot()(
-            eng.layer_params, eng.layer_masks, eng.vocab_parts,
-            eng.shared_params, self._put(jnp.asarray(chunk[None])), slot_arr,
-            self.cache, self._put(jnp.asarray(n_valid, jnp.int32)),
-            self.table if self.paged else None,
+        return chunk, n_valid
+
+    def _prefill_done(self, req: _Request) -> bool:
+        """Admission prefill complete on EVERY engine: the target (which may
+        start past a reused prefix) and, when speculating, the draft (which
+        always prefills from 0)."""
+        return req.prefill_pos >= req.prompt.size and (
+            self.draft is None or req.draft_pos >= req.prompt.size
         )
-        if self.draft is not None:
+
+    def _prefill_one_chunk(self, req: _Request):
+        """Run ONE prefill chunk for a mid-admission request — on the target
+        and, when speculating, the draft, each at its own position (a prefix
+        hit advances only the target's start). On the last chunk of BOTH,
+        sample the first token and activate the slot for decode; the
+        target's final-chunk logits are stashed while the draft catches up."""
+        eng = self.engine
+        c = eng.prefill_chunk
+        slot_arr = self._put(jnp.asarray(req.slot, jnp.int32))
+        if req.prefill_pos < req.prompt.size:
+            chunk, n_valid = self._chunk_at(req.prompt, req.prefill_pos, c)
+            logits, self.cache = eng.prefill_slot()(
+                eng.layer_params, eng.layer_masks, eng.vocab_parts,
+                eng.shared_params, self._put(jnp.asarray(chunk[None])),
+                slot_arr, self.cache,
+                self._put(jnp.asarray(n_valid, jnp.int32)),
+                self.table if self.paged else None,
+            )
+            req.prefill_pos += n_valid
+            if req.prefill_pos >= req.prompt.size:
+                req._last_logits = logits
+        if self.draft is not None and req.draft_pos < req.prompt.size:
             d = self.draft
+            chunk, n_valid = self._chunk_at(req.prompt, req.draft_pos, c)
             _, self.dcache = d.prefill_slot()(
                 d.layer_params, d.layer_masks, d.vocab_parts, d.shared_params,
                 self._put(jnp.asarray(chunk[None])), slot_arr, self.dcache,
                 self._put(jnp.asarray(n_valid, jnp.int32)), None,
             )
-        req.prefill_pos += n_valid
-        if req.prefill_pos < req.prompt.size:
+            req.draft_pos += n_valid
+        if not self._prefill_done(req):
             return
+        logits = req._last_logits
+        req._last_logits = None
 
         if self.prefix_cache:
             # Register every FULL prompt page under its whole-prefix content
@@ -739,7 +766,7 @@ class ContinuousBatcher:
         Mid-prefill there is nothing to stash; the prefill restarts."""
         slot = req.slot
         self.preemptions += 1
-        if req.prefill_pos >= req.prompt.size:
+        if self._prefill_done(req):
             req.resume_keys = np.asarray(jax.device_get(self.keys)[slot])
             req.resume_recent = np.asarray(jax.device_get(self.recent)[slot])
             if req.history:
@@ -749,7 +776,9 @@ class ContinuousBatcher:
                 req.history = []
                 req._pkeys = None  # prompt changed: content keys are stale
         req._chain = None
+        req._last_logits = None
         req.prefill_pos = 0
+        req.draft_pos = 0
         self.active = self._row_set(
             self.active, self._put(jnp.asarray(slot, jnp.int32)),
             self._put(jnp.asarray(False)),
@@ -775,7 +804,7 @@ class ContinuousBatcher:
             (
                 (slot, req)
                 for slot, req in enumerate(self._slots)
-                if req is not None and req.prefill_pos >= req.prompt.size
+                if req is not None and self._prefill_done(req)
             ),
             key=lambda t: t[1].admit_seq,
         )
@@ -817,7 +846,7 @@ class ContinuousBatcher:
         # snapshot of slots active for this block, in slot order
         live = [
             (slot, req) for slot, req in enumerate(self._slots)
-            if req is not None and req.prefill_pos >= req.prompt.size
+            if req is not None and self._prefill_done(req)
         ]
         want_lp = any(req.want_logprobs for _, req in live)
         block = self._decode_block_prog(want_lp)
@@ -858,7 +887,7 @@ class ContinuousBatcher:
         decode block (all slots still advance, just unspeculated)."""
         K, ms = self.spec_k, self.engine.max_seq
         for req in self._slots:
-            if req is None or req.prefill_pos < req.prompt.size:
+            if req is None or not self._prefill_done(req):
                 continue
             if req.want_logprobs:
                 return False
@@ -879,7 +908,7 @@ class ContinuousBatcher:
             self._grow_for_decode()
         live = [
             (slot, req) for slot, req in enumerate(self._slots)
-            if req is not None and req.prefill_pos >= req.prompt.size
+            if req is not None and self._prefill_done(req)
         ]
         if not live:
             return
@@ -971,7 +1000,7 @@ class ContinuousBatcher:
         self._admit_waiting()
         prefilling = [
             r for r in self._slots
-            if r is not None and r.prefill_pos < r.prompt.size
+            if r is not None and not self._prefill_done(r)
         ]
         decoding = bool(np.asarray(self.active).any())
         if prefilling:

@@ -1027,10 +1027,6 @@ def main(argv=None):
                      "generator or to --concurrent serving "
                      "(no --coordinator/--tp/--ep/stage or "
                      "layer-range flags)")
-    if args.draft_model and args.prompt_cache and args.concurrent > 1:
-        parser.error("--draft-model does not compose with --prompt-cache "
-                     "(a prefix hit skips target prefill the draft "
-                     "still needs)")
     if args.prompt_cache and args.concurrent > 1 and not args.paged_pool:
         parser.error("--prompt-cache with --concurrent requires --paged-pool "
                      "(prefix sharing is page-granular)")

@@ -335,37 +335,6 @@ def test_overcommit_requires_paged(setup):
         ContinuousBatcher(batcher.engine, overcommit=True)
 
 
-def test_overcommit_interleaves_where_reserve_serializes(oc_setup):
-    """Two requests whose reserved needs (6 pages each) exceed the 8-page
-    pool: reserve admission runs them strictly one-after-another, over-commit
-    runs them concurrently (higher slot occupancy) and stays token-exact
-    through the preemption the pool pressure eventually forces."""
-    jobs = [
-        ([3, 17, 42, 9], dict(max_tokens=40)),   # full need ceil(44/8)=6
-        ([5, 11, 2, 8], dict(max_tokens=40)),
-    ]
-    # reserve-mode control: same pool, no overcommit — strict serialization
-    reserve, ref = _paged_batcher(pool_pages=8)
-    try:
-        refs = [_run(ref, p, **kw) for p, kw in jobs]
-        got_r, times_r = _concurrent(reserve, jobs)
-        assert got_r == refs
-        # one request's stream finished entirely before the other started
-        starts = [t[0] for t in times_r]
-        ends = [t[-1] for t in times_r]
-        assert min(ends) <= max(starts), "reserve admission co-ran 2x6 pages in an 8-page pool"
-    finally:
-        reserve.close()
-
-    batcher, _ = oc_setup
-    before = batcher.preemptions
-    got, times = _concurrent(batcher, jobs)
-    assert got == refs  # token-exact through preemption + resume
-    # genuine interleaving: each produced a token before the other finished
-    assert times[0][0] < times[1][-1] and times[1][0] < times[0][-1]
-    assert batcher.preemptions > before  # pool pressure forced a preemption
-
-
 def test_overcommit_preempt_resume_seeded_exact(oc_setup):
     """A seeded stochastic request that gets preempted and resumed must
     continue its exact PRNG chain and repetition window: its stream matches
@@ -387,25 +356,9 @@ def test_overcommit_preempt_resume_seeded_exact(oc_setup):
     assert in_use == 0 and len(batcher._free_pages) == total
 
 
-def test_overcommit_prefix_cache_compose():
-    """Over-commit + prefix cache: a preempted request's registered prompt
-    pages survive as cache entries and its resume re-prefill hits them;
-    streams stay exact."""
-    batcher, ref = _paged_batcher(
-        pool_pages=8, overcommit=True, prefix_cache=True
-    )
-    try:
-        shared = [((7 * i) % 251) + 1 for i in range(12)]  # 1 full page + 4
-        jobs = [
-            (shared + [61, 62], dict(max_tokens=30)),
-            (shared + [71], dict(max_tokens=30)),
-        ]
-        refs = [_run(ref, p, **kw) for p, kw in jobs]
-        got, _ = _concurrent(batcher, jobs)
-        assert got == refs
-        assert batcher.prefix_stats()[0] >= 2  # both queried the index
-    finally:
-        batcher.close()
+# (Heavier over-commit / speculation composition cases — each building its
+# own engines — live in tests/test_scheduler_heavy.py, outside the quick
+# tier; the representatives here keep the tier's scheduler signal.)
 
 
 # --------------------------------------------- speculative continuous batching
@@ -467,21 +420,6 @@ def test_spec_cb_greedy_token_exact(spec_setup):
     assert times[0][0] < times[1][-1] and times[1][0] < times[0][-1]
 
 
-def test_spec_cb_perfect_draft_accepts_k(spec_setup):
-    """A draft identical to the target agrees at every position: every
-    round emits the full window K (the acceptance gauge's upper bound)."""
-    batcher, ref = _spec_batcher(microbatches=2, spec_k=3, draft_seed=0)
-    try:
-        jobs = [([3, 17, 42], dict(max_tokens=13)),
-                ([5, 11, 2], dict(max_tokens=13))]
-        refs = [_run(ref, p, **kw) for p, kw in jobs]
-        got, _ = _concurrent(batcher, jobs)
-        assert got == refs
-        assert batcher.accepted_tokens == batcher.spec_k * batcher.rounds
-    finally:
-        batcher.close()
-
-
 def test_spec_cb_sampled_interleaving_independent(spec_setup):
     """Sampled requests under speculation: per-slot PRNG chains make a
     seeded request's stream identical run solo or interleaved with
@@ -498,28 +436,6 @@ def test_spec_cb_sampled_interleaving_independent(spec_setup):
     solo = [_run(batcher, p, **kw) for p, kw in jobs]
     got, _ = _concurrent(batcher, jobs)
     assert got == solo
-
-
-def test_spec_cb_paged_overcommit_compose():
-    """Speculation x paged pool x over-commit: verify writes straddle page
-    boundaries (multi-page writeback) and pool pressure preempts + resumes
-    a request mid-speculation; greedy streams stay exact throughout."""
-    batcher, ref = _spec_batcher(microbatches=2, spec_k=3, pool_pages=8,
-                                 overcommit=True)
-    try:
-        jobs = [
-            ([3, 17, 42, 9], dict(max_tokens=40)),  # full need 6 pages
-            ([5, 11, 2, 8], dict(max_tokens=40)),
-        ]
-        refs = [_run(ref, p, **kw) for p, kw in jobs]
-        before = batcher.preemptions
-        got, _ = _concurrent(batcher, jobs)
-        assert got == refs
-        assert batcher.preemptions > before
-        total, in_use, _ = batcher.page_stats()
-        assert in_use == 0 and len(batcher._free_pages) + 0 == total
-    finally:
-        batcher.close()
 
 
 def test_spec_cb_logprobs_falls_back_unspeculated(spec_setup):
@@ -550,12 +466,6 @@ def test_spec_cb_guards():
     )
     with pytest.raises(ValueError, match="pp=1"):
         ContinuousBatcher(eng2, draft_engine=deng)
-    eng1 = PipelineEngine(
-        model, params, pipeline_mesh(1), microbatches=2, max_seq=64,
-        cache_dtype=jnp.float32, prefill_chunk=8, pool_pages=16, page_size=8,
-    )
-    with pytest.raises(ValueError, match="prefix_cache"):
-        ContinuousBatcher(eng1, draft_engine=deng, prefix_cache=True)
 
 
 # ---------------------------------------------------------------- prefix cache
