@@ -735,6 +735,42 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["cpu_fallback_extras"] = dict(error=repr(e)[:300])
             log(f"[cpu_fallback_extras] FAILED: {e!r}")
+        # prefix-cache + over-commit evidence ride the fallback too, on a
+        # smaller model (the 0.28B fallback compiles these paths too slowly
+        # on CPU to fit the bench budget) — the structural deltas (chunk
+        # skip, admission interleaving) are what these record, not tok/s.
+        # Guarded like every other measurement: a failure here must never
+        # cost the artifact/headline writes below.
+        m2 = p2 = None
+        try:
+            tiny2 = dict(
+                model_type="llama", vocab_size=4096, hidden_size=128,
+                intermediate_size=256, num_hidden_layers=4,
+                num_attention_heads=4, num_key_value_heads=2, head_dim=32,
+                max_position_embeddings=2048,
+            )
+            m2, _ = build_model(tiny2)
+            p2 = jax.jit(lambda k: m2.init_params(k, jnp.bfloat16))(
+                jax.random.PRNGKey(2)
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["cb_prefix_cache_cpu"] = dict(error=repr(e)[:300])
+            log(f"[cpu tiny2 build] FAILED: {e!r}")
+        if m2 is not None:
+            try:
+                detail["cb_prefix_cache_cpu"] = measure_cb_prefix(
+                    m2, p2, "cb_prefix_cache_cpu"
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["cb_prefix_cache_cpu"] = dict(error=repr(e)[:300])
+                log(f"[cb_prefix_cache_cpu] FAILED: {e!r}")
+            try:
+                detail["cb_overcommit_cpu"] = measure_cb_overcommit(
+                    m2, p2, "cb_overcommit_cpu"
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["cb_overcommit_cpu"] = dict(error=repr(e)[:300])
+                log(f"[cb_overcommit_cpu] FAILED: {e!r}")
 
     if not cpu_fallback:
         n_params = param_count(cfg_dict)
