@@ -536,6 +536,87 @@ def measure_cb_overcommit(model, params, label: str) -> dict:
     return res
 
 
+def measure_paged_ragged_vs_gather(model, params, label: str) -> dict:
+    """The ragged paged-attention A/B (ISSUE 1 tentpole): mixed-length
+    continuous batching decode through the same page pool on both paths.
+    Ragged attends over the pool in place via the slot page tables
+    (ops/paged_attention.py); gather materializes each slot's contiguous
+    max_seq view per tick and scatters the dirty page back. Records decode
+    tok/s and the scheduler's analytic KV-bytes-read accounting for each —
+    the bytes ratio is the traffic the ragged path deletes, the tok/s ratio
+    is what that buys on the current backend (CPU exercises the XLA
+    fallbacks; the Pallas kernel needs a real chip)."""
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(11)
+    # uneven on purpose: slots at very different lengths are the whole case
+    # for ragged (gather pays max_seq for every one of them)
+    lens = [16, 64, 160, 320]
+    prompts = [
+        [int(x) for x in rng.integers(1, vocab - 64, n)] for n in lens
+    ]
+
+    def run(path: str) -> dict:
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1), microbatches=4,
+            max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+            pool_pages=28, page_size=128, paged_attention=path,
+        )
+        batcher = ContinuousBatcher(eng, decode_block=8)
+        try:
+            for _ in batcher.generate_step(prompts[0][:16], max_tokens=8):
+                pass  # compile prefill + the decode block for this path
+            total = [0]
+            lock = threading.Lock()
+
+            def consume(p):
+                n = sum(1 for _ in batcher.generate_step(p, max_tokens=48))
+                with lock:
+                    total[0] += n
+
+            threads = [
+                threading.Thread(target=consume, args=(p,)) for p in prompts
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            kpath, last, total_bytes = batcher.kv_read_stats()
+        finally:
+            batcher.close()
+        return dict(
+            path=kpath, tok_s=round(total[0] / wall, 1),
+            kv_bytes_last_tick=int(last),
+            kv_bytes_read_total=int(total_bytes),
+        )
+
+    ragged = run("ragged")
+    gather = run("gather")
+    res = dict(
+        label=label, ragged=ragged, gather=gather,
+        tok_s_ratio=round(ragged["tok_s"] / max(gather["tok_s"], 1e-9), 2),
+        kv_bytes_ratio=round(
+            gather["kv_bytes_read_total"]
+            / max(ragged["kv_bytes_read_total"], 1), 2,
+        ),
+    )
+    log(f"[{label}] ragged={ragged['tok_s']} tok/s "
+        f"({ragged['path']}) gather={gather['tok_s']} tok/s — "
+        f"{res['tok_s_ratio']}x speed, {res['kv_bytes_ratio']}x less KV "
+        "traffic")
+    return res
+
+
 def kernel_smoke(detail: dict) -> None:
     """Compile (for real) + numerically cross-check both Pallas kernels
     against the XLA paths they replace, and time them."""
@@ -771,6 +852,17 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 detail["cb_overcommit_cpu"] = dict(error=repr(e)[:300])
                 log(f"[cb_overcommit_cpu] FAILED: {e!r}")
+            try:
+                detail["paged_ragged_vs_gather_cpu"] = (
+                    measure_paged_ragged_vs_gather(
+                        m2, p2, "paged_ragged_vs_gather_cpu"
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["paged_ragged_vs_gather_cpu"] = dict(
+                    error=repr(e)[:300]
+                )
+                log(f"[paged_ragged_vs_gather_cpu] FAILED: {e!r}")
 
     if not cpu_fallback:
         n_params = param_count(cfg_dict)
@@ -902,6 +994,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["cb_overcommit"] = dict(error=repr(e)[:300])
             log(f"[cb_overcommit] FAILED: {e!r}")
+        gc.collect()
+        try:
+            detail["paged_ragged_vs_gather"] = measure_paged_ragged_vs_gather(
+                model, params, "paged_ragged_vs_gather"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["paged_ragged_vs_gather"] = dict(error=repr(e)[:300])
+            log(f"[paged_ragged_vs_gather] FAILED: {e!r}")
 
         # HEADLINE (BASELINE.json primary config): DeepSeek-Coder-V2-Lite at
         # its real architecture and scale — 27 layers, 64-expert MoE + 2

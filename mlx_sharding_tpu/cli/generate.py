@@ -61,6 +61,14 @@ def main(argv=None):
                         "sampling (distribution-exact)")
     parser.add_argument("--spec-k", type=int, default=4,
                         help="speculation window (with --draft-model)")
+    parser.add_argument("--paged-attention",
+                        choices=("auto", "ragged", "gather"), default="auto",
+                        help="decode-attention path for paged pipeline "
+                        "engines: 'ragged' attends over the KV page pool in "
+                        "place (needs a pool — the engine validates), "
+                        "'gather' keeps the contiguous per-slot view, 'auto' "
+                        "picks ragged where supported; forwarded to the "
+                        "engine, a no-op on dense single-stream runs")
     parser.add_argument("--keep-quantized", action="store_true",
                         help="keep 4-bit decoder weights packed in HBM "
                         "(fused dequant-matmul) instead of dequantizing at "
@@ -116,6 +124,7 @@ def main(argv=None):
                       tp=args.tp, ep=args.ep),
             stage_bounds=bounds,
             max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
+            paged_attention=args.paged_attention,
         )
     else:
         model, params = load_model(

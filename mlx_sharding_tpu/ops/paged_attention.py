@@ -1,0 +1,250 @@
+"""Ragged paged decode attention: attend over the KV page pool in place.
+
+The paged continuous-batching decode path used to gather every slot's pages
+into a dense (B, max_seq, H, D) view per tick (`_paged_read`) and scatter the
+dirty page back (`_paged_writeback`) — the entire KV cache through HBM twice
+per T=1 step, then attention over max_seq padding regardless of each slot's
+true length. This module is the TPU-native fix (Ragged Paged Attention,
+arxiv 2604.15464): a Pallas kernel that walks each slot's page-table row
+directly, streaming only the pages a slot actually occupies and masking
+FLOPs past its offset. No contiguous copy of the cache ever exists.
+
+Two paths, selected the same way ops/flash_attention.py picks its path:
+
+- the Pallas kernel (`_paged_kernel`): grid (slot, kv-head, page); the page
+  to fetch is data-dependent, so the page table and lengths ride in as
+  scalar-prefetch operands and the K/V BlockSpec index maps read them —
+  Pallas double-buffers exactly the pages named by the table. A slot's
+  scratch-page tail (table rows past its length all point at the same
+  scratch id) collapses to one redundant fetch: consecutive grid steps with
+  an identical block index skip the DMA. Online-softmax state (running max,
+  normalizer, fp32 accumulator) lives in VMEM scratch across the page walk.
+- a fused-XLA fallback for CPU / odd shapes / softcap / sliding-window /
+  MLA latent-as-values, mirroring ops/attention.py's masking semantics but
+  gathering only the slot's own table row (slot_pages × page rows), never
+  a max_seq-dense buffer per layer stack.
+
+Both are token-exact vs the gather path; tests/test_paged_attention.py holds
+the parity matrix (uneven lengths, page-boundary offsets, empty slots, GQA/
+MQA head counts, kernel-in-interpret vs XLA).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def kernel_eligible(
+    dk: int,
+    dv: int,
+    logit_softcap,
+    sliding_window,
+    values_from_k,
+    interpret: bool,
+) -> bool:
+    """Pallas path: TPU backend (or interpret mode on any backend), standard
+    GQA only — softcap/window/latent-values stay on the XLA path, like
+    ops/attention.py's _flash_eligible. Head dims need 64-alignment on real
+    hardware (Mosaic pads sub-128 lane tails); interpret mode takes any
+    shape so CPU tests exercise the kernel logic itself. Opt out entirely
+    with MST_PAGED_KERNEL=0."""
+    if os.environ.get("MST_PAGED_KERNEL", "1") == "0":
+        return False
+    if (
+        logit_softcap is not None
+        or sliding_window is not None
+        or values_from_k is not None
+    ):
+        return False
+    if interpret:
+        return True
+    return jax.default_backend() == "tpu" and dk % 64 == 0 and dv % 64 == 0
+
+
+def _kernel(
+    tables_ref,  # (M, SPG) int32 — scalar-prefetch
+    lens_ref,  # (M,) int32 — scalar-prefetch
+    q_ref,  # (1, 1, G, Dk) block
+    k_ref,  # (1, page, 1, Dk) block — the page named by tables[m, j]
+    v_ref,  # (1, page, 1, Dv) block
+    o_ref,  # (1, 1, G, Dv) block
+    m_scr,  # (G, 128) f32 VMEM — running max, lane-replicated
+    l_scr,  # (G, 128) f32 VMEM — running normalizer
+    acc_scr,  # (G, Dv) f32 VMEM — unnormalized output accumulator
+    *,
+    scale: float,
+    page_size: int,
+    pages_per_slot: int,
+):
+    m = pl.program_id(0)
+    j = pl.program_id(2)
+    length = lens_ref[m]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # pages entirely past this slot's length are scratch-table tails: skip
+    # all compute (their DMA already collapsed to the repeated scratch id)
+    @pl.when(j * page_size < length)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)  # (G, Dk)
+        kblk = k_ref[0, :, 0, :].astype(jnp.float32)  # (page, Dk)
+        vblk = v_ref[0, :, 0, :].astype(jnp.float32)  # (page, Dv)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (G, page)
+        k_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        s = jnp.where(k_pos < length, s, NEG_INF)
+        m_prev = m_scr[:, :1]  # (G, 1)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == pages_per_slot - 1)
+    def _finish():
+        # empty slot (length 0, the garbage lane): l stays 0 → zeros out
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_kernel(
+    q, k_pool, v_pool, tables, lengths, scale, interpret
+):
+    m, hq, dk = q.shape
+    pages, page_size, hkv, dv = (
+        k_pool.shape[0], k_pool.shape[1], k_pool.shape[2], v_pool.shape[-1],
+    )
+    spg = tables.shape[1]
+    g = hq // hkv
+    qg = q.reshape(m, hkv, g, dk)
+
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(m, hkv, spg),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, dk), lambda mi, hi, ji, t, ln: (mi, hi, 0, 0)),
+            # data-dependent page fetch: the block index comes from the
+            # prefetched table row — this is the whole point of the kernel
+            pl.BlockSpec(
+                (1, page_size, 1, dk),
+                lambda mi, hi, ji, t, ln: (t[mi, ji], 0, hi, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, 1, dv),
+                lambda mi, hi, ji, t, ln: (t[mi, ji], 0, hi, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, dv), lambda mi, hi, ji, t, ln: (mi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, dv), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, page_size=page_size, pages_per_slot=spg
+        ),
+        grid_spec=spec,
+        out_shape=jax.ShapeDtypeStruct((m, hkv, g, dv), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
+    return out.reshape(m, hq, dv)
+
+
+def _paged_attention_xla(
+    q, k_pool, v_pool, tables, lengths, scale,
+    logit_softcap, sliding_window, values_from_k,
+):
+    m, hq, dk = q.shape
+    page_size, hkv = k_pool.shape[1], k_pool.shape[2]
+    spg = tables.shape[1]
+    g = hq // hkv
+
+    k = jnp.take(k_pool, tables, axis=0)  # (M, SPG, page, Hkv, Dk)
+    k = k.reshape(m, spg * page_size, hkv, dk)
+    if values_from_k is not None:
+        v = k[..., :values_from_k]  # MLA: values are the latent prefix of k
+    else:
+        v = jnp.take(v_pool, tables, axis=0).reshape(
+            m, spg * page_size, hkv, -1
+        )
+    qg = q.reshape(m, hkv, g, dk)
+    scores = jnp.einsum(
+        "mhgd,mshd->mhgs", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if logit_softcap is not None:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+    k_pos = jnp.arange(spg * page_size)[None, :]  # (1, S_virt)
+    allowed = k_pos < lengths[:, None]
+    if sliding_window is not None:
+        # the single query sits at position lengths-1
+        allowed &= k_pos > (lengths[:, None] - 1) - sliding_window
+    scores = jnp.where(allowed[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # an all-masked row (length 0, an inactive slot) softmaxes to uniform
+    # garbage, not zeros — clamp it so the contract matches the kernel
+    probs = probs * allowed[:, None, None, :]
+    out = jnp.einsum(
+        "mhgs,mshd->mhgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(m, hq, -1).astype(q.dtype)
+
+
+def paged_attention(
+    q: jax.Array,  # (M, Hq, Dk) — one query token per slot
+    k_pool: jax.Array,  # (P+1, page, Hkv, Dk) — one layer's pool, scratch last
+    v_pool: jax.Array,  # (P+1, page, Hkv, Dv)
+    tables: jax.Array,  # (M, SPG) int32 pool-page ids (scratch id past length)
+    lengths: jax.Array,  # (M,) int32 — valid positions incl. the new token
+    scale: float,
+    *,
+    logit_softcap: Optional[float] = None,
+    sliding_window=None,  # int or traced scalar
+    values_from_k: Optional[int] = None,  # MLA latent-as-values
+    interpret: bool = False,
+) -> jax.Array:
+    """Ragged decode attention over one layer's page pool. Returns
+    (M, Hq, Dv). Row m attends to positions 0..lengths[m] of its own pages;
+    lengths[m] == 0 (an inactive slot) yields zeros. The new token's K/V
+    must already be written into the pool (the engine scatters the single
+    row before calling this)."""
+    dk, dv = q.shape[-1], v_pool.shape[-1]
+    if kernel_eligible(
+        dk, dv, logit_softcap, sliding_window, values_from_k, interpret
+    ):
+        return _paged_attention_kernel(
+            q, k_pool, v_pool, tables, lengths, scale, interpret
+        )
+    return _paged_attention_xla(
+        q, k_pool, v_pool, tables, lengths, scale,
+        logit_softcap, sliding_window, values_from_k,
+    )

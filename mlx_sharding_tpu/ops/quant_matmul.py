@@ -194,7 +194,9 @@ def quant_matmul_pallas(
         out_specs=pl.BlockSpec((block_m, block_out), lambda mi, oi, ii: (mi, oi)),
         out_shape=jax.ShapeDtypeStruct((m, out_dim), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_out), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -140,13 +140,16 @@ def _rotate_half(x):
 def apply_rope(x: jax.Array, inv_freq: jax.Array, offset) -> jax.Array:
     """Rotate ``x`` of shape (B, T, H, D) for absolute positions
     ``offset .. offset+T``. float32 trig, result in x.dtype. Split-half
-    (HF rotate_half) convention."""
+    (HF rotate_half) convention. ``offset`` is a scalar, or a (B,) vector
+    for per-row positions (the ragged paged-decode path, where every batch
+    lane sits at its own sequence length)."""
     t = x.shape[1]
-    positions = jnp.asarray(offset, jnp.float32) + jnp.arange(t, dtype=jnp.float32)
-    angles = positions[:, None] * inv_freq[None, :]  # (T, D/2)
-    angles = jnp.concatenate([angles, angles], axis=-1)  # (T, D)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    off = jnp.asarray(offset, jnp.float32)
+    positions = off[..., None] + jnp.arange(t, dtype=jnp.float32)  # (…, T)
+    angles = positions[..., None] * inv_freq  # (…, T, D/2)
+    angles = jnp.concatenate([angles, angles], axis=-1)  # (…, T, D)
+    cos = jnp.cos(angles)[..., None, :]  # (T, 1, D) or (B, T, 1, D)
+    sin = jnp.sin(angles)[..., None, :]
     x32 = x.astype(jnp.float32)
     out = x32 * cos + _rotate_half(x32) * sin
     return out.astype(x.dtype)
@@ -158,12 +161,14 @@ def apply_rope_interleaved(
     """Complex-pair rotation: adjacent element pairs (2i, 2i+1) rotate
     together — DeepSeek-V2's convention (HF view_as_complex path), with the
     YaRN attention factor folded into the magnitude like HF's
-    ``freqs_cis * attention_scaling``."""
+    ``freqs_cis * attention_scaling``. ``offset``: scalar or (B,) vector
+    (per-row positions, see :func:`apply_rope`)."""
     t = x.shape[1]
-    positions = jnp.asarray(offset, jnp.float32) + jnp.arange(t, dtype=jnp.float32)
-    angles = positions[:, None] * inv_freq[None, :]  # (T, D/2)
-    cos = (jnp.cos(angles) * scaling)[None, :, None, :]
-    sin = (jnp.sin(angles) * scaling)[None, :, None, :]
+    off = jnp.asarray(offset, jnp.float32)
+    positions = off[..., None] + jnp.arange(t, dtype=jnp.float32)  # (…, T)
+    angles = positions[..., None] * inv_freq  # (…, T, D/2)
+    cos = (jnp.cos(angles) * scaling)[..., None, :]
+    sin = (jnp.sin(angles) * scaling)[..., None, :]
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., 0::2], x32[..., 1::2]
     out1 = x1 * cos - x2 * sin

@@ -141,6 +141,12 @@ class ControlPlane:
         self.last_ok: Optional[float] = None  # monotonic stamp of the last
         # completed collective — proof every rank was alive at that moment
         self._thread = None  # lazy daemon worker (timed exchanges only)
+        import threading
+
+        # serializes the timed path: two callers racing the lazy init would
+        # spawn duplicate broadcast threads, and interleaved _work/_out
+        # queue traffic could hand one caller the other's reply
+        self._lock = threading.Lock()
 
     @staticmethod
     def _broadcast(buf):
@@ -170,61 +176,69 @@ class ControlPlane:
         if self.timeout_s is None:
             out = self._broadcast(buf)
         else:
-            if self.dead:
-                raise WorkerTimeoutError(
-                    "multi-host control plane is down (a peer rank "
-                    "previously failed to respond) — restart the deployment"
-                )
-            import queue as _q
+            # the whole timed path holds the lock: dead-check, lazy init,
+            # submit and reply must be one atomic unit or a concurrent
+            # caller could collect this caller's broadcast result
+            with self._lock:
+                if self.dead:
+                    raise WorkerTimeoutError(
+                        "multi-host control plane is down (a peer rank "
+                        "previously failed to respond) — restart the deployment"
+                    )
+                import queue as _q
 
-            if self._thread is None:
-                # one DAEMON thread issuing collectives in program order: a
-                # timed-out broadcast stays blocked in it forever, and a
-                # daemon can be abandoned at interpreter exit — a
-                # ThreadPoolExecutor worker would be joined by the
-                # concurrent.futures atexit hook and wedge process shutdown
-                self._work: _q.Queue = _q.Queue()
-                self._out: _q.Queue = _q.Queue()
+                if self._thread is None:
+                    # one DAEMON thread issuing collectives in program order:
+                    # a timed-out broadcast stays blocked in it forever, and
+                    # a daemon can be abandoned at interpreter exit — a
+                    # ThreadPoolExecutor worker would be joined by the
+                    # concurrent.futures atexit hook and wedge process
+                    # shutdown
+                    self._work: _q.Queue = _q.Queue()
+                    self._out: _q.Queue = _q.Queue()
 
-                def run():
-                    while True:
-                        b = self._work.get()
-                        try:
-                            self._out.put(("ok", self._broadcast(b)))
-                        except BaseException as e:  # noqa: BLE001
-                            self._out.put(("err", e))
+                    def run():
+                        while True:
+                            b = self._work.get()
+                            try:
+                                self._out.put(("ok", self._broadcast(b)))
+                            except BaseException as e:  # noqa: BLE001
+                                self._out.put(("err", e))
 
-                import threading
+                    import threading
 
-                self._thread = threading.Thread(
-                    target=run, name="mst-ctrl", daemon=True
-                )
-                self._thread.start()
-            self._work.put(buf)
-            try:
-                kind, val = self._out.get(timeout=self.timeout_s)
-            except _q.Empty:
-                self.dead = True  # the broadcast thread stays stuck in the
-                # collective; being a daemon, it is abandoned, never joined
-                raise WorkerTimeoutError(
-                    f"multi-host collective did not complete within "
-                    f"{self.timeout_s:.0f}s — a worker rank is dead or "
-                    "wedged; failing the request and marking the control "
-                    "plane down (restart the deployment)"
-                ) from None
-            if kind == "err":
-                # the distributed runtime itself noticed the dead peer and
-                # errored the collective — same conclusion, better latency.
-                # Normalized to WorkerTimeoutError (cause chained) so every
-                # dead-plane swallow site (STOP / SHUTDOWN / batcher close)
-                # behaves identically on both detection paths.
-                self.dead = True
-                raise WorkerTimeoutError(
-                    "multi-host collective failed — the distributed runtime "
-                    "reported a dead or unreachable peer rank; marking the "
-                    "control plane down (restart the deployment)"
-                ) from val
-            out = val
+                    self._thread = threading.Thread(
+                        target=run, name="mst-ctrl", daemon=True
+                    )
+                    self._thread.start()
+                self._work.put(buf)
+                try:
+                    kind, val = self._out.get(timeout=self.timeout_s)
+                except _q.Empty:
+                    self.dead = True  # the broadcast thread stays stuck in
+                    # the collective; being a daemon, it is abandoned, never
+                    # joined
+                    raise WorkerTimeoutError(
+                        f"multi-host collective did not complete within "
+                        f"{self.timeout_s:.0f}s — a worker rank is dead or "
+                        "wedged; failing the request and marking the control "
+                        "plane down (restart the deployment)"
+                    ) from None
+                if kind == "err":
+                    # the distributed runtime itself noticed the dead peer
+                    # and errored the collective — same conclusion, better
+                    # latency. Normalized to WorkerTimeoutError (cause
+                    # chained) so every dead-plane swallow site (STOP /
+                    # SHUTDOWN / batcher close) behaves identically on both
+                    # detection paths.
+                    self.dead = True
+                    raise WorkerTimeoutError(
+                        "multi-host collective failed — the distributed "
+                        "runtime reported a dead or unreachable peer rank; "
+                        "marking the control plane down (restart the "
+                        "deployment)"
+                    ) from val
+                out = val
         self.last_ok = time.monotonic()
         return {k: np.asarray(v) for k, v in out.items()}
 
@@ -278,8 +292,12 @@ def _start_request(engine, msg):
     # with global-mesh arrays in one jit is not well-defined
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from mlx_sharding_tpu.parallel.pipeline import put_global
+
     rep = NamedSharding(engine.mesh, P())
-    put = lambda x: jax.device_put(x, rep)  # noqa: E731
+    # put_global, not device_put: every rank builds the same value from the
+    # broadcast request, so device_put's assert-equal broadcast is overhead
+    put = lambda x: put_global(x, rep)  # noqa: E731
     recent = put(init_recent_tokens(M * B, rep_ctx, arr.reshape(M * B, -1)))
     key = put(jax.random.PRNGKey(seed))
     sp = jax.tree.map(put, sp)

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mlx_sharding_tpu.cache import KVCache
 from mlx_sharding_tpu.ops.quant import dequantize, is_quantized
-from mlx_sharding_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_TP
+from mlx_sharding_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_TP, shard_map
 from mlx_sharding_tpu.sample import (
     SamplerParams,
     init_recent_tokens,
@@ -50,6 +50,31 @@ from mlx_sharding_tpu.sample import (
     transform_logits_batched,
     update_recent_tokens,
 )
+
+
+def put_global(tree, shardings):
+    """``jax.device_put`` that is safe across processes. Single-process it IS
+    device_put. Multi-process, ``device_put`` of host data onto a
+    process-spanning sharding first broadcasts the whole tree through the
+    control plane to assert every rank passed identical values — for model
+    params and cache zeros that is pure overhead (every rank loaded the same
+    checkpoint / computes the same zeros), it is the slowest possible way to
+    place a model, and gloo-backed CPU ranks crash outright on large
+    payloads. Build each global array from the local copy instead: no
+    cross-host value traffic at all. ``shardings`` is a matching pytree of
+    shardings or a single sharding applied to every leaf."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+
+    def put(x, s):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, s, lambda idx, _x=x: _x[idx]
+        )
+
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.tree.map(lambda x: put(x, shardings), tree)
+    return jax.tree.map(put, tree, shardings)
 
 
 def balanced_stage_bounds(num_layers: int, num_stages: int) -> list[tuple[int, int]]:
@@ -166,6 +191,7 @@ class PipelineEngine:
         decode_block: int = 16,
         pool_pages: Optional[int] = None,
         page_size: Optional[int] = None,
+        paged_attention: str = "auto",
     ):
         cfg = model.config
         if not (cfg.is_first_stage and cfg.is_last_stage):
@@ -227,6 +253,34 @@ class PipelineEngine:
             raise ValueError(
                 f"expert parallelism is not wired for {type(model).__name__}"
             )
+
+        # Paged T=1 decode attention path: "ragged" attends over the page
+        # pool in place (ops/paged_attention.py — no per-tick gather/
+        # scatter); "gather" keeps the _paged_read contiguous view;
+        # "auto" picks ragged whenever the wiring supports it. The ragged
+        # body rides the sp_layer hook (injected attention), which has no
+        # tp/ep plumbing, and the S==1 vectorized shape.
+        if paged_attention not in ("auto", "ragged", "gather"):
+            raise ValueError(
+                f"paged_attention={paged_attention!r}: want auto|ragged|gather"
+            )
+        ragged_ok = (
+            self.paged
+            and self.num_stages == 1
+            and self.tp == 1
+            and self.ep == 1
+            and self.batch == 1
+            and getattr(model, "supports_sp", False)
+        )
+        if paged_attention == "ragged" and not ragged_ok:
+            raise ValueError(
+                "paged_attention='ragged' needs a paged (pool_pages) pp=1 "
+                "engine with tp=ep=1, batch=1, and a model with supports_sp"
+            )
+        self.paged_attention = (
+            "ragged" if paged_attention in ("auto", "ragged") and ragged_ok
+            else "gather"
+        )
         # run_layers parallelism kwargs, shared by every step body
         self._rl_kwargs = {}
         if self.tp > 1:
@@ -335,14 +389,14 @@ class PipelineEngine:
             self.layer_specs = jax.tree.map(lambda _: P(AXIS_PP), split)
         else:
             self.layer_specs = build_specs(split, axes_by_name)
-        self.layer_params = jax.device_put(
+        self.layer_params = put_global(
             split,
             jax.tree.map(
                 lambda s: NamedSharding(mesh, s), self.layer_specs,
                 is_leaf=lambda x: isinstance(x, P),
             ),
         )
-        self.layer_masks = jax.device_put(masks, stage_sharding)
+        self.layer_masks = put_global(masks, stage_sharding)
         self.layers_per_stage = slots
 
         # Vocab-shard the embedding table and LM head over pp: each device
@@ -377,8 +431,8 @@ class PipelineEngine:
             head = jnp.pad(head, ((0, 0), (0, Vs * S - head.shape[1])))
             # (S, H, Vs) so each device's slice is its vocab shard
             vparts.append(head.reshape(-1, S, Vs).transpose(1, 0, 2))
-        self.vocab_parts = jax.device_put(tuple(vparts), stage_sharding)
-        self.shared_params = jax.device_put(
+        self.vocab_parts = put_global(tuple(vparts), stage_sharding)
+        self.shared_params = put_global(
             {
                 k: v for k, v in params.items()
                 if k not in ("layers", "embed", "lm_head")
@@ -455,9 +509,9 @@ class PipelineEngine:
         # offset is PER MICROBATCH SLOT: continuous batching runs a different
         # request (at a different sequence position) in every slot
         return KVCache(
-            k=jax.device_put(jnp.zeros((*shape, k_dim), self.cache_dtype), sharding),
-            v=jax.device_put(jnp.zeros((*shape, v_dim), self.cache_dtype), sharding),
-            offset=jax.device_put(
+            k=put_global(jnp.zeros((*shape, k_dim), self.cache_dtype), sharding),
+            v=put_global(jnp.zeros((*shape, v_dim), self.cache_dtype), sharding),
+            offset=put_global(
                 jnp.zeros((M,), jnp.int32), NamedSharding(self.mesh, P())
             ),
         )
@@ -488,13 +542,13 @@ class PipelineEngine:
         )
         sharding = NamedSharding(self.mesh, self._kv_spec)
         cache = KVCache(
-            k=jax.device_put(jnp.zeros((*shape, k_dim), self.cache_dtype), sharding),
-            v=jax.device_put(jnp.zeros((*shape, v_dim), self.cache_dtype), sharding),
-            offset=jax.device_put(
+            k=put_global(jnp.zeros((*shape, k_dim), self.cache_dtype), sharding),
+            v=put_global(jnp.zeros((*shape, v_dim), self.cache_dtype), sharding),
+            offset=put_global(
                 jnp.zeros((M,), jnp.int32), NamedSharding(self.mesh, P())
             ),
         )
-        table = jax.device_put(
+        table = put_global(
             jnp.full((M + 1, self.slot_pages), self.pool_pages, jnp.int32),
             NamedSharding(self.mesh, P()),
         )
@@ -732,7 +786,7 @@ class PipelineEngine:
             body = body_s1
 
         spec_stage, spec_rep = P(AXIS_PP), P()
-        inner = jax.shard_map(
+        inner = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(
@@ -765,6 +819,126 @@ class PipelineEngine:
         if t_len == 1 and not keep_all:
             self._smapped_decode = smapped  # shared by the continuous-batching step
         return smapped
+
+    def _build_smapped_ragged(self):
+        """T=1 paged decode body attending over the page pool IN PLACE
+        (ops/paged_attention.py). Where the gather body materializes every
+        live slot's full (max_seq) KV view and scatters the dirty page back
+        each tick, this body scatters only the M new K/V rows into their
+        pool pages and hands the pool itself to the ragged attention op —
+        per-tick KV traffic drops from the whole cache (twice) to the pages
+        slots actually occupy, and no FLOPs run past each slot's offset.
+
+        Rides the sp_layer injected-attention hook with M as the batch dim
+        (offsets become an (M,)-vector — apply_rope's per-row form), so one
+        forward streams the weights once across all slots, like body_s1.
+        Gated to S==1/tp=1/ep=1/B==1/supports_sp by the constructor."""
+        model, M, B = self.model, self.microbatches, self.batch
+        page = self.page_size
+        from mlx_sharding_tpu.models.base import scan_layers
+        from mlx_sharding_tpu.ops.paged_attention import paged_attention
+
+        def body(layer_params, masks, vparts, shared, tokens, k, v,
+                 offsets, active, n_valid, table):
+            layer_params = jax.tree.map(lambda x: x[0], layer_params)
+            masks = jax.tree.map(lambda x: x[0], masks)
+            vparts = jax.tree.map(lambda x: x[0], vparts)
+            k, v = k[0], v[0]  # (L, P+1, B, page, H, D)
+            s = jax.lax.axis_index(AXIS_PP)
+
+            offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
+            m_write = jnp.where(active, jnp.arange(M), M)  # inactive → scratch
+            offset_m = offsets_pad[m_write]  # (M,)
+            rows = table[m_write]  # (M, SPG) — inactive rows all-scratch
+            page_ids = jnp.take_along_axis(
+                rows, (offset_m // page)[:, None], axis=1
+            )[:, 0]  # (M,) pool page holding each slot's write position
+            row_pos = offset_m % page
+            # valid prefix incl. the row written this tick; 0 zeroes the
+            # garbage lanes' attention outright
+            lengths = jnp.where(active, offset_m + 1, 0).astype(jnp.int32)
+
+            # B == 1: treat the slot axis as the batch axis, (M, 1) tokens
+            # embed straight to (M, T=1, hidden)
+            h = self._vs_embed(s, vparts, tokens).astype(k.dtype)
+
+            def make_layer(g):
+                def layer(h, p, k_buf, v_buf):
+                    # scatter the M new rows, attend over the pool in place;
+                    # updated pool escapes through ``done`` as the scan ys
+                    # (sp_decode.py's closure idiom)
+                    done = {}
+
+                    def attn_fn(q, k_new, v_new, logit_softcap=None,
+                                sliding_window=None, values_from_k=None):
+                        kl = k_buf[:, 0]  # (P+1, page, Hkv, Dk)
+                        vl = v_buf[:, 0]
+                        kl = kl.at[page_ids, row_pos].set(
+                            k_new[:, 0].astype(kl.dtype)
+                        )
+                        vl = vl.at[page_ids, row_pos].set(
+                            v_new[:, 0].astype(vl.dtype)
+                        )
+                        done["k"], done["v"] = kl[:, None], vl[:, None]
+                        out = paged_attention(
+                            q[:, 0], kl, vl, rows, lengths, model.scale,
+                            logit_softcap=logit_softcap,
+                            sliding_window=sliding_window,
+                            values_from_k=values_from_k,
+                        )
+                        return out[:, None]  # (M, T=1, Hq, Dv)
+
+                    h2, _, _ = model.sp_layer(p, h, offset_m, attn_fn, group=g)
+                    return h2, done["k"], done["v"]
+
+                return layer
+
+            # per-group scans over the stacked layer sub-trees, the pool
+            # sliced to each group's layer range (run_layers' layout)
+            lo = 0
+            k_parts, v_parts = [], []
+            for g in model.sp_groups():
+                if g is not None and g not in layer_params:
+                    continue
+                stack = layer_params if g is None else layer_params[g]
+                mask_g = masks if g is None else masks[g]
+                n_g = jax.tree.leaves(stack)[0].shape[0]
+                h, k_g, v_g = scan_layers(
+                    make_layer(g), h, stack,
+                    k[lo : lo + n_g], v[lo : lo + n_g], mask_g,
+                )
+                k_parts.append(k_g)
+                v_parts.append(v_g)
+                lo += n_g
+            k = jnp.concatenate(k_parts, axis=0) if len(k_parts) > 1 else k_parts[0]
+            v = jnp.concatenate(v_parts, axis=0) if len(v_parts) > 1 else v_parts[0]
+
+            out = jnp.where(active[:, None, None], h, 0).astype(k.dtype)
+            out = jax.lax.psum(out, AXIS_PP)  # identity at S=1; keeps the
+            # body shape identical to the gather one
+            logits = self._vs_head(shared, vparts, out)  # (M, B, V) f32
+            return logits, k[None], v[None]
+
+        spec_stage, spec_rep = P(AXIS_PP), P()
+        return shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(
+                self.layer_specs,
+                jax.tree.map(lambda _: spec_stage, self.layer_masks),
+                jax.tree.map(lambda _: spec_stage, self.vocab_parts),
+                jax.tree.map(lambda _: spec_rep, self.shared_params),
+                spec_rep,  # tokens
+                self._kv_spec,  # k
+                self._kv_spec,  # v
+                spec_rep,  # offsets (M,)
+                spec_rep,  # active (M,)
+                spec_rep,  # n_valid
+                spec_rep,  # page table
+            ),
+            out_specs=(spec_rep, self._kv_spec, self._kv_spec),
+            check_vma=False,
+        )
 
     def _finish_step(self, smapped, t_len: int, with_sampling: bool):
         M, B = self.microbatches, self.batch
@@ -808,7 +982,14 @@ class PipelineEngine:
         if B != 1:
             raise ValueError("continuous batching expects batch=1 per slot")
         if self.paged:
-            inner = self._build_smapped(t_len=1, paged=True)
+            # ragged (default where supported): attend over the page pool in
+            # place; gather: the contiguous _paged_read view. Prefill and the
+            # T=K speculative verify always keep the gather path — chunked
+            # writes want the contiguous buffer.
+            if self.paged_attention == "ragged":
+                inner = self._build_smapped_ragged()
+            else:
+                inner = self._build_smapped(t_len=1, paged=True)
         else:
             if self._smapped_decode is None:
                 self._build_step(t_len=1, with_sampling=True)
@@ -1009,6 +1190,49 @@ class PipelineEngine:
             )
         return self._spec_progs[cache_key]
 
+    def spec_replay_cb(self, K: int):
+        """Replay ``K`` recorded tokens through the dense decode body to
+        advance the KV cache WITHOUT sampling — the scheduler uses this on a
+        draft engine after a tick that fell back to plain (non-speculative)
+        decode: the target advanced K positions, so the draft must ingest the
+        same K tokens or its later proposals attend to stale KV and
+        acceptance silently collapses. Logits are discarded; PRNG keys and
+        repetition windows are untouched (the fallback block already
+        consumed the slot's key chain on the target side). Returns a jitted
+        ``prog(layer_params, masks, vparts, shared, toks (K, M, B), cache,
+        active) -> cache``."""
+        key = ("replay", K)
+        if key not in self._spec_progs:
+            if self.num_stages != 1:
+                raise ValueError(
+                    "speculative continuous batching needs a pp=1 engine"
+                )
+            if self.batch != 1:
+                raise ValueError("continuous batching expects batch=1 per slot")
+            if self.paged:
+                raise ValueError("the draft engine must be dense (no pool_pages)")
+            if self._smapped_decode is None:
+                self._build_step(t_len=1, with_sampling=True)
+            dense = self._smapped_decode
+            one = jnp.asarray(1, jnp.int32)
+
+            def prog(layer_params, masks, vparts, shared, toks, cache, active):
+                def step(carry, tok):
+                    k, v, offsets = carry
+                    _, k, v = dense(
+                        layer_params, masks, vparts, shared, tok, k, v,
+                        offsets, active, one,
+                    )
+                    return (k, v, offsets + active.astype(jnp.int32)), None
+
+                (k, v, offsets), _ = jax.lax.scan(
+                    step, (cache.k, cache.v, cache.offset), toks
+                )
+                return KVCache(k=k, v=v, offset=offsets)
+
+            self._spec_progs[key] = jax.jit(prog, donate_argnums=(5,))
+        return self._spec_progs[key]
+
     def _build_prefill_slot(self):
         """Prefill one chunk of ONE slot's request while other slots' state
         stays untouched — the admit path of continuous batching. S ticks
@@ -1061,7 +1285,7 @@ class PipelineEngine:
             return logits, k[None], v[None]
 
         spec_stage, spec_rep = P(AXIS_PP), P()
-        smapped = jax.shard_map(
+        smapped = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(

@@ -187,8 +187,13 @@ class ContinuousBatcher:
         if self._multi:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from mlx_sharding_tpu.parallel.pipeline import put_global
+
+            # every rank mirrors the same op stream, so the host value being
+            # committed is identical by construction — put_global skips
+            # device_put's cross-host assert broadcast
             rep = NamedSharding(engine.mesh, P())
-            self._put = lambda x: jax.device_put(x, rep)
+            self._put = lambda x: put_global(x, rep)
         else:
             self._put = lambda x: x
         self._row_set = jax.jit(lambda arr, slot, val: arr.at[slot].set(val))
@@ -236,7 +241,11 @@ class ContinuousBatcher:
         )
         if draft_engine is not None:
             self.rounds = 0          # spec telemetry: verify rounds x slots
-            self.accepted_tokens = 0  # tokens emitted by those rounds
+            self.accepted_tokens = 0  # tokens EMITTED by those rounds
+            # ticks that fell back to plain decode (spec paused) and the
+            # tokens replayed through the draft to keep its KV in sync
+            self.fallback_ticks = 0
+            self.replayed_tokens = 0
             self.dcache = draft_engine.init_cache()
             k_ = spec_k
             self._split3 = jax.jit(
@@ -249,6 +258,20 @@ class ContinuousBatcher:
             )
         if self.paged:
             self.cache, self.table = engine.init_cache_paged()
+            # analytic per-tick KV-read accounting (the HBM story behind the
+            # ragged-vs-gather paths): bytes of K+V per token position,
+            # summed over every layer stack — leaf shape is
+            # (S, L, pool+1, B, page, H, D), so S*L*H*D*itemsize per row
+            self.kv_path = getattr(engine, "paged_attention", "gather")
+            self.kv_bytes_read_last_tick = 0
+            self.kv_bytes_read_total = 0
+            self._kv_row_bytes = sum(
+                leaf.shape[0] * leaf.shape[1] * leaf.shape[-2]
+                * leaf.shape[-1] * leaf.dtype.itemsize
+                for leaf in (
+                    jax.tree.leaves(self.cache.k) + jax.tree.leaves(self.cache.v)
+                )
+            )
             self._free_pages = list(range(engine.pool_pages - 1, -1, -1))
             self._pages_of: dict[int, list[int]] = {}  # slot → mapped pages
             self.pages_high_water = 0
@@ -379,6 +402,35 @@ class ContinuousBatcher:
     def _pages_needed(self, n_prompt: int, max_tokens: int) -> int:
         page = self.engine.page_size
         return -(-(n_prompt + max_tokens) // page)
+
+    def kv_read_stats(self) -> Optional[tuple[str, int, int]]:
+        """(attention path, KV bytes read last tick, total) for /metrics;
+        None on dense engines. Analytic, not measured: ragged counts the
+        page-rounded rows each live slot actually occupies, gather counts
+        the full slot_pages-wide contiguous view `_paged_read` materializes
+        per slot per step — the gap between the two numbers is the traffic
+        the ragged kernel deletes."""
+        if not self.paged:
+            return None
+        return (
+            self.kv_path, self.kv_bytes_read_last_tick,
+            self.kv_bytes_read_total,
+        )
+
+    def _account_kv_read(self, live, steps: int, path: Optional[str] = None):
+        if not self.paged or not live:
+            return
+        page = self.engine.page_size
+        if (path or self.kv_path) == "ragged":
+            rows = 0
+            for _, req in live:
+                length = req.prompt.size + max(0, req.produced - 1) + 1
+                rows += -(-length // page) * page
+        else:
+            rows = len(live) * self.engine.slot_pages * page
+        b = rows * self._kv_row_bytes * steps
+        self.kv_bytes_read_last_tick = b
+        self.kv_bytes_read_total += b
 
     def prefix_stats(self) -> Optional[tuple[int, int, int, int, int]]:
         """(queries, hits, tokens reused, evictions, cached pages) for
@@ -835,8 +887,20 @@ class ContinuousBatcher:
                     break
                 victims = [r for r in self._slots if r is not None]
                 if len(victims) <= 1:
-                    break  # only this request left; cap ≤ pool makes this
-                    # unreachable — defensive against accounting drift
+                    # Only this request is left and the pool STILL can't
+                    # cover its next block. cap ≤ pool (generate_step's
+                    # capacity check) makes this unreachable absent
+                    # accounting drift — but silently continuing would
+                    # wedge the request against its scratch-page tail and
+                    # emit garbage forever. Fail it loudly instead.
+                    req.out.put(RuntimeError(
+                        f"KV page pool exhausted: slot {slot} needs "
+                        f"{n_more} more page(s) for its next decode block "
+                        f"but only {len(self._free_pages)} are free and no "
+                        "other request remains to preempt"
+                    ))
+                    self._finish(req)
+                    break
                 self._preempt(max(victims, key=lambda r: r.admit_seq))
 
     def _decode_once(self):
@@ -849,6 +913,10 @@ class ContinuousBatcher:
             if req is not None and self._prefill_done(req)
         ]
         want_lp = any(req.want_logprobs for _, req in live)
+        self._account_kv_read(live, self.decode_block)
+        # the block's first input token, kept so a draft engine can replay
+        # the exact chain the target consumed (see below)
+        prev_tok = self.last_tok
         block = self._decode_block_prog(want_lp)
         outs, self.last_tok, self.cache, self.recent, self.keys = block(
             eng.layer_params, eng.layer_masks, eng.vocab_parts,
@@ -857,6 +925,24 @@ class ContinuousBatcher:
         )
         outs = jax.device_get(outs)
         toks = outs[0]  # (K, M, 1)
+        if self.draft is not None and live:
+            # This tick fell back to plain decode (spec paused — logprobs
+            # wanted, or a slot within K of max_seq): the target just
+            # advanced decode_block positions, so the draft must ingest the
+            # same token chain or its next proposals attend to stale KV and
+            # acceptance silently collapses. Step j of the block consumed
+            # toks[j-1] (step 0 consumed prev_tok), so the replay chain is
+            # [prev_tok, toks[:-1]]. Deterministic device ops only — every
+            # multi-host mirror computes the identical replay in lockstep.
+            prev = np.asarray(jax.device_get(prev_tok))  # (M, 1)
+            chain = np.concatenate([prev[None], np.asarray(toks[:-1])], 0)
+            self.dcache = self.draft.spec_replay_cb(self.decode_block)(
+                self.draft.layer_params, self.draft.layer_masks,
+                self.draft.vocab_parts, self.draft.shared_params,
+                self._put(jnp.asarray(chain)), self.dcache, self.active,
+            )
+            self.fallback_ticks += 1
+            self.replayed_tokens += self.decode_block * len(live)
         for j in range(toks.shape[0]):
             for slot, req in live:
                 if req.slot != slot:  # finished (max_tokens) earlier in block
@@ -912,6 +998,9 @@ class ContinuousBatcher:
         ]
         if not live:
             return
+        # the T=K verify always takes the gather path (chunked writes want
+        # the contiguous buffer), whatever the decode tick uses
+        self._account_kv_read(live, 1, path="gather")
         keys3 = self._split3(self.keys)
         self.keys, dkeys, vkeys = keys3[:, 0], keys3[:, 1], keys3[:, 2]
         drafts, qlps, self.dcache = d.spec_propose_cb(K)(
@@ -932,11 +1021,16 @@ class ContinuousBatcher:
         gs_h = np.asarray(jax.device_get(gs))
         self.rounds += len(live)
         for slot, req in live:
-            self.accepted_tokens += int(counts[slot])
+            emitted = 0
             for j in range(int(counts[slot])):
                 if req.slot != slot:
                     break  # finished (max_tokens) earlier in this round
                 self._emit(req, int(gs_h[j, slot]), None)
+                emitted += 1
+            # count what actually reached the consumer: a slot that hits
+            # max_tokens mid-round drops the rest of its accepted prefix,
+            # and counting those would overstate the acceptance rate
+            self.accepted_tokens += emitted
 
     def _fits(self, req: _Request) -> bool:
         if not self.paged:

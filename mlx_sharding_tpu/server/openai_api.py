@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 import uuid
@@ -109,6 +110,7 @@ class ModelProvider:
         decode_block: int = 16,
         paged_pool: Optional[int] = None,
         page_size: Optional[int] = None,
+        paged_attention: str = "auto",
         admission_policy: str = "fifo",
         overcommit: bool = False,
         draft_model: Optional[str] = None,
@@ -134,6 +136,10 @@ class ModelProvider:
         # reservation admission — see scheduler.ContinuousBatcher
         self.paged_pool = paged_pool
         self.page_size = page_size
+        # decode-attention path over the pool: "ragged" attends in place
+        # (ops/paged_attention.py), "gather" materializes the contiguous
+        # per-slot view, "auto" picks ragged where the engine supports it
+        self.paged_attention = paged_attention
         self.admission_policy = admission_policy
         self.overcommit = overcommit
         self.default_model = default_model
@@ -275,6 +281,7 @@ class ModelProvider:
                             pool_pages=self.paged_pool
                             if self.concurrent > 1 else None,
                             page_size=self.page_size,
+                            paged_attention=self.paged_attention,
                         )
                         if self.concurrent > 1 and not self.multihost:
                             from mlx_sharding_tpu.scheduler import (
@@ -931,6 +938,15 @@ def main(argv=None):
     parser.add_argument("--page-size", type=int, default=None,
                         help="KV page size in tokens (default: the prefill "
                              "chunk); must be a chunk multiple")
+    parser.add_argument("--paged-attention",
+                        choices=("auto", "ragged", "gather"), default="auto",
+                        help="with --paged-pool: decode-attention path over "
+                             "the page pool. 'ragged' attends in place via "
+                             "the slot page tables (no per-tick gather/"
+                             "scatter of the cache), 'gather' keeps the "
+                             "contiguous per-slot view, 'auto' (default) "
+                             "picks ragged where the engine supports it "
+                             "(pp=1, tp=ep=1)")
     parser.add_argument("--admission-policy", choices=("fifo", "first_fit"),
                         default="fifo",
                         help="waiting-line policy when a request doesn't fit "
@@ -1004,6 +1020,16 @@ def main(argv=None):
     if args.coordinator:
         import jax
 
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # CPU ranks (the multi-host tests, or a smoke deployment) need
+            # an explicit cross-process collectives implementation on jax
+            # versions where the CPU backend doesn't default to one
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except Exception:  # noqa: BLE001 — older/newer jax: best effort
+                pass
         jax.distributed.initialize(
             args.coordinator, num_processes=args.num_processes,
             process_id=args.process_id,
@@ -1055,6 +1081,8 @@ def main(argv=None):
         parser.error("--paged-pool requires the fused engine")
     if args.page_size and not args.paged_pool:
         parser.error("--page-size requires --paged-pool")
+    if args.paged_attention != "auto" and not args.paged_pool:
+        parser.error("--paged-attention requires --paged-pool")
     if args.admission_policy != "fifo" and not args.paged_pool:
         parser.error("--admission-policy requires --paged-pool")
     if args.overcommit and not args.paged_pool:
@@ -1073,7 +1101,8 @@ def main(argv=None):
         max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
         chat_template=chat_template, keep_quantized=args.keep_quantized,
         decode_block=args.decode_block, paged_pool=args.paged_pool,
-        page_size=args.page_size, admission_policy=args.admission_policy,
+        page_size=args.page_size, paged_attention=args.paged_attention,
+        admission_policy=args.admission_policy,
         overcommit=args.overcommit,
         draft_model=args.draft_model, spec_k=args.spec_k,
         prompt_cache=args.prompt_cache, replicas=args.replicas,

@@ -143,6 +143,19 @@ class ServingMetrics:
                         "# TYPE mst_preemptions_total counter",
                         f"mst_preemptions_total {b.preemptions}",
                     ]
+                kv = getattr(b, "kv_read_stats", lambda: None)()
+                if kv is not None:
+                    path, last_tick, total_bytes = kv
+                    lines += [
+                        # 1 = ragged in-place paged attention, 0 = the
+                        # gather/scatter path — which kernel decode is on
+                        "# TYPE mst_paged_attention_ragged gauge",
+                        f"mst_paged_attention_ragged {int(path == 'ragged')}",
+                        "# TYPE mst_kv_bytes_read_last_tick gauge",
+                        f"mst_kv_bytes_read_last_tick {last_tick}",
+                        "# TYPE mst_kv_bytes_read_total counter",
+                        f"mst_kv_bytes_read_total {total_bytes}",
+                    ]
                 prefix = getattr(b, "prefix_stats", lambda: None)()
                 if prefix is not None:
                     queries, hits, reused, evictions, cached = prefix
@@ -167,5 +180,19 @@ class ServingMetrics:
                     f"mst_spec_rounds_total {spec.rounds}",
                     "# TYPE mst_spec_tokens_accepted_total counter",
                     f"mst_spec_tokens_accepted_total {spec.accepted_tokens}",
+                ]
+                rounds = max(1, spec.rounds)
+                lines += [
+                    # accepted/rounds collapsing toward 1.0 with fallbacks
+                    # climbing = the draft is stale or mismatched
+                    "# TYPE mst_spec_acceptance_rate gauge",
+                    f"mst_spec_acceptance_rate "
+                    f"{spec.accepted_tokens / rounds:.4f}",
+                    "# TYPE mst_spec_fallback_ticks_total counter",
+                    f"mst_spec_fallback_ticks_total "
+                    f"{getattr(spec, 'fallback_ticks', 0)}",
+                    "# TYPE mst_spec_tokens_replayed_total counter",
+                    f"mst_spec_tokens_replayed_total "
+                    f"{getattr(spec, 'replayed_tokens', 0)}",
                 ]
         return "\n".join(lines) + "\n"

@@ -125,3 +125,35 @@ def test_qwen2_bias_parity():
             params, jnp.asarray(tokens, jnp.int32), model.make_cache(1, 16, jnp.float32)
         )
         np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_control_plane_concurrent_exchanges_do_not_cross_replies(monkeypatch):
+    """ControlPlane.exchange's timed path (submit to the broadcast thread,
+    collect the reply) must be atomic: two callers racing it could collect
+    each other's broadcast results (or spawn duplicate broadcast threads).
+    A slow fake broadcast makes the race window wide; every caller must get
+    its own header back."""
+    import threading
+    import time as _time
+
+    from mlx_sharding_tpu.parallel.multihost import ControlPlane
+
+    def slow_echo(buf):
+        _time.sleep(0.01)
+        return buf
+
+    monkeypatch.setattr(ControlPlane, "_broadcast", staticmethod(slow_echo))
+    plane = ControlPlane(max_prompt=8, timeout_s=30)
+    results = {}
+
+    def caller(i):
+        out = plane.exchange({"header": np.full(8, i, np.int32)})
+        results[i] = int(out["header"][0])
+
+    threads = [threading.Thread(target=caller, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert results == {i: i for i in range(8)}
+    assert not plane.dead

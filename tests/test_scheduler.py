@@ -12,6 +12,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 pytestmark = pytest.mark.quick
@@ -598,3 +599,121 @@ def test_prefix_cache_own_chain_not_evicted_under_pressure():
         assert batcher.prefix_stats()[1] >= 1  # the chain WAS reused
     finally:
         batcher.close()
+
+
+# ------------------------------------------------ ragged paged decode (ISSUE 1)
+def _ragged_batcher(paged_attention, pool_pages=10, **kw):
+    """pp=1 paged engine: the only wiring the ragged in-place attention path
+    supports (ops/paged_attention.py via the vectorized decode body)."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(1), microbatches=3, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+        pool_pages=pool_pages, page_size=8, paged_attention=paged_attention,
+    )
+    ref = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    return ContinuousBatcher(eng, decode_block=3, **kw), ref
+
+
+def test_ragged_mixed_length_cb_matches_serial():
+    """Mixed-length concurrent run on the ragged path (pool attended in
+    place, per-slot lengths masked in-kernel): every stream token-exact vs
+    its solo serial run, and the KV accounting reports the ragged path."""
+    batcher, ref = _ragged_batcher("ragged")
+    try:
+        assert batcher.engine.paged_attention == "ragged"
+        rng = np.random.default_rng(3)
+        jobs = []
+        for i, plen in enumerate([2, 8, 11, 19]):  # straddle page boundaries
+            prompt = [int(t) for t in rng.integers(1, 256, size=plen)]
+            jobs.append((prompt, dict(max_tokens=5 + 2 * i, seed=i,
+                                      temperature=0.6)))
+        want = [_run(ref, p, **kw) for p, kw in jobs]
+        got, _ = _concurrent(batcher, jobs)
+        assert got == want
+        path, last_tick, total = batcher.kv_read_stats()
+        assert path == "ragged" and total > 0
+    finally:
+        batcher.close()
+
+
+def test_kv_read_accounting_ragged_below_gather():
+    """Same short run on both paths: the ragged analytic KV-bytes-read must
+    come in strictly below gather's (gather always reads every slot's full
+    slot_pages regardless of true length)."""
+    totals = {}
+    for path in ("ragged", "gather"):
+        batcher, _ = _ragged_batcher(path)
+        try:
+            _run(batcher, [5, 3], max_tokens=8)
+            totals[path] = batcher.kv_read_stats()[2]
+        finally:
+            batcher.close()
+    assert 0 < totals["ragged"] < totals["gather"]
+
+
+def test_overcommit_pool_exhaustion_errors_not_wedges():
+    """If the pool truly cannot cover a lone request's next decode block
+    (only reachable through accounting drift), the request must FAIL with a
+    loud error, not wedge against the scratch page emitting garbage. Drift
+    is simulated by vanishing the free list mid-decode."""
+    batcher, _ = _paged_batcher(pool_pages=4, overcommit=True)
+    try:
+        gen = batcher.generate_step([5, 9], max_tokens=24)  # 4-page full need
+        next(gen)  # prefill done, decode under way
+        batcher._free_pages = []  # simulate the drift: pool gone
+        with pytest.raises(RuntimeError, match="pool exhausted"):
+            for _ in gen:
+                pass
+    finally:
+        batcher.close()
+
+
+@pytest.fixture(scope="module")
+def spec_perfect():
+    """draft == target: every proposal verifies, so acceptance statistics
+    become deterministic signal instead of noise."""
+    batcher, ref = _spec_batcher(draft_seed=0)
+    yield batcher, ref
+    batcher.close()
+
+
+def test_spec_accepted_counts_only_emitted(spec_perfect):
+    """accepted_tokens is throughput telemetry: a final round whose accepted
+    run overshoots the request's remaining budget must count only what was
+    emitted, not the whole run."""
+    batcher, ref = spec_perfect
+    a0 = batcher.accepted_tokens
+    out = _run(batcher, [4, 2], max_tokens=2)  # 1 prefill + 1 spec token
+    assert out == _run(ref, [4, 2], max_tokens=2)
+    assert batcher.accepted_tokens - a0 == max(0, len(out) - 1)
+
+
+def test_spec_draft_replay_after_fallback_keeps_acceptance(spec_perfect):
+    """A want_logprobs neighbor forces non-speculative ticks for EVERY live
+    slot; the draft must be replayed through those emitted tokens or its KV
+    desyncs and acceptance collapses once speculation resumes. With a
+    perfect draft, post-fallback rounds must keep accepting multiple tokens
+    per round."""
+    batcher, ref = spec_perfect
+    f0, p0 = batcher.fallback_ticks, batcher.replayed_tokens
+    r0, a0 = batcher.rounds, batcher.accepted_tokens
+    jobs = [
+        ([3, 1, 4], dict(max_tokens=8, want_logprobs=True)),
+        ([5, 2, 6], dict(max_tokens=24)),  # outlives the logprobs neighbor
+    ]
+    got, _ = _concurrent(batcher, jobs)
+    assert got[0] == _run(ref, [3, 1, 4], max_tokens=8)
+    assert got[1] == _run(ref, [5, 2, 6], max_tokens=24)
+    assert batcher.fallback_ticks > f0  # the fallback ticks really happened
+    assert batcher.replayed_tokens > p0  # and the draft replayed through them
+    rounds = batcher.rounds - r0
+    accepted = batcher.accepted_tokens - a0
+    assert rounds > 0
+    # a desynced draft degenerates to ~1 accepted/round; the replayed one
+    # keeps the perfect draft's multi-token acceptance
+    assert accepted >= 2 * rounds
