@@ -300,6 +300,8 @@ class Generator:
         key = jax.random.PRNGKey(int(time.time_ns()) & 0x7FFFFFFF if seed is None else seed)
         prompt = np.asarray(prompt_tokens, np.int32).reshape(self.batch, -1)
         n_prompt = prompt.shape[1]
+        if n_prompt == 0:
+            raise ValueError("empty prompt")
         if n_prompt + max_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({n_prompt}) + max_tokens ({max_tokens}) exceeds KV "

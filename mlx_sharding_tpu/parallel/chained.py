@@ -135,6 +135,10 @@ class ChainedPipeline:
         )
         prompt = np.asarray(prompt_tokens, np.int32).reshape(self.batch, -1)
         n_prompt = prompt.shape[1]
+        if n_prompt == 0:
+            # without this the prefill loop below never runs and the sample
+            # call crashes on logits=None — reject at entry instead
+            raise ValueError("empty prompt")
         if n_prompt + max_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({n_prompt}) + max_tokens ({max_tokens}) exceeds KV "

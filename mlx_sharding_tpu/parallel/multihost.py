@@ -40,6 +40,7 @@ from mlx_sharding_tpu.sample import (
     init_recent_tokens,
     make_sampler_params,
 )
+from mlx_sharding_tpu.testing.faults import inject
 
 
 class WorkerTimeoutError(RuntimeError):
@@ -168,6 +169,18 @@ class ControlPlane:
         Everyone gets rank 0's message back as host numpy. Raises
         :class:`WorkerTimeoutError` (rank 0) when a peer doesn't show up
         within the liveness budget, and instantly once the plane is dead."""
+        try:
+            # fault harness: a raise here simulates a collective whose peer
+            # never arrives (faults.DropExchange) — same conclusion as a
+            # timeout, detected instantly
+            inject("multihost.exchange")
+        except Exception as e:  # noqa: BLE001 — any injected failure means
+            # the plane can no longer be trusted; normalize like a timeout
+            self.dead = True
+            raise WorkerTimeoutError(
+                "multi-host collective dropped (injected fault) — marking "
+                "the control plane down (restart the deployment)"
+            ) from e
         buf = self._zeros()
         if msg is not None:
             for k, v in msg.items():

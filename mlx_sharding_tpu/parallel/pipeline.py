@@ -1354,6 +1354,10 @@ class PipelineEngine:
         prompt = np.asarray(prompt_tokens, np.int32).reshape(1, 1, -1)
         prompt = np.broadcast_to(prompt, (M, B, prompt.shape[-1]))
         n_prompt = prompt.shape[-1]
+        if n_prompt == 0:
+            # the prefill loop below would be skipped and the first sample
+            # would crash on logits=None — reject at entry instead
+            raise ValueError("empty prompt")
         if n_prompt + max_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({n_prompt}) + max_tokens ({max_tokens}) exceeds KV "

@@ -12,12 +12,29 @@ ref: shard/openai_api.py:543-563).
 Each replica holds its own copy of the weights (device_put onto its own
 mesh by PipelineEngine) and its own KV state; requests never migrate, so
 per-request streams are exactly what the replica alone would produce.
+
+Resilience: the dispatcher is also the failure domain boundary. A replica
+that keeps failing dispatches is circuit-broken out of routing (consecutive
+failures ≥ ``breaker_threshold`` opens the breaker for ``probe_interval``
+seconds; after that ONE live request is let through as a half-open probe —
+success closes the breaker, failure re-opens it). Requests that fail before
+their first token retry on another replica; started streams never migrate
+(their KV lives on the failed replica). While at least one replica lives the
+set keeps serving and ``health()`` reports degraded, not dead.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
+
+from mlx_sharding_tpu.resilience import (
+    QueueFullError,
+    ReplicasUnavailableError,
+    RequestTimeoutError,
+)
+from mlx_sharding_tpu.testing.faults import inject
 
 
 class ReplicaSet:
@@ -26,16 +43,33 @@ class ReplicaSet:
     Routing: least in-flight requests, ties to the lowest index — a
     deterministic, state-light policy (no cross-replica queues; a replica's
     own ContinuousBatcher provides intra-replica queueing when built with
-    ``--concurrent``)."""
+    ``--concurrent``). Circuit-broken replicas are skipped; a half-open
+    replica receives at most one probe request at a time."""
 
     concurrent = True  # the server must not serialize requests around us
 
-    def __init__(self, replicas: list):
+    def __init__(self, replicas: list, *, breaker_threshold: int = 3,
+                 probe_interval: float = 5.0):
         if not replicas:
             raise ValueError("ReplicaSet needs at least one replica")
+        if breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if probe_interval <= 0:
+            raise ValueError("probe_interval must be positive")
         self.replicas = list(replicas)
-        self._inflight = [0] * len(self.replicas)
-        self.served = [0] * len(self.replicas)  # lifetime request counts
+        self.breaker_threshold = breaker_threshold
+        self.probe_interval = probe_interval
+        n = len(self.replicas)
+        self._inflight = [0] * n
+        self.served = [0] * n  # lifetime dispatch counts (retries included)
+        self.failures = [0] * n  # lifetime dispatch failures
+        self.breaker_opens = [0] * n  # closed→open transitions
+        self._fails_consec = [0] * n
+        # monotonic stamp until which the breaker holds the replica out of
+        # routing; 0 = closed. Past the stamp the replica is HALF-OPEN: one
+        # request may probe it (_probing guards against a probe stampede).
+        self._open_until = [0.0] * n
+        self._probing = [False] * n
         self._lock = threading.Lock()
         # non-concurrent replicas (plain engines) serve one request at a
         # time each; per-replica locks replace the server's global one
@@ -44,9 +78,44 @@ class ReplicaSet:
             for r in self.replicas
         ]
 
-    def _pick(self) -> int:
+    @property
+    def supports_deadlines(self) -> bool:
+        """Deadline kwargs can be forwarded only when every replica
+        understands them (mixed sets would crash on the plain engines)."""
+        return all(
+            getattr(r, "supports_deadlines", False) for r in self.replicas
+        )
+
+    # ------------------------------------------------------------- routing
+    def _breaker_state(self, j: int, now: float) -> str:
+        if self._open_until[j] == 0:
+            return "closed"
+        return "half_open" if now >= self._open_until[j] else "open"
+
+    def _pick(self, exclude=()) -> int:
         with self._lock:
-            i = min(range(len(self.replicas)), key=lambda j: self._inflight[j])
+            now = time.monotonic()
+            closed, half_open = [], []
+            for j in range(len(self.replicas)):
+                if j in exclude:
+                    continue
+                state = self._breaker_state(j, now)
+                if state == "closed":
+                    closed.append(j)
+                elif state == "half_open" and not self._probing[j]:
+                    half_open.append(j)
+            if half_open:
+                # recovery beats load balance: route this request as the
+                # probe, or an idle fleet would never close the breaker
+                i = half_open[0]
+                self._probing[i] = True
+            elif closed:
+                i = min(closed, key=lambda j: self._inflight[j])
+            else:
+                raise ReplicasUnavailableError(
+                    "no replica available: every replica is circuit-broken "
+                    "or already failed this request"
+                )
             self._inflight[i] += 1
             self.served[i] += 1
             return i
@@ -55,19 +124,74 @@ class ReplicaSet:
         with self._lock:
             self._inflight[i] -= 1
 
+    def _record_success(self, i: int):
+        with self._lock:
+            self._fails_consec[i] = 0
+            self._open_until[i] = 0.0
+            self._probing[i] = False
+
+    def _record_failure(self, i: int):
+        with self._lock:
+            self.failures[i] += 1
+            self._fails_consec[i] += 1
+            self._probing[i] = False
+            now = time.monotonic()
+            if self._open_until[i] > 0:
+                # failed half-open probe: straight back to open
+                self._open_until[i] = now + self.probe_interval
+            elif self._fails_consec[i] >= self.breaker_threshold:
+                self._open_until[i] = now + self.probe_interval
+                self.breaker_opens[i] += 1
+
     def generate_step(self, prompt_tokens, **kw):
-        i = self._pick()
-        try:
-            serial = self._serial_locks[i]
-            if serial is not None:
-                with serial:
-                    yield from self.replicas[i].generate_step(
+        excluded: set[int] = set()
+        last_exc: Optional[BaseException] = None
+        while True:
+            try:
+                i = self._pick(excluded)
+            except ReplicasUnavailableError:
+                if last_exc is not None:
+                    raise last_exc  # the concrete failure beats the generic 503
+                raise
+            started = False
+            try:
+                inject("replica.dispatch", replica=i)
+                serial = self._serial_locks[i]
+                if serial is not None:
+                    with serial:
+                        for item in self.replicas[i].generate_step(
+                            prompt_tokens, **kw
+                        ):
+                            started = True
+                            yield item
+                else:
+                    for item in self.replicas[i].generate_step(
                         prompt_tokens, **kw
-                    )
-            else:
-                yield from self.replicas[i].generate_step(prompt_tokens, **kw)
-        finally:
-            self._done(i)
+                    ):
+                        started = True
+                        yield item
+                self._record_success(i)
+                return
+            except ValueError:
+                raise  # bad request — the replica is healthy
+            except QueueFullError as exc:
+                # saturation, not sickness: no breaker penalty, but try the
+                # other replicas before giving the client a 429
+                excluded.add(i)
+                last_exc = exc
+            except RequestTimeoutError:
+                # the request's own budget is spent — a retry would only
+                # blow it further; the replica still takes the health strike
+                self._record_failure(i)
+                raise
+            except Exception as exc:  # noqa: BLE001 — any replica-side crash
+                self._record_failure(i)
+                if started:
+                    raise  # tokens were delivered; streams never migrate
+                excluded.add(i)
+                last_exc = exc
+            finally:
+                self._done(i)
 
     # ------------------------------------------------------- observability
     def stats(self):
@@ -91,6 +215,55 @@ class ReplicaSet:
         if not totals:
             return None
         return tuple(sum(col) for col in zip(*totals))
+
+    def resilience_stats(self) -> dict:
+        """Deadline/shedding counters summed across replica batchers."""
+        agg = {"timeouts": 0, "shed_queue_full": 0, "shed_deadline": 0,
+               "max_queue": None, "scheduler_thread_live": True}
+        for r in self.replicas:
+            if not hasattr(r, "resilience_stats"):
+                continue
+            s = r.resilience_stats()
+            agg["timeouts"] += s["timeouts"]
+            agg["shed_queue_full"] += s["shed_queue_full"]
+            agg["shed_deadline"] += s["shed_deadline"]
+            if s["max_queue"] is not None:
+                agg["max_queue"] = (agg["max_queue"] or 0) + s["max_queue"]
+            agg["scheduler_thread_live"] = (
+                agg["scheduler_thread_live"] and s["scheduler_thread_live"]
+            )
+        return agg
+
+    def health(self) -> dict:
+        """Partial-capacity health: degraded (still serving) while at least
+        one replica lives, dead only when none do."""
+        with self._lock:
+            now = time.monotonic()
+            states = [
+                self._breaker_state(j, now) for j in range(len(self.replicas))
+            ]
+            consec = list(self._fails_consec)
+            fails = list(self.failures)
+        per, live = [], 0
+        for j, r in enumerate(self.replicas):
+            entry = {"replica": j, "breaker": states[j],
+                     "consecutive_failures": consec[j], "failures": fails[j]}
+            sub = r.health() if hasattr(r, "health") else None
+            alive = states[j] != "open"
+            if sub is not None:
+                entry["engine"] = sub["status"]
+                alive = alive and sub["serving"]
+            if alive:
+                live += 1
+            per.append(entry)
+        n = len(self.replicas)
+        return {
+            "status": "ok" if live == n else "degraded",
+            "serving": live >= 1,
+            "replicas_total": n,
+            "replicas_live": live,
+            "replicas": per,
+        }
 
     def close(self):
         for r in self.replicas:

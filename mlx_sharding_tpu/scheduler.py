@@ -31,8 +31,10 @@ keys, so their token-exactness holds unconditionally.
 from __future__ import annotations
 
 import hashlib
+import logging
 import queue
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
@@ -43,6 +45,12 @@ import numpy as np
 
 from mlx_sharding_tpu.cache import KVCache
 from mlx_sharding_tpu.generate import block_lp_outputs, block_token_logprobs
+from mlx_sharding_tpu.resilience import (
+    Deadlines,
+    QueueFullError,
+    RequestTimeoutError,
+)
+from mlx_sharding_tpu.testing.faults import inject
 from mlx_sharding_tpu.sample import (
     SamplerParams,
     make_sampler_params,
@@ -61,6 +69,9 @@ class _Request:
     want_logprobs: bool = False
     out: queue.Queue = field(default_factory=lambda: queue.Queue())
     cancelled: bool = False
+    # per-request deadlines (resilience.Deadlines) — None = unbounded, the
+    # seed behavior; host-side only, never broadcast to worker mirrors
+    deadlines: Optional[Deadlines] = None
     slot: int = -1
     produced: int = 0
     prefill_pos: int = 0  # next prompt index to prefill; admission is chunked
@@ -101,12 +112,19 @@ class ContinuousBatcher:
     """
 
     concurrent = True
+    # generate_step accepts request_timeout/ttft_timeout/stall_timeout and
+    # enforces them scheduler-side; the server checks this attr before
+    # forwarding deadline kwargs (plain Generator/PipelineEngine lack them)
+    supports_deadlines = True
 
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
                  policy: str = "fifo", prefix_cache: bool = False,
-                 overcommit: bool = False, draft_engine=None, spec_k: int = 4):
+                 overcommit: bool = False, draft_engine=None, spec_k: int = 4,
+                 max_queue: Optional[int] = None):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
+        if max_queue is not None and (not isinstance(max_queue, int) or max_queue < 1):
+            raise ValueError(f"max_queue must be a positive int, got {max_queue!r}")
         if draft_engine is not None:
             # speculative x continuous batching: the draft engine mirrors the
             # target's slot structure (same M, same chunking) with its own
@@ -175,6 +193,17 @@ class ContinuousBatcher:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
+        # Admission control: generate_step rejects (QueueFullError → HTTP
+        # 429) when queued requests reach max_queue, instead of letting the
+        # unbounded submit queue grow without limit under overload.
+        self.max_queue = max_queue
+        # resilience counters (read by /metrics via resilience_stats)
+        self.timeouts = 0        # consumer-side deadline expiries
+        self.shed_queue_full = 0  # rejected at admission (429)
+        self.shed_deadline = 0   # shed while queued: TTFT budget already gone
+        # close() flips this when the scheduler thread fails to join —
+        # /health reports degraded and the thread-live gauge drops to 0
+        self.thread_wedged = False
 
         # Multi-controller discipline (multi-host serving mirrors this
         # scheduler on every rank): host-built inputs must be committed as
@@ -328,10 +357,17 @@ class ContinuousBatcher:
         seed: Optional[int] = None,
         max_tokens: int = 256,
         want_logprobs: bool = False,  # yields TokenLogprobs summaries
+        request_timeout: Optional[float] = None,  # submit → last token budget
+        ttft_timeout: Optional[float] = None,     # submit → first token budget
+        stall_timeout: Optional[float] = None,    # inter-token watchdog
     ):
-        import time as _time
-
+        # Eager validation/admission, lazy consumption: every rejection
+        # (bad params, queue full) raises on the CALLING thread before any
+        # request state exists — the server can answer 400/429 before it has
+        # committed to a streaming response. Only the token loop is deferred.
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
         if prompt.size + max_tokens > self.engine.max_seq:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_tokens ({max_tokens}) exceeds "
@@ -357,13 +393,29 @@ class ContinuousBatcher:
                 f"repetition_context_size {repetition_context_size} exceeds "
                 f"the scheduler's window {self.W}"
             )
+        deadlines = (
+            Deadlines.start(
+                ttft_timeout=ttft_timeout,
+                request_timeout=request_timeout,
+                stall_timeout=stall_timeout,
+            )
+            if any(v is not None
+                   for v in (ttft_timeout, request_timeout, stall_timeout))
+            else None
+        )
+        if self.max_queue is not None:
+            depth = self._submit.qsize() + len(self._waiting)
+            if depth >= self.max_queue:
+                self.shed_queue_full += 1
+                raise QueueFullError(depth, self.max_queue)
         req = _Request(
             prompt=prompt,
             sp=sp,
-            seed=int(_time.time_ns()) & 0x7FFFFFFF if seed is None else seed,
+            seed=int(time.time_ns()) & 0x7FFFFFFF if seed is None else seed,
             max_tokens=max_tokens,
             rep_context=min(repetition_context_size, self.W),
             want_logprobs=want_logprobs,
+            deadlines=deadlines,
             temperature=temperature,
             top_p=top_p,
             repetition_penalty=repetition_penalty,
@@ -371,13 +423,56 @@ class ContinuousBatcher:
         )
         self._ensure_running()
         self._submit.put(req)
+        return self._consume(req)
+
+    def _consume(self, req: _Request):
+        """Token stream for a submitted request. Waits are bounded by the
+        request's deadlines: TTFT before the first token, the inter-token
+        watchdog after it, and the total budget throughout — whichever
+        expires first. Expiry flips ``cancelled`` (the scheduler reclaims
+        the slot/pages on its next tick, even a wedged one once it revives)
+        and raises the structured error immediately, so a consumer never
+        blocks forever on a dead engine."""
+        dl = req.deadlines
+        first = True
         try:
             while True:
-                item = req.out.get()
+                kind, timeout = None, None
+                if dl is not None:
+                    now = time.monotonic()
+                    cands = []
+                    if first and dl.ttft_deadline is not None:
+                        cands.append(("ttft", dl.ttft_deadline - now))
+                    if dl.total_deadline is not None:
+                        cands.append(("total", dl.total_deadline - now))
+                    if not first and dl.stall_timeout is not None:
+                        cands.append(("stall", dl.stall_timeout))
+                    if cands:
+                        kind, timeout = min(cands, key=lambda t: t[1])
+                        timeout = max(0.0, timeout)
+                try:
+                    item = (
+                        req.out.get(timeout=timeout)
+                        if timeout is not None
+                        else req.out.get()
+                    )
+                except queue.Empty:
+                    req.cancelled = True
+                    self.timeouts += 1
+                    now = time.monotonic()
+                    budget = (
+                        dl.stall_timeout if kind == "stall"
+                        else (dl.ttft_deadline if kind == "ttft"
+                              else dl.total_deadline) - dl.submitted_at
+                    )
+                    raise RequestTimeoutError(
+                        kind, now - dl.submitted_at, budget
+                    ) from None
                 if item is None:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                first = False
                 yield item
         finally:
             req.cancelled = True  # scheduler reclaims the slot next tick
@@ -390,6 +485,40 @@ class ContinuousBatcher:
             sum(1 for r in self._slots if r is not None),
             self._submit.qsize() + len(self._waiting),
         )
+
+    def scheduler_thread_live(self) -> bool:
+        """True while the scheduler thread is healthy: running, cleanly
+        stopped, or not yet started. False only after close() observed a
+        join timeout (a tick wedged mid-device-op)."""
+        if self.thread_wedged:
+            return False
+        t = self._thread
+        return t is None or t.is_alive() or self._stop
+
+    def resilience_stats(self) -> dict:
+        """Deadline/shedding counters + queue bound for /metrics."""
+        return {
+            "timeouts": self.timeouts,
+            "shed_queue_full": self.shed_queue_full,
+            "shed_deadline": self.shed_deadline,
+            "max_queue": self.max_queue,
+            "scheduler_thread_live": self.scheduler_thread_live(),
+        }
+
+    def health(self) -> dict:
+        """Serving health for the /health endpoint: ``status`` in
+        ok/degraded/draining, ``serving`` decides 200 vs 503."""
+        live = self.scheduler_thread_live()
+        if not live:
+            # a wedged thread (even one noticed during close) beats draining:
+            # the operator needs to see the leak, not a polite shutdown
+            return {"status": "degraded", "serving": False,
+                    "scheduler_thread_live": False}
+        if self._stop:
+            return {"status": "draining", "serving": False,
+                    "scheduler_thread_live": live}
+        return {"status": "ok", "serving": True,
+                "scheduler_thread_live": live}
 
     def page_stats(self) -> Optional[tuple[int, int, int]]:
         """(pool pages, pages in use, high-water mark) for /metrics — the
@@ -522,11 +651,22 @@ class ContinuousBatcher:
             else:
                 self._page_ref[p] = r
 
-    def close(self):
+    def close(self, timeout: float = 10.0):
         self._stop = True
         if self._thread is not None:
             self._submit.put(None)  # wake the idle wait
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # a tick is wedged (stuck device op / injected fault): the
+                # daemon thread can't be reclaimed, so record the leak —
+                # /health flips to degraded and mst_scheduler_thread_live
+                # drops to 0 instead of pretending the close succeeded
+                self.thread_wedged = True
+                logging.getLogger(__name__).error(
+                    "scheduler thread failed to exit within %.0fs — a tick "
+                    "is wedged; the thread is abandoned (daemon) and /health "
+                    "now reports degraded", timeout,
+                )
 
     # ------------------------------------------------------------ internals
     def _ensure_running(self):
@@ -1051,6 +1191,25 @@ class ContinuousBatcher:
         """Admit from the waiting line into free slots under the admission
         policy. fifo: strict order, a non-fitting head blocks the line.
         first_fit: scan past non-fitting requests (they keep their place)."""
+        # Shed queued requests whose TTFT budget is already gone: prefilling
+        # them would be wasted work (the consumer has timed out or is about
+        # to). Host-local decision — nothing was broadcast for an unassigned
+        # request, so worker mirrors never knew it existed.
+        if self._waiting:
+            now = time.monotonic()
+            for req in [
+                r for r in self._waiting
+                if not r.cancelled and r.deadlines is not None
+                and r.deadlines.ttft_deadline is not None
+                and now > r.deadlines.ttft_deadline
+            ]:
+                self._waiting.remove(req)
+                self.shed_deadline += 1
+                req.cancelled = True
+                req.out.put(RequestTimeoutError(
+                    "queue", now - req.deadlines.submitted_at,
+                    req.deadlines.ttft_deadline - req.deadlines.submitted_at,
+                ))
         # reap dead waiters first — under fifo a non-fitting head would
         # otherwise shadow a cancelled request behind it forever
         for req in [r for r in self._waiting if r.cancelled]:
@@ -1089,6 +1248,7 @@ class ContinuousBatcher:
         latency for long prompts trades against decode jitter bounded at
         one chunk per block. With nothing decoding, all admitting requests
         advance at full rate."""
+        inject("scheduler.tick")  # fault harness: wedge/delay/fail a tick
         self._reap_cancelled()
         self._drain_submissions()
         self._admit_waiting()

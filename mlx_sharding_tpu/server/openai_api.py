@@ -34,6 +34,12 @@ from typing import Optional
 import numpy as np
 
 from mlx_sharding_tpu.generate import TokenLogprobs
+from mlx_sharding_tpu.resilience import (
+    QueueFullError,
+    ReplicasUnavailableError,
+    RequestTimeoutError,
+)
+from mlx_sharding_tpu.testing.faults import inject
 from mlx_sharding_tpu.tokenizer_utils import (
     StreamingDetokenizer,
     sequence_overlap,
@@ -117,7 +123,11 @@ class ModelProvider:
         spec_k: int = 4,
         prompt_cache: bool = False,
         replicas: int = 1,
+        max_queue: Optional[int] = None,
     ):
+        # admission control: per-batcher bound on queued requests; a full
+        # queue rejects with QueueFullError (HTTP 429 + Retry-After)
+        self.max_queue = max_queue
         # data-parallel serving: R independent engine replicas, each on its
         # own slice of jax.devices(), least-loaded request routing
         self.replicas = max(1, replicas)
@@ -308,6 +318,7 @@ class ModelProvider:
                                 overcommit=self.overcommit,
                                 draft_engine=draft_eng,
                                 spec_k=self.spec_k,
+                                max_queue=self.max_queue,
                             )
                         return engine
 
@@ -337,6 +348,7 @@ class ModelProvider:
                                 decode_block=min(8, self.decode_block),
                                 policy=self.admission_policy,
                                 prefix_cache=self.prefix_cache_enabled,
+                                max_queue=self.max_queue,
                             )
                         else:
                             from mlx_sharding_tpu.parallel.multihost import (
@@ -392,6 +404,10 @@ class APIHandler(BaseHTTPRequestHandler):
     metrics: ServingMetrics = None
     profile_dir: Optional[str] = None
     api_key: Optional[str] = None
+    # server-wide deadline defaults (--request-timeout / --ttft-timeout);
+    # per-request body fields override them
+    request_timeout: Optional[float] = None
+    ttft_timeout: Optional[float] = None
     protocol_version = "HTTP/1.1"
 
     # ------------------------------------------------------------- helpers
@@ -404,24 +420,34 @@ class APIHandler(BaseHTTPRequestHandler):
         self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
         self.send_header("Access-Control-Allow-Headers", "Content-Type, Authorization")
 
-    def _json(self, code: int, payload: dict):
+    def _json(self, code: int, payload: dict,
+              extra_headers: Optional[dict] = None):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self._cors()
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code: int, message: str):
+    def _error(self, code: int, message: str,
+               extra_headers: Optional[dict] = None):
         # OpenAI error envelope with a type that reflects the status class,
         # so clients can distinguish bad requests from engine failures.
         kind = (
             "invalid_request_error" if code == 400
             else "not_found_error" if code == 404
+            else "overloaded_error" if code == 429
+            else "service_unavailable_error" if code == 503
+            else "timeout_error" if code == 504
             else "server_error"
         )
-        self._json(code, {"error": {"message": message, "type": kind, "code": code}})
+        self._json(
+            code, {"error": {"message": message, "type": kind, "code": code}},
+            extra_headers=extra_headers,
+        )
 
     # ------------------------------------------------------------- routing
     def do_OPTIONS(self):
@@ -435,25 +461,36 @@ class APIHandler(BaseHTTPRequestHandler):
         if path in ("/", "/index.html"):
             path = "/index.html"
         elif path == "/health":
-            # multi-host deployments surface control-plane liveness: every
-            # completed collective proves all ranks were alive at that
-            # moment; a timed-out one marks the plane dead (multihost.py
-            # ControlPlane) and health goes degraded with a 503
-            ctrl = getattr(self.provider.generator, "ctrl", None)
-            if ctrl is None:
-                return self._json(200, {"status": "ok"})
-            import time as _time
+            # Layered health: the generator's own view (scheduler thread
+            # liveness / per-replica circuit state — ok, degraded, draining)
+            # plus multi-host control-plane liveness. ``serving`` decides the
+            # status code: partial capacity (some replicas circuit-broken,
+            # ≥1 alive) is degraded WITH a 200 — degraded, not dead; a
+            # wedged scheduler, drained server, or dead control plane is a
+            # 503.
+            gen = self.provider.generator
+            payload, serving = {"status": "ok"}, True
+            if hasattr(gen, "health"):
+                payload = dict(gen.health())
+                serving = bool(payload.pop("serving", True))
+            ctrl = getattr(gen, "ctrl", None)
+            if ctrl is not None:
+                # a timed-out collective marks the plane dead (multihost.py
+                # ControlPlane); every completed one proves all ranks alive
+                import time as _time
 
-            last = getattr(ctrl, "last_ok", None)
-            mh = {
-                "workers_responsive": not getattr(ctrl, "dead", False),
-                "last_exchange_s_ago": (
-                    None if last is None else round(_time.monotonic() - last, 1)
-                ),
-            }
-            if getattr(ctrl, "dead", False):
-                return self._json(503, {"status": "degraded", "multihost": mh})
-            return self._json(200, {"status": "ok", "multihost": mh})
+                last = getattr(ctrl, "last_ok", None)
+                payload["multihost"] = {
+                    "workers_responsive": not getattr(ctrl, "dead", False),
+                    "last_exchange_s_ago": (
+                        None if last is None
+                        else round(_time.monotonic() - last, 1)
+                    ),
+                }
+                if getattr(ctrl, "dead", False):
+                    payload["status"] = "degraded"
+                    serving = False
+            return self._json(200 if serving else 503, payload)
         elif path == "/metrics":
             body = self.metrics.render().encode()
             self.send_response(200)
@@ -533,8 +570,28 @@ class APIHandler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
         try:
             handlers[route](body, params, generator, tokenizer)
-        except BrokenPipeError:
-            pass
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; _generate's close already cancelled
+            # the in-flight request (the scheduler reclaims its slot/pages)
+        except QueueFullError as e:
+            # load shed at admission: the queue bound was hit before any
+            # work was spent; tell the client when to come back
+            try:
+                self._error(429, str(e), extra_headers={
+                    "Retry-After": str(max(1, round(e.retry_after_s))),
+                })
+            except Exception:
+                pass
+        except RequestTimeoutError as e:
+            try:
+                self._error(504, str(e))
+            except Exception:
+                pass
+        except ReplicasUnavailableError as e:
+            try:
+                self._error(503, str(e))
+            except Exception:
+                pass
         except ValueError as e:  # bad request discovered late (e.g. KV capacity)
             try:
                 self._error(400, str(e))
@@ -596,6 +653,15 @@ class APIHandler(BaseHTTPRequestHandler):
             raise ValueError("stop must be a string or list of strings")
         p["stop_words"] = stop
         p["seed"] = body.get("seed")
+        # per-request deadline overrides; None falls back to the server-wide
+        # --request-timeout / --ttft-timeout defaults
+        for key in ("request_timeout", "ttft_timeout"):
+            v = body.get(key)
+            if v is not None and (
+                isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0
+            ):
+                raise ValueError(f"{key} must be a positive number of seconds")
+            p[key] = v
         return p
 
     # ------------------------------------------------------------- prompts
@@ -661,6 +727,26 @@ class APIHandler(BaseHTTPRequestHandler):
             # so only the non-streaming path asks the engine to compute them
             gen_kwargs["want_logprobs"] = True
 
+        # Deadlines: per-request override beats the server-wide flag. A
+        # scheduler-backed generator enforces them itself (bounded out-queue
+        # waits that survive a wedged engine); anything else gets a coarse
+        # between-tokens check in _generate — it can't interrupt a stuck
+        # step, but it bounds total generation.
+        req_to = params.get("request_timeout")
+        if req_to is None:
+            req_to = self.request_timeout
+        ttft_to = params.get("ttft_timeout")
+        if ttft_to is None:
+            ttft_to = self.ttft_timeout
+        soft_timeout = None
+        if getattr(generator, "supports_deadlines", False):
+            if req_to is not None:
+                gen_kwargs["request_timeout"] = req_to
+            if ttft_to is not None:
+                gen_kwargs["ttft_timeout"] = ttft_to
+        else:
+            soft_timeout = req_to
+
         # a concurrency-safe generator (ContinuousBatcher) interleaves
         # requests itself; everything else is serialized by the lock, which
         # is the reference's single-request behavior (shard/openai_api.py:543-563)
@@ -676,16 +762,19 @@ class APIHandler(BaseHTTPRequestHandler):
                 self._stream(
                     rid, obj + ".chunk", model_name, generator, tokenizer,
                     prompt_ids, stop_id_sequences, eos, chat, gen_kwargs,
+                    soft_timeout,
                 )
             else:
                 self._complete(
                     rid, obj, model_name, generator, tokenizer, prompt_ids,
                     stop_id_sequences, eos, chat, params["logprobs"], gen_kwargs,
+                    soft_timeout,
                 )
 
     def _complete(
         self, rid, obj, model_name, generator, tokenizer, prompt_ids,
         stop_id_sequences, eos, chat, want_logprobs, gen_kwargs,
+        soft_timeout=None,
     ):
         # non-streaming path (ref handle_completion shard/openai_api.py:357-434)
         tokens: list[int] = []
@@ -694,41 +783,49 @@ class APIHandler(BaseHTTPRequestHandler):
         finish_reason = "length"
         t_start = time.perf_counter()
         t_first = None
-        for token, logprobs in self._generate(generator, prompt_ids, gen_kwargs):
-            if t_first is None:
-                t_first = time.perf_counter()
-            if eos is not None and token == eos:
-                finish_reason = "stop"
-                break
-            tokens.append(token)
-            if want_logprobs > 0:
-                if isinstance(logprobs, TokenLogprobs):
-                    # computed on device in the decode block (lax.top_k);
-                    # nothing vocab-sized ever reaches the host
-                    token_logprobs.append(logprobs.chosen)
-                    top_logprobs.append(
-                        {
-                            int(i): float(v)
-                            for i, v in zip(
-                                logprobs.top_indices[:want_logprobs],
-                                logprobs.top_values[:want_logprobs],
-                            )
-                        }
-                    )
-                else:  # engines still yielding the full (B, V) row
-                    row = np.asarray(logprobs[0])
-                    token_logprobs.append(float(row[token]))
-                    top_idx = np.argsort(row)[::-1][:want_logprobs]
-                    top_logprobs.append({int(i): float(row[i]) for i in top_idx})
-            stop = stopping_criteria(tokens, stop_id_sequences, None)
-            if stop.stop_met:
-                if stop.trim_length:
-                    tokens = tokens[: -stop.trim_length]
-                    if want_logprobs > 0:
-                        token_logprobs = token_logprobs[: -stop.trim_length]
-                        top_logprobs = top_logprobs[: -stop.trim_length]
-                finish_reason = "stop"
-                break
+        it = self._generate(generator, prompt_ids, gen_kwargs, soft_timeout)
+        try:
+            for token, logprobs in it:
+                if t_first is None:
+                    t_first = time.perf_counter()
+                if eos is not None and token == eos:
+                    finish_reason = "stop"
+                    break
+                tokens.append(token)
+                if want_logprobs > 0:
+                    if isinstance(logprobs, TokenLogprobs):
+                        # computed on device in the decode block (lax.top_k);
+                        # nothing vocab-sized ever reaches the host
+                        token_logprobs.append(logprobs.chosen)
+                        top_logprobs.append(
+                            {
+                                int(i): float(v)
+                                for i, v in zip(
+                                    logprobs.top_indices[:want_logprobs],
+                                    logprobs.top_values[:want_logprobs],
+                                )
+                            }
+                        )
+                    else:  # engines still yielding the full (B, V) row
+                        row = np.asarray(logprobs[0])
+                        token_logprobs.append(float(row[token]))
+                        top_idx = np.argsort(row)[::-1][:want_logprobs]
+                        top_logprobs.append({int(i): float(row[i]) for i in top_idx})
+                stop = stopping_criteria(tokens, stop_id_sequences, None)
+                if stop.stop_met:
+                    if stop.trim_length:
+                        tokens = tokens[: -stop.trim_length]
+                        if want_logprobs > 0:
+                            token_logprobs = token_logprobs[: -stop.trim_length]
+                            top_logprobs = top_logprobs[: -stop.trim_length]
+                    finish_reason = "stop"
+                    break
+        finally:
+            # deterministic cancellation (stop-word / eos early exit, or an
+            # exception): closing the generator flips the scheduler
+            # request's cancelled flag NOW, not at some later GC, so the
+            # slot and its KV pages are reclaimed within a tick
+            it.close()
         self._record(len(prompt_ids), len(tokens), t_start, t_first)
         text = tokenizer.decode(tokens)
         logprobs_payload = None
@@ -753,11 +850,26 @@ class APIHandler(BaseHTTPRequestHandler):
 
     def _stream(
         self, rid, obj, model_name, generator, tokenizer, prompt_ids,
-        stop_id_sequences, eos, chat, gen_kwargs,
+        stop_id_sequences, eos, chat, gen_kwargs, soft_timeout=None,
     ):
         # SSE with partial-stop-word buffering (ref handle_stream
         # shard/openai_api.py:436-505): if the current token tail could still
         # grow into a stop sequence, hold the text back.
+        t_start = time.perf_counter()
+        it = self._generate(generator, prompt_ids, gen_kwargs, soft_timeout)
+        # Prime the FIRST token before committing to a 200/SSE response:
+        # instant failures — queue full (429), TTFT timeout (504), bad
+        # request discovered at admission (400), every replica down (503) —
+        # surface as proper status codes instead of a broken event stream.
+        try:
+            head = next(it)
+        except StopIteration:
+            head = None
+        except BaseException:
+            it.close()
+            raise
+        t_first = time.perf_counter() if head is not None else None
+
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -768,6 +880,7 @@ class APIHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
         def emit(payload: dict):
+            inject("server.sse_write")  # fault harness: kill a live stream
             self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
             self.wfile.flush()
 
@@ -779,40 +892,61 @@ class APIHandler(BaseHTTPRequestHandler):
                 )
             )
 
+        def token_stream():
+            if head is not None:
+                yield head
+            yield from it
+
         detok = StreamingDetokenizer(tokenizer)
         tokens: list[int] = []
         in_flight: list[int] = []  # tokens withheld due to stop-prefix overlap
         finish_reason = "length"
-        t_start = time.perf_counter()
-        t_first = None
-        for token, _ in self._generate(generator, prompt_ids, gen_kwargs):
-            if t_first is None:
-                t_first = time.perf_counter()
-            if eos is not None and token == eos:
-                finish_reason = "stop"
-                break
-            tokens.append(token)
-            stop = stopping_criteria(tokens, stop_id_sequences, None)
-            if stop.stop_met:
-                finish_reason = "stop"
+        timed_out: Optional[RequestTimeoutError] = None
+        try:
+            for token, _ in token_stream():
+                if eos is not None and token == eos:
+                    finish_reason = "stop"
+                    break
+                tokens.append(token)
+                stop = stopping_criteria(tokens, stop_id_sequences, None)
+                if stop.stop_met:
+                    finish_reason = "stop"
+                    in_flight.clear()
+                    break
+                if any(sequence_overlap(tokens, s) for s in stop_id_sequences):
+                    in_flight.append(token)
+                    continue
+                for t in in_flight:
+                    detok.add_token(t)
                 in_flight.clear()
-                break
-            if any(sequence_overlap(tokens, s) for s in stop_id_sequences):
-                in_flight.append(token)
-                continue
-            for t in in_flight:
-                detok.add_token(t)
-            in_flight.clear()
-            detok.add_token(token)
-            if detok.last_segment:
-                delta = {"content": detok.last_segment}
-                emit(
-                    self._make_response(
-                        rid=rid, object_type=obj, model=model_name,
-                        **({"delta": delta} if chat else {"text": detok.last_segment}),
+                detok.add_token(token)
+                if detok.last_segment:
+                    delta = {"content": detok.last_segment}
+                    emit(
+                        self._make_response(
+                            rid=rid, object_type=obj, model=model_name,
+                            **({"delta": delta} if chat else {"text": detok.last_segment}),
+                        )
                     )
-                )
+        except RequestTimeoutError as e:
+            # headers are gone — close the stream with a final error event
+            # instead of a raw connection drop
+            timed_out = e
+            in_flight.clear()
+        finally:
+            # deterministic cancellation: whatever path leaves this loop
+            # (stop word, eos, timeout, BrokenPipeError from a vanished
+            # client), the scheduler request's cancelled flag flips NOW and
+            # its slot/KV pages are reclaimed within a tick
+            it.close()
         self._record(len(prompt_ids), len(tokens), t_start, t_first)
+        if timed_out is not None:
+            emit({"error": {"message": str(timed_out), "type": "timeout_error",
+                            "code": 504}})
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+            self.close_connection = True
+            return
         # a length-finished run that was still buffering emits the buffered
         # tokens — they never completed a stop sequence
         for t in in_flight:
@@ -841,11 +975,28 @@ class APIHandler(BaseHTTPRequestHandler):
         self.close_connection = True
 
     # -------------------------------------------------------- observability
-    def _generate(self, generator, prompt_ids, gen_kwargs):
+    def _generate(self, generator, prompt_ids, gen_kwargs, soft_timeout=None):
         """Generation wrapped in a JAX profiler trace when --profile-dir is
-        set (SURVEY §5: the profiling layer the reference lacks)."""
+        set (SURVEY §5: the profiling layer the reference lacks).
+
+        ``soft_timeout`` is the fallback total-generation bound for engines
+        without scheduler-side deadline support: checked between tokens, so
+        it bounds a long generation but cannot interrupt a wedged step."""
         with profile_trace(self.profile_dir):
-            yield from generator.generate_step(prompt_ids, **gen_kwargs)
+            it = generator.generate_step(prompt_ids, **gen_kwargs)
+            if soft_timeout is None:
+                yield from it
+                return
+            t0 = time.monotonic()
+            try:
+                for item in it:
+                    yield item
+                    if time.monotonic() - t0 > soft_timeout:
+                        raise RequestTimeoutError(
+                            "total", time.monotonic() - t0, soft_timeout
+                        )
+            finally:
+                it.close()
 
     def _record(self, n_prompt, n_gen, t_start, t_first):
         end = time.perf_counter()
@@ -877,6 +1028,8 @@ def make_server(
     port: int = 8080,
     profile_dir: Optional[str] = None,
     api_key: Optional[str] = None,
+    request_timeout: Optional[float] = None,
+    ttft_timeout: Optional[float] = None,
 ):
     handler = type(
         "BoundAPIHandler",
@@ -894,6 +1047,8 @@ def make_server(
             ),
             "profile_dir": profile_dir,
             "api_key": api_key,
+            "request_timeout": request_timeout,
+            "ttft_timeout": ttft_timeout,
         },
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -987,6 +1142,23 @@ def main(argv=None):
                              "for strict per-token streaming on a local chip)")
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
+    parser.add_argument("--request-timeout", type=float, default=None,
+                        help="total-generation deadline in seconds (submit "
+                             "to last token); expiry cancels the request, "
+                             "frees its slot/KV pages and returns HTTP 504 "
+                             "(or a final SSE error event). Per-request "
+                             "'request_timeout' in the body overrides it")
+    parser.add_argument("--ttft-timeout", type=float, default=None,
+                        help="time-to-first-token deadline in seconds "
+                             "(queue wait + prefill + compile); also the "
+                             "default inter-token stall watchdog. Requests "
+                             "still queued past it are shed before prefill. "
+                             "Per-request 'ttft_timeout' overrides it")
+    parser.add_argument("--max-queue", type=int, default=None,
+                        help="with --concurrent: admission bound on queued "
+                             "requests (per replica); a full queue rejects "
+                             "with 429 + Retry-After instead of growing "
+                             "without limit under overload")
     parser.add_argument("--api-key", default=None,
                         help="require 'Authorization: Bearer <key>' on the "
                              "/v1/* endpoints (the web UI's API key setting)")
@@ -1092,6 +1264,16 @@ def main(argv=None):
         # rewrites table rows outside the mirrored multihost op stream;
         # workers would desync — reserve admission only across hosts
         parser.error("--overcommit is not supported in multi-host serving")
+    if args.max_queue is not None:
+        if args.max_queue < 1:
+            parser.error("--max-queue must be a positive integer")
+        if args.concurrent <= 1:
+            parser.error("--max-queue requires --concurrent N (N > 1): only "
+                         "the continuous batcher has a submit queue to bound")
+    for flag, val in (("--request-timeout", args.request_timeout),
+                      ("--ttft-timeout", args.ttft_timeout)):
+        if val is not None and val <= 0:
+            parser.error(f"{flag} must be a positive number of seconds")
     multihost = bool(args.coordinator) and (args.num_processes or 1) > 1
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
@@ -1106,6 +1288,7 @@ def main(argv=None):
         overcommit=args.overcommit,
         draft_model=args.draft_model, spec_k=args.spec_k,
         prompt_cache=args.prompt_cache, replicas=args.replicas,
+        max_queue=args.max_queue,
     )
     if multihost:
         import jax
@@ -1131,7 +1314,9 @@ def main(argv=None):
                 serve_worker(provider.generator)
             return
     server = make_server(provider, args.host, args.port,
-                         profile_dir=args.profile_dir, api_key=args.api_key)
+                         profile_dir=args.profile_dir, api_key=args.api_key,
+                         request_timeout=args.request_timeout,
+                         ttft_timeout=args.ttft_timeout)
     logger.info("serving on http://%s:%d", args.host, args.port)
     server.serve_forever()
 

@@ -1,0 +1,1 @@
+"""Test-support utilities shipped with the package (fault injection)."""

@@ -1,0 +1,181 @@
+"""Fault-injection harness for the serving resilience layer.
+
+Deterministic failure testing needs a way to *make* the steady-state
+disasters happen on demand: a wedged engine tick, a replica that stalls or
+errors, a multi-host exchange that never completes, a client that vanishes
+mid-SSE-stream. This module plants named injection points at those sites —
+``inject("<site>")`` calls that are a single dict lookup when nothing is
+armed — and lets tests (or an operator, via ``MST_FAULTS``) arm them with a
+delay, a gate (block until released), or an exception.
+
+Sites wired into the serving stack:
+
+- ``scheduler.tick``      — top of every ContinuousBatcher scheduler tick
+  (arm a gate/delay here to wedge the engine mid-generation)
+- ``replica.dispatch``    — before a ReplicaSet routes a request into a
+  replica; ctx ``replica=<i>`` (match to delay/fail one specific replica)
+- ``multihost.exchange``  — top of every ControlPlane collective (raise
+  :class:`DropExchange` to simulate a peer that never arrives)
+- ``server.sse_write``    — every SSE chunk write in the HTTP layer (raise
+  ``BrokenPipeError`` to kill a stream mid-generation)
+
+Programmatic use (the fault-injection test suite)::
+
+    from mlx_sharding_tpu.testing import faults
+    gate = threading.Event()
+    f = faults.arm("scheduler.tick", gate=gate, after=2, times=1)
+    ...            # tick 3 blocks until gate.set(); f.fired counts hits
+    faults.disarm()
+
+Env activation (``MST_FAULTS``), for wedging a live deployment::
+
+    MST_FAULTS="scheduler.tick:delay=5:times=1,replica.dispatch:exc=runtime"
+
+Every armed fault auto-expires after ``times`` firings (default: forever),
+and gates wait at most ``GATE_MAX_WAIT_S`` so a forgotten ``gate.set()``
+can never hang a suite.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+# safety bound on gate waits: a test that forgets to release its gate gets
+# a slow test, not a hung interpreter
+GATE_MAX_WAIT_S = 30.0
+
+
+class FaultError(RuntimeError):
+    """Default exception raised by an armed fault with ``exc=True``."""
+
+
+class DropExchange(Exception):
+    """Raised at ``multihost.exchange`` to simulate a collective whose peer
+    never arrives; ControlPlane converts it into its dead-plane path."""
+
+
+_EXC_NAMES = {
+    "fault": FaultError,
+    "runtime": RuntimeError,
+    "broken_pipe": BrokenPipeError,
+    "timeout": TimeoutError,
+    "drop": DropExchange,
+}
+
+
+@dataclass
+class Fault:
+    site: str
+    delay: float = 0.0
+    gate: Optional[threading.Event] = None
+    exc: object = None  # exception instance/class, or None
+    times: Optional[int] = None  # firings before auto-disarm; None = forever
+    after: int = 0  # skip the first N hits (arm "on the Nth call")
+    match: Optional[dict] = None  # ctx subset that must match to fire
+    fired: int = 0  # observability for test assertions
+    skipped: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _applies(self, ctx: dict) -> bool:
+        if self.match:
+            for k, v in self.match.items():
+                if ctx.get(k) != v:
+                    return False
+        with self._lock:
+            if self.times is not None and self.fired >= self.times:
+                return False
+            if self.skipped < self.after:
+                self.skipped += 1
+                return False
+            self.fired += 1
+            return True
+
+    def trigger(self):
+        if self.gate is not None:
+            self.gate.wait(timeout=GATE_MAX_WAIT_S)
+        if self.delay > 0:
+            time.sleep(self.delay)
+        if self.exc is not None:
+            e = self.exc
+            raise e() if isinstance(e, type) else e
+
+
+# site -> list[Fault]; empty dict == fully disarmed (the inject fast path)
+_ARMED: dict[str, list[Fault]] = {}
+_ARM_LOCK = threading.Lock()
+
+
+def arm(
+    site: str,
+    *,
+    delay: float = 0.0,
+    gate: Optional[threading.Event] = None,
+    exc: object = None,
+    times: Optional[int] = None,
+    after: int = 0,
+    match: Optional[dict] = None,
+) -> Fault:
+    """Arm a fault at ``site``; returns the Fault for assertions."""
+    f = Fault(site=site, delay=delay, gate=gate, exc=exc, times=times,
+              after=after, match=match)
+    with _ARM_LOCK:
+        _ARMED.setdefault(site, []).append(f)
+    return f
+
+
+def disarm(site: Optional[str] = None):
+    """Disarm one site, or everything when ``site`` is None."""
+    with _ARM_LOCK:
+        if site is None:
+            _ARMED.clear()
+        else:
+            _ARMED.pop(site, None)
+
+
+def inject(site: str, **ctx):
+    """Injection point: no-op unless a matching fault is armed at ``site``.
+    May sleep (delay/gate) and/or raise (exc) per the armed fault."""
+    if not _ARMED:  # fast path: nothing armed anywhere
+        return
+    for f in _ARMED.get(site, ()):
+        if f._applies(ctx):
+            f.trigger()
+
+
+def _parse_env(spec: str):
+    """``MST_FAULTS="site:key=val:key=val,site2:..."`` — flag-activated
+    faults for wedging a live deployment without code changes."""
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        site, kw = fields[0], {}
+        try:
+            for kv in fields[1:]:
+                k, _, v = kv.partition("=")
+                if k == "delay":
+                    kw["delay"] = float(v)
+                elif k == "times":
+                    kw["times"] = int(v)
+                elif k == "after":
+                    kw["after"] = int(v)
+                elif k == "exc":
+                    kw["exc"] = _EXC_NAMES[v]
+            arm(site, **kw)
+        except (KeyError, ValueError):
+            # a malformed fault spec must never take down serving — faults
+            # are a debugging tool, not a dependency
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ignoring malformed MST_FAULTS entry %r", part
+            )
+
+
+if os.environ.get("MST_FAULTS"):
+    _parse_env(os.environ["MST_FAULTS"])

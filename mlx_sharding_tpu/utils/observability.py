@@ -156,6 +156,47 @@ class ServingMetrics:
                         "# TYPE mst_kv_bytes_read_total counter",
                         f"mst_kv_bytes_read_total {total_bytes}",
                     ]
+                res = getattr(b, "resilience_stats", lambda: None)()
+                if res is not None:
+                    lines += [
+                        "# TYPE mst_requests_timeout_total counter",
+                        f"mst_requests_timeout_total {res['timeouts']}",
+                        # shed = rejected before any engine work was spent:
+                        # queue_full at admission (429), deadline while queued
+                        "# TYPE mst_requests_shed_total counter",
+                        f'mst_requests_shed_total{{reason="queue_full"}} '
+                        f"{res['shed_queue_full']}",
+                        f'mst_requests_shed_total{{reason="deadline"}} '
+                        f"{res['shed_deadline']}",
+                        "# TYPE mst_scheduler_thread_live gauge",
+                        "mst_scheduler_thread_live "
+                        f"{int(bool(res['scheduler_thread_live']))}",
+                    ]
+                    if res.get("max_queue") is not None:
+                        lines += [
+                            "# TYPE mst_max_queue gauge",
+                            f"mst_max_queue {res['max_queue']}",
+                        ]
+                health = getattr(b, "health", lambda: None)()
+                if health is not None and "replicas_total" in health:
+                    lines += [
+                        "# TYPE mst_replicas_total gauge",
+                        f"mst_replicas_total {health['replicas_total']}",
+                        "# TYPE mst_replicas_live gauge",
+                        f"mst_replicas_live {health['replicas_live']}",
+                    ]
+                    lines.append("# TYPE mst_replica_breaker_open gauge")
+                    for rep in health["replicas"]:
+                        lines += [
+                            f'mst_replica_breaker_open{{replica="{rep["replica"]}"}} '
+                            f"{int(rep['breaker'] != 'closed')}",
+                        ]
+                    lines.append("# TYPE mst_replica_failures_total counter")
+                    for rep in health["replicas"]:
+                        lines += [
+                            f'mst_replica_failures_total{{replica="{rep["replica"]}"}} '
+                            f"{rep['failures']}",
+                        ]
                 prefix = getattr(b, "prefix_stats", lambda: None)()
                 if prefix is not None:
                     queries, hits, reused, evictions, cached = prefix

@@ -2,7 +2,47 @@
 
 from __future__ import annotations
 
+import functools
+import signal
 import threading
+
+
+def hard_timeout(seconds: float):
+    """Fail the decorated test if it runs longer than ``seconds``.
+
+    pytest-timeout is not installed in this environment, and the resilience
+    suite deliberately wedges threads — a bug in the reclamation paths would
+    otherwise hang the whole tier-1 run instead of failing one test. Uses
+    SIGALRM/setitimer, so it only arms in the main thread on platforms that
+    have it (everywhere we run tests); elsewhere it is a no-op rather than
+    a crash.
+    """
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if (
+                not hasattr(signal, "SIGALRM")
+                or threading.current_thread() is not threading.main_thread()
+            ):
+                return fn(*args, **kwargs)
+
+            def on_alarm(signum, frame):
+                raise TimeoutError(
+                    f"{fn.__name__} exceeded the {seconds}s hard timeout"
+                )
+
+            prev = signal.signal(signal.SIGALRM, on_alarm)
+            signal.setitimer(signal.ITIMER_REAL, seconds)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                signal.setitimer(signal.ITIMER_REAL, 0.0)
+                signal.signal(signal.SIGALRM, prev)
+
+        return wrapper
+
+    return deco
 
 
 def run_concurrent(gen_like, jobs, timeout: float = 600.0):

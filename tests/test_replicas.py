@@ -89,6 +89,94 @@ def test_replicated_batchers():
         rs.close()
 
 
+# ------------------------------------------------- dispatcher concurrency
+# Stub replicas isolate the ROUTING properties (serial locks, aggregation,
+# tie-breaking) from engine behavior, which the tests above already cover.
+
+
+class _Stub:
+    concurrent = True
+
+    def __init__(self, tokens=(1, 2, 3)):
+        self.tokens = list(tokens)
+
+    def generate_step(self, prompt_tokens, **kw):
+        yield from [(t, None) for t in self.tokens]
+
+
+def test_serial_replica_requests_never_overlap():
+    """A replica without ``concurrent`` gets a per-replica serial lock: two
+    threads streaming through the same one-slot replica must interleave at
+    the request level, never inside it."""
+    import threading
+    import time
+
+    class Serial:
+        # no `concurrent` attr: the dispatcher must serialize around us
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+            self._lock = threading.Lock()
+
+        def generate_step(self, prompt_tokens, **kw):
+            with self._lock:
+                self.active += 1
+                self.max_active = max(self.max_active, self.active)
+            try:
+                for t in range(3):
+                    time.sleep(0.01)  # widen any overlap window
+                    yield (t, None)
+            finally:
+                with self._lock:
+                    self.active -= 1
+
+    rep = Serial()
+    rs = ReplicaSet([rep])
+    got = _concurrent_runs(rs, [([1], {}) for _ in range(4)])
+    assert got == [[0, 1, 2]] * 4
+    assert rep.max_active == 1
+    assert rs.served == [4]
+
+
+def test_stats_aggregation_across_replicas():
+    """stats()/page_stats() sum element-wise across replica batchers; plain
+    generators count as one slot and contribute no pages."""
+
+    class WithStats(_Stub):
+        def stats(self):
+            return (2, 1, 3)
+
+        def page_stats(self):
+            return (10, 4, 6)
+
+    rs = ReplicaSet([WithStats(), WithStats()])
+    assert rs.stats() == (4, 2, 6)
+    assert rs.page_stats() == (20, 8, 12)
+    # no paged replica anywhere → no page story to report
+    assert ReplicaSet([_Stub()]).page_stats() is None
+    mixed = ReplicaSet([WithStats(), _Stub()])
+    assert mixed.stats() == (3, 1, 3)
+    assert mixed.page_stats() == (10, 4, 6)
+
+
+def test_least_loaded_routing_and_ties():
+    """Ties break to the lowest index; an in-flight stream tips the next
+    request to the idle replica."""
+    r0, r1 = _Stub(), _Stub()
+    rs = ReplicaSet([r0, r1])
+    # idle tie → replica 0, twice (the first request finished before the
+    # second arrived, so the tie repeats)
+    for _ in range(2):
+        assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert rs.served == [2, 0]
+    # hold a stream open on 0 mid-iteration: the next request must go to 1
+    it = rs.generate_step([1])
+    next(it)
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert rs.served == [3, 1]
+    assert list(it) == [(2, None), (3, None)]  # held stream completes intact
+
+
 def test_provider_wiring(tmp_path):
     """ModelProvider --replicas path end-to-end from a real checkpoint."""
     from tests.make_tiny_checkpoint import make_tiny_checkpoint

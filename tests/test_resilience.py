@@ -1,0 +1,643 @@
+"""Serving resilience: deadlines, load shedding, circuit breakers and the
+fault-injection harness (testing/faults.py) that makes each failure mode
+happen deterministically on CPU — a wedged engine tick, an overloaded
+queue, a dead replica, a dropped multi-host collective, a client that
+vanishes mid-SSE-stream. Every test is bounded by an alarm (pytest-timeout
+is not available here): a reclamation bug must fail one test, not hang
+tier-1."""
+
+import http.client
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.generate import Generator
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+from mlx_sharding_tpu.replicas import ReplicaSet
+from mlx_sharding_tpu.resilience import (
+    Deadlines,
+    QueueFullError,
+    ReplicasUnavailableError,
+    RequestTimeoutError,
+)
+from mlx_sharding_tpu.scheduler import ContinuousBatcher
+from mlx_sharding_tpu.server.openai_api import ModelProvider, make_server
+from mlx_sharding_tpu.testing import faults
+from mlx_sharding_tpu.utils.observability import ServingMetrics
+from tests.helpers import hard_timeout
+from tests.test_tokenizer_utils import ByteTokenizer
+
+TINY = dict(
+    vocab_size=300,  # covers the byte tokenizer's id range
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """No fault may leak into the next test (or the rest of tier-1)."""
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    return model, params
+
+
+def _batcher(mp, *, slots=2, paged=False, **kw):
+    model, params = mp
+    extra = dict(pool_pages=8, page_size=8) if paged else {}
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(1), microbatches=slots, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8, **extra,
+    )
+    return ContinuousBatcher(eng, decode_block=4, **kw)
+
+
+def _wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _wedge(gate):
+    """Arm a gate fault at scheduler.tick and block until the scheduler
+    thread is provably parked on it (fired implies it is inside trigger(),
+    and it cannot drain/admit anything until the gate is released)."""
+    f = faults.arm("scheduler.tick", gate=gate)
+    _wait_for(lambda: f.fired >= 1, msg="scheduler thread to hit the gate")
+    return f
+
+
+# ------------------------------------------------------------- unit: faults
+def test_fault_match_times_after():
+    f = faults.arm("site.x", exc=faults.FaultError, times=1, after=1,
+                   match={"replica": 2})
+    faults.inject("site.x", replica=1)  # match miss
+    faults.inject("site.x", replica=2)  # consumed by `after`
+    with pytest.raises(faults.FaultError):
+        faults.inject("site.x", replica=2)
+    faults.inject("site.x", replica=2)  # times exhausted: no-op
+    assert f.fired == 1 and f.skipped == 1
+    faults.disarm("site.x")
+    faults.inject("site.x", replica=2)  # disarmed: no-op
+
+
+def test_fault_env_parsing():
+    faults._parse_env(
+        "scheduler.tick:delay=0.5:times=2, ,bogus:exc=nosuch,"
+        "replica.dispatch:exc=runtime"
+    )
+    try:
+        armed = faults._ARMED
+        (f,) = armed["scheduler.tick"]
+        assert f.delay == 0.5 and f.times == 2
+        assert armed["replica.dispatch"][0].exc is RuntimeError
+        # the malformed entry is dropped, not fatal
+        assert "bogus" not in armed
+    finally:
+        faults.disarm()
+
+
+# ---------------------------------------------------------- unit: deadlines
+def test_deadline_validation():
+    for bad in (0, -1, "2", True):
+        with pytest.raises(ValueError):
+            Deadlines.start(request_timeout=bad)
+    d = Deadlines.start(ttft_timeout=1.5)
+    # the stall watchdog inherits the TTFT budget by default
+    assert d.stall_timeout == 1.5
+    assert d.total_deadline is None and d.ttft_deadline is not None
+    d2 = Deadlines.start(request_timeout=3.0, stall_timeout=0.5)
+    assert d2.stall_timeout == 0.5 and d2.ttft_deadline is None
+
+
+def test_batcher_rejects_bad_deadlines(mp):
+    b = _batcher(mp)
+    try:
+        with pytest.raises(ValueError):
+            b.generate_step([1, 2], max_tokens=4, ttft_timeout=-1)
+    finally:
+        b.close()
+
+
+# ------------------------------------------------------- unit: empty prompt
+def test_empty_prompt_rejected_everywhere(mp):
+    model, params = mp
+    gen = Generator(model, params, max_seq=64, cache_dtype=jnp.float32,
+                    prefill_chunk=8)
+    with pytest.raises(ValueError, match="empty prompt"):
+        next(gen.generate_step([], max_tokens=4))
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(1), max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    with pytest.raises(ValueError, match="empty prompt"):
+        next(eng.generate_step([], max_tokens=4))
+    b = _batcher(mp)
+    try:
+        # eager admission: raises at call time, before any request exists
+        with pytest.raises(ValueError, match="empty prompt"):
+            b.generate_step([], max_tokens=4)
+    finally:
+        b.close()
+
+
+def test_empty_prompt_rejected_chained():
+    from mlx_sharding_tpu.parallel.chained import ChainedPipeline
+    from tests.test_chained_pipeline import TINY as CH_TINY, _stage
+
+    full = LlamaModel(LlamaConfig(**CH_TINY))
+    params = full.init_params(jax.random.PRNGKey(0), jnp.float32)
+    m, p = _stage(CH_TINY, params, 0, CH_TINY["num_hidden_layers"])
+    chain = ChainedPipeline(
+        [m], [p], max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8
+    )
+    with pytest.raises(ValueError, match="empty prompt"):
+        next(chain.generate_step([], max_tokens=4))
+
+
+# --------------------------------------------- wedged tick → structured 504
+@hard_timeout(180)
+def test_wedged_tick_ttft_timeout_and_reclaim(mp):
+    """Acceptance #1: wedge the engine mid-serving — the waiting client gets
+    a structured TTFT timeout immediately (not a hang), and once the engine
+    revives, the cancelled request's slot and KV pages are reclaimed."""
+    b = _batcher(mp, paged=True)
+    gate = threading.Event()
+    try:
+        list(b.generate_step([1, 2, 3], max_tokens=4))  # compile + warm
+        _wait_for(lambda: b.stats()[1] == 0, msg="warmup slot reclaim")
+        _, baseline_in_use, _ = b.page_stats()
+
+        _wedge(gate)
+        t0 = time.monotonic()
+        it = b.generate_step([9, 8, 7], max_tokens=8, ttft_timeout=0.3)
+        with pytest.raises(RequestTimeoutError) as ei:
+            next(it)
+        assert ei.value.kind == "ttft"
+        assert ei.value.budget_s == pytest.approx(0.3)
+        # released at the deadline, not after the wedge cleared
+        assert time.monotonic() - t0 < 5.0
+        assert b.timeouts == 1
+        assert b.resilience_stats()["timeouts"] == 1
+
+        gate.set()
+        faults.disarm()
+        _wait_for(
+            lambda: b.stats()[1] == 0 and b.stats()[2] == 0
+            and b.page_stats()[1] <= baseline_in_use,
+            msg="slot + page reclaim after the wedge cleared",
+        )
+        # the engine is fully serviceable again
+        assert len(list(b.generate_step([4, 5], max_tokens=3))) == 3
+    finally:
+        gate.set()
+        faults.disarm()
+        b.close()
+
+
+@hard_timeout(180)
+def test_stall_watchdog_mid_stream(mp):
+    """A stream that produced tokens and then stalls trips the inter-token
+    watchdog with kind='stall' (not ttft — the stream had started)."""
+    b = _batcher(mp, slots=1)
+    gate = threading.Event()
+    try:
+        list(b.generate_step([1, 2], max_tokens=4))  # compile + warm
+        # slow the ticks so the stream is still mid-flight when the gate
+        # engages (the engine decodes regardless of consumer pace)
+        faults.arm("scheduler.tick", delay=0.05)
+        it = b.generate_step(
+            [3, 4], max_tokens=30, ttft_timeout=10.0, stall_timeout=0.3
+        )
+        first = next(it)  # stream is live
+        assert isinstance(first, tuple)
+        _wedge(gate)  # now the engine stops producing
+        with pytest.raises(RequestTimeoutError) as ei:
+            for _ in it:
+                pass
+        assert ei.value.kind == "stall"
+        assert b.timeouts == 1
+    finally:
+        gate.set()
+        faults.disarm()
+        b.close()
+
+
+# ------------------------------------------------- admission control / shed
+@hard_timeout(180)
+def test_queue_full_sheds_synchronously(mp):
+    b = _batcher(mp, slots=1, max_queue=1)
+    gate = threading.Event()
+    try:
+        list(b.generate_step([1, 2], max_tokens=4))  # compile + warm
+        _wedge(gate)  # nothing drains: submissions pile up at the bound
+        it1 = b.generate_step([5, 6], max_tokens=4)  # depth 1 == max_queue
+        with pytest.raises(QueueFullError) as ei:
+            b.generate_step([7, 8], max_tokens=4)
+        assert ei.value.retry_after_s > 0
+        assert b.shed_queue_full == 1
+        assert b.resilience_stats()["shed_queue_full"] == 1
+        gate.set()
+        faults.disarm()
+        # the admitted request is unharmed by its neighbor's rejection
+        assert len(list(it1)) == 4
+        m = ServingMetrics(batcher_fn=lambda: b)
+        out = m.render()
+        assert 'mst_requests_shed_total{reason="queue_full"} 1' in out
+        assert "mst_max_queue 1" in out
+    finally:
+        gate.set()
+        faults.disarm()
+        b.close()
+
+
+@hard_timeout(180)
+def test_queue_wait_shed_before_prefill(mp):
+    """A queued request whose TTFT budget expires while waiting for a slot
+    is shed by the scheduler (kind='queue') before any prefill is spent."""
+    b = _batcher(mp, slots=1)
+    try:
+        list(b.generate_step([1, 2], max_tokens=4))  # compile + warm
+        # slow every tick so request A holds the only slot long enough
+        faults.arm("scheduler.tick", delay=0.03)
+        it_a = b.generate_step([1, 2], max_tokens=40)
+        next(it_a)  # A admitted and producing
+        it_b = b.generate_step([3, 4], max_tokens=4, ttft_timeout=0.25)
+        _wait_for(lambda: b.shed_deadline == 1, msg="queued request shed")
+        time.sleep(0.1)  # let the scheduler's error delivery land
+        with pytest.raises(RequestTimeoutError) as ei:
+            next(it_b)
+        assert ei.value.kind == "queue"
+        assert b.timeouts == 0  # shed scheduler-side, not a consumer timeout
+        faults.disarm()
+        assert len(list(it_a)) == 39  # A unaffected
+    finally:
+        faults.disarm()
+        b.close()
+
+
+# ------------------------------------------------------ close() wedge leak
+@hard_timeout(180)
+def test_close_reports_wedged_scheduler_thread(mp):
+    b = _batcher(mp, slots=1)
+    gate = threading.Event()
+    try:
+        list(b.generate_step([1, 2], max_tokens=4))  # start + warm the thread
+        _wedge(gate)
+        b.close(timeout=0.3)
+        assert b.thread_wedged
+        assert not b.scheduler_thread_live()
+        h = b.health()
+        assert h["status"] == "degraded" and not h["serving"]
+        out = ServingMetrics(batcher_fn=lambda: b).render()
+        assert "mst_scheduler_thread_live 0" in out
+    finally:
+        gate.set()
+        faults.disarm()
+        # the revived tick must observe _stop and exit — no leaked threads
+        if b._thread is not None:
+            b._thread.join(timeout=20)
+            assert not b._thread.is_alive()
+
+
+def test_healthy_close_and_health_states(mp):
+    b = _batcher(mp, slots=1)
+    assert b.health()["status"] == "ok"  # never started is healthy
+    list(b.generate_step([1, 2], max_tokens=3))
+    assert b.health() == {
+        "status": "ok", "serving": True, "scheduler_thread_live": True,
+    }
+    b.close()
+    h = b.health()
+    assert h["status"] == "draining" and not h["serving"]
+    assert b.scheduler_thread_live()  # clean exit, not a wedge
+
+
+# ------------------------------------------------------------ replica stubs
+class StubReplica:
+    """Scriptable replica: fails on demand, else yields a fixed stream."""
+
+    concurrent = True
+    supports_deadlines = True
+
+    def __init__(self, tokens=(1, 2, 3)):
+        self.tokens = list(tokens)
+        self.fail = False
+        self.exc = RuntimeError("injected replica crash")
+        self.calls = 0
+
+    def generate_step(self, prompt_tokens, **kw):
+        self.calls += 1
+        if self.fail:
+            raise self.exc
+        yield from [(t, None) for t in self.tokens]
+
+
+@hard_timeout(60)
+def test_failover_breaker_opens_and_recovers():
+    """Acceptance #3: requests keep succeeding on the survivor while the
+    sick replica circuit-breaks out of routing; health says degraded (not
+    dead); a half-open probe closes the breaker once the replica heals."""
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=2, probe_interval=0.2)
+    r0.fail = True
+    for _ in range(2):  # ties route to r0 first; both fail over to r1
+        assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert rs.failures[0] == 2 and rs.breaker_opens[0] == 1
+    h = rs.health()
+    assert h["status"] == "degraded" and h["serving"]
+    assert h["replicas_live"] == 1
+    assert h["replicas"][0]["breaker"] == "open"
+    # breaker open: traffic skips r0 entirely
+    calls0 = r0.calls
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert r0.calls == calls0
+    # past the probe interval the healed replica gets ONE probe and rejoins
+    r0.fail = False
+    time.sleep(0.25)
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert r0.calls == calls0 + 1
+    assert rs.health()["status"] == "ok"
+    assert rs.breaker_opens[0] == 1  # recovery didn't re-open
+
+
+@hard_timeout(60)
+def test_failed_probe_reopens_breaker():
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=1, probe_interval=0.15)
+    r0.fail = True
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert rs.breaker_opens[0] == 1
+    time.sleep(0.2)  # half-open
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]  # probe fails
+    assert rs._breaker_state(0, time.monotonic()) == "open"
+    assert rs.breaker_opens[0] == 1  # a re-opened probe is not a new open
+    time.sleep(0.2)
+    r0.fail = False
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert rs.health()["status"] == "ok"
+
+
+@hard_timeout(60)
+def test_all_replicas_down_raises_concrete_error():
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=1, probe_interval=60)
+    r0.fail = r1.fail = True
+    with pytest.raises(RuntimeError, match="injected replica crash"):
+        list(rs.generate_step([1]))
+    # both breakers now open; a fresh request has no concrete failure to
+    # report and gets the structured 503
+    with pytest.raises(ReplicasUnavailableError):
+        list(rs.generate_step([1]))
+    h = rs.health()
+    assert not h["serving"] and h["replicas_live"] == 0
+
+
+@hard_timeout(60)
+def test_started_stream_never_migrates():
+    class HalfStream:
+        concurrent = True
+
+        def generate_step(self, prompt_tokens, **kw):
+            yield (1, None)
+            raise RuntimeError("replica died mid-stream")
+
+    rs = ReplicaSet([HalfStream(), StubReplica()])
+    it = rs.generate_step([1])
+    assert next(it) == (1, None)
+    with pytest.raises(RuntimeError, match="mid-stream"):
+        list(it)
+    assert rs.failures[0] == 1
+    assert rs.replicas[1].calls == 0  # no silent retry with KV lost
+
+
+@hard_timeout(60)
+def test_replica_error_classification():
+    # queue-full: saturation — retried on the other replica, no strike
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1])
+    r0.fail, r0.exc = True, QueueFullError(4, 4)
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert rs.failures == [0, 0] and rs._fails_consec == [0, 0]
+    # both full: the client's 429 comes through
+    r1.fail, r1.exc = True, QueueFullError(4, 4)
+    with pytest.raises(QueueFullError):
+        list(rs.generate_step([1]))
+    # ValueError: the request is bad, not the replica — no retry, no strike
+    r0.exc = ValueError("empty prompt")
+    r1.fail = False
+    calls1 = r1.calls
+    with pytest.raises(ValueError):
+        list(rs.generate_step([1]))
+    assert rs.failures == [0, 0] and r1.calls == calls1  # no retry happened
+    # timeout: the budget is spent — propagate, but the replica takes the
+    # health strike
+    r0.exc = RequestTimeoutError("ttft", 1.0, 1.0)
+    with pytest.raises(RequestTimeoutError):
+        list(rs.generate_step([1]))
+    assert rs.failures[0] == 1
+
+
+@hard_timeout(60)
+def test_replica_dispatch_fault_site():
+    """The replica.dispatch injection point fails one targeted replica."""
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=1, probe_interval=60)
+    faults.arm("replica.dispatch", exc=faults.FaultError, match={"replica": 0})
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert r0.calls == 0  # died at dispatch, before the replica ran
+    assert rs.breaker_opens[0] == 1 and rs.health()["status"] == "degraded"
+
+
+# --------------------------------------------------------- multihost faults
+@hard_timeout(60)
+def test_multihost_exchange_drop_marks_plane_dead():
+    from mlx_sharding_tpu.parallel.multihost import (
+        ControlPlane,
+        WorkerTimeoutError,
+    )
+
+    cp = ControlPlane(max_prompt=8, timeout_s=30)
+    cp.exchange({"header": [1]})  # healthy single-process collective
+    assert cp.last_ok is not None and not cp.dead
+    faults.arm("multihost.exchange", exc=faults.DropExchange, times=1)
+    with pytest.raises(WorkerTimeoutError):
+        cp.exchange({"header": [1]})
+    assert cp.dead
+    faults.disarm()
+    with pytest.raises(WorkerTimeoutError):  # dead plane fails fast forever
+        cp.exchange({"header": [1]})
+
+
+# --------------------------------------------------------------- HTTP layer
+@pytest.fixture()
+def cb_server(mp):
+    b = _batcher(mp, slots=1, max_queue=1)
+    provider = ModelProvider.__new__(ModelProvider)
+    provider.default_model = "tiny"
+    provider.trust_remote_paths = False
+    provider._key = None
+    provider._load_lock = threading.Lock()
+    provider._set("tiny", b, ByteTokenizer())
+    srv = make_server(provider, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield port, b
+    srv.shutdown()
+    faults.disarm()
+    b.close()
+
+
+def _request(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request(
+        method, path,
+        json.dumps(body) if body is not None else None,
+        {"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, headers, data
+
+
+@hard_timeout(300)
+def test_http_504_then_429_retry_after(cb_server):
+    """Acceptance #2: a wedged engine answers a TTFT-bounded request with a
+    structured 504 (not a hang), and once the bounded queue is full every
+    further request — buffered or streaming — gets 429 with Retry-After
+    (the stream primes its first token before committing to SSE, so the
+    429 is a real status code)."""
+    port, b = cb_server
+    status, _, _ = _request(
+        port, "POST", "/v1/completions", {"prompt": "hi", "max_tokens": 4}
+    )
+    assert status == 200  # compiled + warm
+    gate = threading.Event()
+    _wedge(gate)
+    # wedged engine + TTFT budget → structured 504; the timed-out request
+    # stays in the (wedged) submit queue until the scheduler revives, so
+    # the queue is now at its --max-queue bound of 1
+    status, _, body = _request(
+        port, "POST", "/v1/completions",
+        {"prompt": "yo", "max_tokens": 4, "ttft_timeout": 0.3},
+    )
+    assert status == 504, body
+    assert json.loads(body)["error"]["type"] == "timeout_error"
+    for stream in (False, True):
+        status, headers, body = _request(
+            port, "POST", "/v1/completions",
+            {"prompt": "hi", "max_tokens": 4, "stream": stream},
+        )
+        assert status == 429, body
+        assert int(headers["Retry-After"]) >= 1
+        assert json.loads(body)["error"]["type"] == "overloaded_error"
+    assert b.shed_queue_full == 2 and b.timeouts == 1
+    gate.set()
+    faults.disarm()
+    # revived: the cancelled request is reaped and the server serves again
+    status, _, _ = _request(
+        port, "POST", "/v1/completions", {"prompt": "hi", "max_tokens": 4}
+    )
+    assert status == 200
+
+
+@hard_timeout(300)
+def test_http_deadline_param_validation(cb_server):
+    port, _ = cb_server
+    for bad in (-1, 0, "x", True):
+        status, _, body = _request(
+            port, "POST", "/v1/completions",
+            {"prompt": "hi", "max_tokens": 4, "request_timeout": bad},
+        )
+        assert status == 400, (bad, body)
+
+
+@hard_timeout(300)
+def test_sse_client_disconnect_reclaims_slot(cb_server):
+    """Satellite: a client that vanishes mid-SSE (BrokenPipeError on write)
+    must cancel the batcher request — the slot frees within a tick instead
+    of decoding to max_tokens for nobody."""
+    port, b = cb_server
+    status, _, _ = _request(
+        port, "POST", "/v1/completions", {"prompt": "hi", "max_tokens": 4}
+    )
+    assert status == 200  # compiled + warm
+    f = faults.arm("server.sse_write", exc=BrokenPipeError, times=1)
+    status, _, body = _request(
+        port, "POST", "/v1/completions",
+        {"prompt": "abcdefgh", "max_tokens": 50, "stream": True},
+    )
+    # headers went out before the first write died; the body is truncated
+    assert status == 200
+    assert b"[DONE]" not in body
+    assert f.fired == 1
+    _wait_for(
+        lambda: b.stats()[1] == 0 and b.stats()[2] == 0,
+        msg="slot reclaim after client disconnect",
+    )
+    # well under the 50 requested tokens were generated for the dead client
+    faults.disarm()
+    status, _, _ = _request(
+        port, "POST", "/v1/completions", {"prompt": "hi", "max_tokens": 4}
+    )
+    assert status == 200  # the server kept serving
+
+
+@hard_timeout(300)
+def test_http_health_replica_degradation():
+    """/health over HTTP: degraded-but-200 on partial capacity, 503 when
+    every replica is circuit-broken."""
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=1, probe_interval=60)
+    provider = ModelProvider.__new__(ModelProvider)
+    provider.default_model = "tiny"
+    provider.trust_remote_paths = False
+    provider._key = None
+    provider._load_lock = threading.Lock()
+    provider._set("tiny", rs, ByteTokenizer())
+    srv = make_server(provider, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        status, _, body = _request(port, "GET", "/health")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        r0.fail = True
+        list(rs.generate_step([1]))  # opens r0's breaker, succeeds on r1
+        status, _, body = _request(port, "GET", "/health")
+        payload = json.loads(body)
+        assert status == 200  # degraded is still serving
+        assert payload["status"] == "degraded"
+        assert payload["replicas_live"] == 1
+        r1.fail = True
+        with pytest.raises(RuntimeError):
+            list(rs.generate_step([1]))  # opens r1's breaker too
+        status, _, body = _request(port, "GET", "/health")
+        assert status == 503
+        assert json.loads(body)["replicas_live"] == 0
+    finally:
+        srv.shutdown()
