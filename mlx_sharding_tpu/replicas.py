@@ -92,7 +92,7 @@ class ReplicaSet:
             return "closed"
         return "half_open" if now >= self._open_until[j] else "open"
 
-    def _pick(self, exclude=()) -> int:
+    def _pick(self, exclude=()) -> tuple[int, bool]:
         with self._lock:
             now = time.monotonic()
             closed, half_open = [], []
@@ -104,11 +104,13 @@ class ReplicaSet:
                     closed.append(j)
                 elif state == "half_open" and not self._probing[j]:
                     half_open.append(j)
+            probe = False
             if half_open:
                 # recovery beats load balance: route this request as the
                 # probe, or an idle fleet would never close the breaker
                 i = half_open[0]
                 self._probing[i] = True
+                probe = True
             elif closed:
                 i = min(closed, key=lambda j: self._inflight[j])
             else:
@@ -118,11 +120,16 @@ class ReplicaSet:
                 )
             self._inflight[i] += 1
             self.served[i] += 1
-            return i
+            return i, probe
 
-    def _done(self, i: int):
+    def _done(self, i: int, probe: bool = False):
         with self._lock:
             self._inflight[i] -= 1
+            if probe:
+                # the probe ticket must come back on EVERY exit path (bad
+                # request, queue-full, consumer close, crash) — a leaked
+                # ticket would bar the replica from ever being probed again
+                self._probing[i] = False
 
     def _record_success(self, i: int):
         with self._lock:
@@ -148,7 +155,7 @@ class ReplicaSet:
         last_exc: Optional[BaseException] = None
         while True:
             try:
-                i = self._pick(excluded)
+                i, probe = self._pick(excluded)
             except ReplicasUnavailableError:
                 if last_exc is not None:
                     raise last_exc  # the concrete failure beats the generic 503
@@ -172,6 +179,16 @@ class ReplicaSet:
                         yield item
                 self._record_success(i)
                 return
+            except GeneratorExit:
+                # The consumer closed the stream early — under the server
+                # this is the COMMON success path (eos / stop word hit, so
+                # it.close()s the stream). Tokens flowed, the replica did
+                # its job: record the success, or a recovered probe would
+                # stay half-open forever and ordinary early exits would
+                # never reset the failure streak.
+                if started:
+                    self._record_success(i)
+                raise
             except ValueError:
                 raise  # bad request — the replica is healthy
             except QueueFullError as exc:
@@ -179,10 +196,14 @@ class ReplicaSet:
                 # other replicas before giving the client a 429
                 excluded.add(i)
                 last_exc = exc
-            except RequestTimeoutError:
+            except RequestTimeoutError as exc:
                 # the request's own budget is spent — a retry would only
-                # blow it further; the replica still takes the health strike
-                self._record_failure(i)
+                # blow it further. Only expiries that mark a WEDGED engine
+                # (mid-stream stall, blown total budget) strike the breaker;
+                # ttft/queue expiries are saturation, and client-settable
+                # budgets must not circuit-break healthy-but-busy replicas
+                if exc.kind in ("stall", "total"):
+                    self._record_failure(i)
                 raise
             except Exception as exc:  # noqa: BLE001 — any replica-side crash
                 self._record_failure(i)
@@ -191,7 +212,7 @@ class ReplicaSet:
                 excluded.add(i)
                 last_exc = exc
             finally:
-                self._done(i)
+                self._done(i, probe)
 
     # ------------------------------------------------------- observability
     def stats(self):

@@ -195,8 +195,14 @@ class ContinuousBatcher:
         self._start_lock = threading.Lock()
         # Admission control: generate_step rejects (QueueFullError → HTTP
         # 429) when queued requests reach max_queue, instead of letting the
-        # unbounded submit queue grow without limit under overload.
+        # unbounded submit queue grow without limit under overload. The lock
+        # makes check-then-enqueue atomic across HTTP handler threads (and
+        # the shed counter exact). The scheduler thread moves requests from
+        # _submit to _waiting outside this lock, so a request mid-drain can
+        # be momentarily invisible to the depth read — the bound is exact
+        # across submitters and soft by at most that one in-flight drain.
         self.max_queue = max_queue
+        self._admission_lock = threading.Lock()
         # resilience counters (read by /metrics via resilience_stats)
         self.timeouts = 0        # consumer-side deadline expiries
         self.shed_queue_full = 0  # rejected at admission (429)
@@ -403,11 +409,6 @@ class ContinuousBatcher:
                    for v in (ttft_timeout, request_timeout, stall_timeout))
             else None
         )
-        if self.max_queue is not None:
-            depth = self._submit.qsize() + len(self._waiting)
-            if depth >= self.max_queue:
-                self.shed_queue_full += 1
-                raise QueueFullError(depth, self.max_queue)
         req = _Request(
             prompt=prompt,
             sp=sp,
@@ -422,7 +423,15 @@ class ContinuousBatcher:
             logit_bias=logit_bias,
         )
         self._ensure_running()
-        self._submit.put(req)
+        if self.max_queue is not None:
+            with self._admission_lock:
+                depth = self._submit.qsize() + len(self._waiting)
+                if depth >= self.max_queue:
+                    self.shed_queue_full += 1
+                    raise QueueFullError(depth, self.max_queue)
+                self._submit.put(req)
+        else:
+            self._submit.put(req)
         return self._consume(req)
 
     def _consume(self, req: _Request):
@@ -445,7 +454,13 @@ class ContinuousBatcher:
                         cands.append(("ttft", dl.ttft_deadline - now))
                     if dl.total_deadline is not None:
                         cands.append(("total", dl.total_deadline - now))
-                    if not first and dl.stall_timeout is not None:
+                    if dl.stall_timeout is not None and (
+                        not first or dl.ttft_deadline is None
+                    ):
+                        # inter-token watchdog; with no TTFT budget it also
+                        # bounds the FIRST token, so a caller who set only
+                        # stall_timeout still can't block forever on a
+                        # wedged engine
                         cands.append(("stall", dl.stall_timeout))
                     if cands:
                         kind, timeout = min(cands, key=lambda t: t[1])
@@ -458,7 +473,8 @@ class ContinuousBatcher:
                     )
                 except queue.Empty:
                     req.cancelled = True
-                    self.timeouts += 1
+                    with self._admission_lock:  # exact under concurrency
+                        self.timeouts += 1
                     now = time.monotonic()
                     budget = (
                         dl.stall_timeout if kind == "stall"

@@ -297,6 +297,66 @@ def test_queue_wait_shed_before_prefill(mp):
         b.close()
 
 
+@hard_timeout(180)
+def test_stall_timeout_alone_bounds_first_token(mp):
+    """With ONLY stall_timeout set, the watchdog must also bound the wait
+    for the FIRST token — a wedged engine can't hang a caller who asked
+    for an inter-token watchdog but set no TTFT budget."""
+    b = _batcher(mp, slots=1)
+    gate = threading.Event()
+    try:
+        list(b.generate_step([1, 2], max_tokens=4))  # compile + warm
+        _wedge(gate)
+        t0 = time.monotonic()
+        it = b.generate_step([3, 4], max_tokens=4, stall_timeout=0.3)
+        with pytest.raises(RequestTimeoutError) as ei:
+            next(it)
+        assert ei.value.kind == "stall"
+        assert ei.value.budget_s == pytest.approx(0.3)
+        assert time.monotonic() - t0 < 5.0
+        assert b.timeouts == 1
+    finally:
+        gate.set()
+        faults.disarm()
+        b.close()
+
+
+@hard_timeout(180)
+def test_admission_bound_exact_under_concurrent_submits(mp):
+    """Check-then-enqueue is atomic across handler threads: with the
+    scheduler wedged (nothing drains), N concurrent submits against
+    max_queue=2 admit EXACTLY 2 and shed the rest."""
+    b = _batcher(mp, slots=1, max_queue=2)
+    gate = threading.Event()
+    try:
+        list(b.generate_step([1, 2], max_tokens=4))  # compile + warm
+        _wedge(gate)
+        results = []
+
+        def submit():
+            try:
+                results.append(b.generate_step([5, 6], max_tokens=2))
+            except QueueFullError:
+                results.append(None)
+
+        threads = [threading.Thread(target=submit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        admitted = [r for r in results if r is not None]
+        assert len(admitted) == 2  # never over the bound
+        assert b.shed_queue_full == 6  # no lost counter increments
+        gate.set()
+        faults.disarm()
+        for it in admitted:  # admitted requests drain normally after revive
+            assert len(list(it)) == 2
+    finally:
+        gate.set()
+        faults.disarm()
+        b.close()
+
+
 # ------------------------------------------------------ close() wedge leak
 @hard_timeout(180)
 def test_close_reports_wedged_scheduler_thread(mp):
@@ -451,12 +511,83 @@ def test_replica_error_classification():
     with pytest.raises(ValueError):
         list(rs.generate_step([1]))
     assert rs.failures == [0, 0] and r1.calls == calls1  # no retry happened
-    # timeout: the budget is spent — propagate, but the replica takes the
-    # health strike
-    r0.exc = RequestTimeoutError("ttft", 1.0, 1.0)
-    with pytest.raises(RequestTimeoutError):
+    # ttft/queue timeouts: saturation (queue wait against a client-settable
+    # budget) — propagate, but a healthy-but-busy replica takes NO strike,
+    # or tight-budget clients could circuit-break the whole fleet
+    for kind in ("ttft", "queue"):
+        r0.exc = RequestTimeoutError(kind, 1.0, 1.0)
+        with pytest.raises(RequestTimeoutError):
+            list(rs.generate_step([1]))
+    assert rs.failures == [0, 0] and rs._fails_consec == [0, 0]
+    # stall/total timeouts mark a wedged engine: propagate AND strike
+    for n, kind in enumerate(("stall", "total"), start=1):
+        r0.exc = RequestTimeoutError(kind, 1.0, 1.0)
+        with pytest.raises(RequestTimeoutError):
+            list(rs.generate_step([1]))
+        assert rs.failures[0] == n
+
+
+@hard_timeout(60)
+def test_early_closed_stream_counts_as_success():
+    """The server it.close()es every stream it stops reading (eos / stop
+    word) — GeneratorExit at the yield must record SUCCESS: sporadic
+    failures interleaved with early-closed successes must never accumulate
+    into an open breaker."""
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=2, probe_interval=0.15)
+    for _ in range(3):
+        r0.fail = True
+        assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]  # failover
+        r0.fail = False
+        it = rs.generate_step([1])  # ties route back to r0
+        assert next(it) == (1, None)
+        it.close()  # eos/stop-word: the stream is closed mid-iteration
+    assert rs.breaker_opens[0] == 0 and rs._fails_consec[0] == 0
+    assert rs.health()["status"] == "ok"
+
+
+@hard_timeout(60)
+def test_probe_closed_early_still_closes_breaker():
+    """A half-open probe whose consumer stops reading after the first token
+    is a SUCCESSFUL probe — the breaker closes and the replica rejoins."""
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=1, probe_interval=0.15)
+    r0.fail = True
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert rs.breaker_opens[0] == 1
+    r0.fail = False
+    time.sleep(0.2)  # half-open
+    it = rs.generate_step([1])  # routed as the probe
+    assert next(it) == (1, None)
+    it.close()  # early close must not leave the probe dangling
+    assert not rs._probing[0]
+    assert rs._breaker_state(0, time.monotonic()) == "closed"
+    assert rs.health()["status"] == "ok"
+
+
+@hard_timeout(60)
+def test_probe_ticket_returned_on_queue_full_and_bad_request():
+    """A probe that exits via QueueFullError or ValueError takes no verdict
+    on replica health, but must hand the probe ticket back — a leaked
+    ticket would bar the replica from ever being probed again."""
+    r0, r1 = StubReplica(), StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=1, probe_interval=0.1)
+    r0.fail = True
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    time.sleep(0.15)  # half-open
+    r0.exc = QueueFullError(4, 4)
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]  # probe → r1
+    assert not rs._probing[0]
+    assert rs._breaker_state(0, time.monotonic()) == "half_open"
+    r0.exc = ValueError("empty prompt")
+    with pytest.raises(ValueError):
         list(rs.generate_step([1]))
-    assert rs.failures[0] == 1
+    assert not rs._probing[0]
+    # still probeable: heal it and the next request closes the breaker
+    r0.fail = False
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    assert rs._breaker_state(0, time.monotonic()) == "closed"
+    assert rs.health()["status"] == "ok"
 
 
 @hard_timeout(60)
