@@ -1,0 +1,22 @@
+"""mstcheck: repo-native static analysis for the serving stack.
+
+Three rule families over plain ``ast`` (no third-party deps):
+
+- MST1xx trace safety (host effects in jit-traced code, device syncs in
+  hot paths, recompilation hazards) — :mod:`.trace_safety`
+- MST2xx lock discipline (guarded-attribute access, check-then-act,
+  lock-order cycles) — :mod:`.locks`
+- MST3xx stream/resource lifecycles (generator leaks, alloc/free pairing,
+  fault-injection-site coverage) — :mod:`.lifecycle`
+
+Run with ``python -m mlx_sharding_tpu.analysis <paths>``. See the README's
+"Static analysis" section for the rule catalog, suppression syntax, and the
+baseline workflow.
+"""
+
+from mlx_sharding_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Report,
+    analyze_paths,
+    main,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from mlx_sharding_tpu.analysis.core import main
+
+sys.exit(main())

@@ -1,0 +1,272 @@
+"""mstcheck driver: file walking, suppressions, baseline, reporting.
+
+The checker is pure-stdlib (``ast`` + ``re``) so the self-scan test adds no
+heavyweight imports — ``python -m mlx_sharding_tpu.analysis mlx_sharding_tpu/``
+runs in well under a second on this repo.
+
+Workflow pieces living here:
+
+- **Suppressions** — ``# mst: allow(MST102): <reason>`` on the finding line
+  (or the line above) silences that rule there. The reason is mandatory: a
+  bare ``allow(...)`` is itself reported as MST001, so every silenced finding
+  carries its justification in the diff.
+- **Baseline** — ``analysis/baseline.json`` holds grandfathered findings
+  keyed by (rule, path, enclosing symbol, message); matching findings are
+  reported as baselined and do not fail the run. ``--write-baseline``
+  regenerates the file from the current findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+SUPPRESS_RE = re.compile(
+    r"#\s*mst:\s*allow\(\s*([A-Za-z0-9_\-, ]+?)\s*\)(?:\s*:\s*(\S.*))?"
+)
+HOT_PATH_RE = re.compile(r"#\s*mst:\s*hot-path\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # "MST101"
+    path: str  # posix path as scanned
+    line: int
+    col: int
+    message: str
+    context: str = ""  # enclosing ClassName.method / function, for baselining
+
+    def key(self) -> tuple:
+        # line numbers churn with unrelated edits; the baseline matches on
+        # the stable parts only
+        return (self.rule, self.path, self.context, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed file plus everything the rules need alongside the AST."""
+
+    path: Path
+    display_path: str
+    tree: ast.Module
+    source_lines: list[str]
+    # line -> set of rule ids allowed there (valid suppressions only)
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+    bad_suppressions: list[int] = field(default_factory=list)
+    hot_lines: set[int] = field(default_factory=set)  # '# mst: hot-path'
+
+    @property
+    def basename(self) -> str:
+        return self.path.name
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        for line in (finding.line, finding.line - 1):
+            if finding.rule in self.suppressions.get(line, ()):
+                return True
+        return False
+
+
+def qualname_for_line(tree: ast.Module, target_line: int) -> str:
+    """Dotted enclosing-symbol name for a line (baseline context)."""
+    best: list[str] = []
+
+    def walk(n, stack):
+        for child in ast.iter_child_nodes(n):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                end = getattr(child, "end_lineno", child.lineno)
+                if child.lineno <= target_line <= end:
+                    nonlocal best
+                    best = stack + [child.name]
+                    walk(child, best)
+            else:
+                walk(child, stack)
+
+    walk(tree, [])
+    return ".".join(best) or "<module>"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains; None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def parse_module(path: Path, display_path: str) -> tuple[Optional[ModuleInfo], list[Finding]]:
+    try:
+        source = path.read_text(encoding="utf-8")
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError, ValueError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        return None, [
+            Finding("MST000", display_path, line, 0, f"unparseable file: {e}")
+        ]
+    mod = ModuleInfo(path=path, display_path=display_path, tree=tree,
+                     source_lines=source.splitlines())
+    for i, text in enumerate(mod.source_lines, start=1):
+        if "mst:" not in text:
+            continue
+        if HOT_PATH_RE.search(text):
+            mod.hot_lines.add(i)
+        m = SUPPRESS_RE.search(text)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                mod.bad_suppressions.append(i)
+            else:
+                mod.suppressions.setdefault(i, set()).update(rules)
+    return mod, []
+
+
+def collect_files(paths: list[str]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            files.extend(
+                f for f in sorted(path.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        else:
+            files.append(path)
+    return files
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)  # actionable
+    baselined: list[Finding] = field(default_factory=list)
+    lock_edges: list = field(default_factory=list)  # locks.LockEdge
+    files_scanned: int = 0
+
+
+def analyze_paths(paths: list[str], baseline: Optional[set] = None) -> Report:
+    """Run every rule family over ``paths``; returns the triaged report."""
+    from mlx_sharding_tpu.analysis import lifecycle, locks, trace_safety
+
+    report = Report()
+    raw: list[Finding] = []
+    modules: list[ModuleInfo] = []
+    for f in collect_files(paths):
+        mod, errors = parse_module(f, f.as_posix())
+        raw.extend(errors)
+        if mod is None:
+            continue
+        modules.append(mod)
+        report.files_scanned += 1
+        for line in mod.bad_suppressions:
+            raw.append(Finding(
+                "MST001", mod.display_path, line, 0,
+                "suppression without a reason — write "
+                "'# mst: allow(<rule>): <why this is safe>'",
+                context=qualname_for_line(mod.tree, line),
+            ))
+        raw.extend(trace_safety.check_module(mod))
+        raw.extend(lifecycle.check_module(mod))
+    lock_findings, edges = locks.check_modules(modules)
+    raw.extend(lock_findings)
+    report.lock_edges = edges
+
+    by_path = {m.display_path: m for m in modules}
+    for finding in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        mod = by_path.get(finding.path)
+        if mod is not None and finding.rule != "MST001" and mod.is_suppressed(finding):
+            continue
+        if baseline and finding.key() in baseline:
+            report.baselined.append(finding)
+        else:
+            report.findings.append(finding)
+    return report
+
+
+# ----------------------------------------------------------------- baseline
+def load_baseline(path: Path) -> set:
+    data = json.loads(path.read_text())
+    return {
+        (e["rule"], e["path"], e.get("context", ""), e["message"])
+        for e in data.get("findings", [])
+    }
+
+
+def write_baseline(path: Path, findings: list[Finding]):
+    entries = [
+        {"rule": f.rule, "path": f.path, "context": f.context,
+         "message": f.message}
+        for f in findings
+    ]
+    path.write_text(json.dumps(
+        {"version": 1, "findings": entries}, indent=2, sort_keys=True
+    ) + "\n")
+
+
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mlx_sharding_tpu.analysis",
+        description="mstcheck: trace-safety, lock-discipline and "
+        "stream/resource-lifecycle static analysis for this repo",
+    )
+    parser.add_argument("paths", nargs="+", help="files or directories to scan")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline JSON (default: {DEFAULT_BASELINE})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report grandfathered findings too")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                        "and exit 0")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--lock-graph", action="store_true",
+                        help="print the static lock-acquisition-order graph")
+    args = parser.parse_args(argv)
+
+    baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+    baseline: Optional[set] = None
+    if not args.no_baseline and not args.write_baseline and baseline_path.exists():
+        baseline = load_baseline(baseline_path)
+
+    report = analyze_paths(args.paths, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, report.findings)
+        print(f"wrote {len(report.findings)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in report.findings],
+            "baselined": [f.__dict__ for f in report.baselined],
+            "lock_edges": [e.as_dict() for e in report.lock_edges],
+        }, indent=2))
+    else:
+        for f in report.findings:
+            print(f.render())
+        if args.lock_graph:
+            print("lock-order graph:")
+            for e in sorted(set((e.src, e.dst) for e in report.lock_edges)):
+                print(f"  {e[0]} -> {e[1]}")
+        print(
+            f"mstcheck: {len(report.findings)} finding(s), "
+            f"{len(report.baselined)} baselined, "
+            f"{report.files_scanned} file(s) scanned"
+        )
+    return 1 if report.findings else 0

@@ -1,0 +1,249 @@
+"""Stream/resource-lifecycle rules (MST30x).
+
+- **MST301 generator-leak** — a generator function that acquires a resource
+  (``.acquire()``, ``._pick()``, ``alloc*``/``reserve*``/``open_*`` calls)
+  but yields outside any ``try`` with a ``finally`` or a ``GeneratorExit``
+  handler. A consumer dropping the stream mid-flight (client disconnect →
+  ``it.close()``) then skips the release — the PR-2 probe-ticket bug.
+- **MST302 alloc-leak-on-raise** — a resource is allocated (``.pop()`` from
+  a free/pool/pages list, or an ``alloc*``/``acquire*``/``reserve*`` call)
+  and a later ``raise`` in the same function can exit before any release
+  (``free*``/``release*`` or ``.append()`` back onto the pool) with no
+  ``try/finally`` in between: the page/slot leaks on the error path.
+- **MST303 unknown-fault-site** — ``inject("<site>")`` with a site string
+  not in the registered set; a typo here silently never fires.
+- **MST304 missing-fault-site** — a serving module that must carry its
+  fault-injection hook (``testing/faults.py`` contract) no longer calls
+  ``inject()`` with its site string; the resilience suite would silently
+  stop exercising that failure domain.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from mlx_sharding_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    qualname_for_line,
+)
+
+ACQUIRE_NAMES = {"acquire", "_pick"}
+ACQUIRE_PREFIXES = ("alloc", "acquire", "reserve", "open_")
+RELEASE_PREFIXES = ("release", "free", "_done")
+POOL_HINTS = ("free", "pool", "pages", "slots")
+
+KNOWN_FAULT_SITES = {
+    "scheduler.tick", "replica.dispatch", "multihost.exchange",
+    "server.sse_write",
+}
+# basename -> the inject() site that file must keep calling
+REQUIRED_FAULT_SITES = {
+    "scheduler.py": "scheduler.tick",
+    "replicas.py": "replica.dispatch",
+    "multihost.py": "multihost.exchange",
+    "openai_api.py": "server.sse_write",
+}
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk ``fn`` without descending into nested function definitions."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_generator(fn: ast.AST) -> bool:
+    return any(isinstance(n, (ast.Yield, ast.YieldFrom))
+               for n in _own_nodes(fn))
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    return name.split(".")[-1] if name else None
+
+
+def _is_acquire(node: ast.Call) -> bool:
+    bare = _call_name(node)
+    if bare is None:
+        return False
+    return bare in ACQUIRE_NAMES or bare.startswith(ACQUIRE_PREFIXES)
+
+
+def _is_release(node: ast.Call) -> bool:
+    bare = _call_name(node)
+    if bare is None:
+        return False
+    if bare.startswith(RELEASE_PREFIXES):
+        return True
+    if bare == "append" and isinstance(node.func, ast.Attribute):
+        base = dotted_name(node.func.value) or ""
+        return any(h in base for h in POOL_HINTS)
+    return False
+
+
+def _is_pool_pop(node: ast.Call) -> bool:
+    if not (isinstance(node.func, ast.Attribute) and node.func.attr == "pop"):
+        return False
+    base = dotted_name(node.func.value) or ""
+    return any(h in base for h in POOL_HINTS)
+
+
+def _try_protects(t: ast.Try) -> bool:
+    if t.finalbody:
+        return True
+    for h in t.handlers:
+        if h.type is None:
+            return True  # bare except catches BaseException incl. GeneratorExit
+        name = dotted_name(h.type)
+        if name in ("GeneratorExit", "BaseException"):
+            return True
+        if isinstance(h.type, ast.Tuple):
+            for elt in h.type.elts:
+                if dotted_name(elt) in ("GeneratorExit", "BaseException"):
+                    return True
+    return False
+
+
+def _check_generators(mod: ModuleInfo) -> list[Finding]:
+    findings = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_generator(fn):
+            continue
+        acquires = [n for n in _own_nodes(fn)
+                    if isinstance(n, ast.Call) and _is_acquire(n)]
+        if not acquires:
+            continue
+
+        unprotected: list[ast.AST] = []
+
+        def scan(node, protected):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, (ast.Yield, ast.YieldFrom)) and not protected:
+                unprotected.append(node)
+            if isinstance(node, ast.Try):
+                inner = protected or _try_protects(node)
+                for stmt in node.body + node.orelse:
+                    scan(stmt, inner)
+                # handler/finally bodies run during unwinding: treat as safe
+                for h in node.handlers:
+                    for stmt in h.body:
+                        scan(stmt, True)
+                for stmt in node.finalbody:
+                    scan(stmt, True)
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child, protected)
+
+        for stmt in fn.body:
+            scan(stmt, False)
+        if unprotected:
+            node = min(unprotected, key=lambda n: (n.lineno, n.col_offset))
+            findings.append(Finding(
+                "MST301", mod.display_path, node.lineno, node.col_offset,
+                f"generator {fn.name}() acquires a resource but yields "
+                "outside try/finally or a GeneratorExit handler — a dropped "
+                "stream (it.close()) leaks the resource",
+                context=qualname_for_line(mod.tree, node.lineno)))
+    return findings
+
+
+def _check_alloc_paths(mod: ModuleInfo) -> list[Finding]:
+    findings = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        allocs: list[int] = []
+        releases: list[int] = []
+        raises: list[ast.Raise] = []
+
+        def scan(node, protected):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.Call):
+                if _is_pool_pop(node) or _is_acquire(node):
+                    allocs.append(node.lineno)
+                elif _is_release(node):
+                    releases.append(node.lineno)
+            if isinstance(node, ast.Raise) and not protected:
+                raises.append(node)
+            if isinstance(node, ast.Try):
+                inner = protected or bool(node.finalbody)
+                for stmt in node.body + node.orelse:
+                    scan(stmt, inner)
+                for h in node.handlers:
+                    for stmt in h.body:
+                        scan(stmt, inner)
+                for stmt in node.finalbody:
+                    scan(stmt, protected)
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child, protected)
+
+        for stmt in fn.body:
+            scan(stmt, False)
+        if not allocs or not raises:
+            continue
+        first_alloc = min(allocs)
+        flagged = False
+        for r in sorted(raises, key=lambda n: n.lineno):
+            if r.lineno <= first_alloc:
+                continue
+            released_before = any(first_alloc < rel < r.lineno
+                                  for rel in releases)
+            if not released_before and not flagged:
+                findings.append(Finding(
+                    "MST302", mod.display_path, r.lineno, r.col_offset,
+                    f"{fn.name}() allocates from a pool then raises before "
+                    "any release on this path — the resource leaks on the "
+                    "error exit (wrap in try/finally or release first)",
+                    context=qualname_for_line(mod.tree, r.lineno)))
+                flagged = True  # one finding per function is enough signal
+    return findings
+
+
+def _check_fault_sites(mod: ModuleInfo) -> list[Finding]:
+    findings = []
+    called_sites: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if not name or name.split(".")[-1] != "inject":
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        site = node.args[0].value
+        called_sites.add(site)
+        if site not in KNOWN_FAULT_SITES:
+            findings.append(Finding(
+                "MST303", mod.display_path, node.lineno, node.col_offset,
+                f"unknown fault-injection site {site!r} — not in the "
+                "registered set, so it can never be armed",
+                context=qualname_for_line(mod.tree, node.lineno)))
+    required = REQUIRED_FAULT_SITES.get(mod.basename)
+    if required and required not in called_sites:
+        findings.append(Finding(
+            "MST304", mod.display_path, 1, 0,
+            f"{mod.basename} must call inject({required!r}) so the "
+            "resilience suite keeps exercising this failure domain",
+            context="<module>"))
+    return findings
+
+
+def check_module(mod: ModuleInfo) -> list[Finding]:
+    return (_check_generators(mod) + _check_alloc_paths(mod)
+            + _check_fault_sites(mod))

@@ -1,0 +1,433 @@
+"""Lock-discipline rules (MST20x) for the threaded serving layer.
+
+- **MST201 unlocked-guarded-access** — per class, an attribute counts as
+  *guarded* when it is accessed somewhere under ``with self.<lock>`` AND
+  written outside ``__init__``. Accesses to a guarded attribute from a
+  *public* method with no lock held are reported; private methods are
+  exempt (convention: the caller ensures locking).
+- **MST202 check-then-act** — within one function, a ``with lock:`` block
+  reads a guarded attribute and a *later, separate* ``with lock:`` block
+  mutates it: the state can change between the two acquisitions (the
+  non-atomic check-then-enqueue bug from PR 2).
+- **MST203 lock-order-cycle** — the static lock-acquisition-order graph
+  (nested ``with`` blocks, plus one level of intra- and cross-class call
+  resolution) contains a cycle, i.e. a potential ABBA deadlock.
+
+Graph nodes are named ``ClassName.attr`` — or the string literal handed to
+``analysis.runtime.make_lock("...")``, which the serving modules use so the
+static graph and the dynamically recorded one share a vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from mlx_sharding_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    qualname_for_line,
+)
+
+# container calls that mutate their receiver
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "add", "discard", "update", "setdefault", "popitem",
+    "put", "put_nowait", "get", "get_nowait", "move_to_end", "sort",
+}
+
+
+@dataclass(frozen=True)
+class LockEdge:
+    """src was held when dst was acquired (one observed static ordering)."""
+
+    src: str
+    dst: str
+    path: str
+    line: int
+
+    def as_dict(self) -> dict:
+        return {"src": self.src, "dst": self.dst, "path": self.path,
+                "line": self.line}
+
+
+@dataclass
+class _Access:
+    attr: str
+    write: bool
+    method: str
+    public: bool
+    line: int
+    held: tuple
+
+
+@dataclass
+class _WithBlock:
+    lock: str
+    method: str
+    line: int
+    end: int
+    reads: set = field(default_factory=set)
+    writes: set = field(default_factory=set)
+
+
+@dataclass
+class _HeldCall:
+    held: tuple
+    method_name: str  # callee name
+    recv_is_self: bool
+    line: int
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    mod: ModuleInfo
+    locks: dict  # attr -> graph node name
+    accesses: list = field(default_factory=list)
+    with_blocks: dict = field(default_factory=dict)  # method -> [_WithBlock]
+    held_calls: list = field(default_factory=list)
+    edges: list = field(default_factory=list)
+    method_locks: dict = field(default_factory=dict)  # method -> set(node)
+
+
+def _lock_factory(call: ast.Call) -> Optional[tuple]:
+    """('named', literal) / ('plain', None) if ``call`` builds a lock."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    if name.split(".")[-1] == "make_lock":
+        if (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            return ("named", call.args[0].value)
+        return ("plain", None)
+    if name in ("Lock", "RLock", "threading.Lock", "threading.RLock"):
+        return ("plain", None)
+    return None
+
+
+def _lock_value_label(value: ast.AST, cls: str, attr: str) -> Optional[str]:
+    """Graph node name if ``self.attr = value`` constructs a lock."""
+    if isinstance(value, ast.Call):
+        fac = _lock_factory(value)
+        if fac:
+            return fac[1] or f"{cls}.{attr}"
+        fn = dotted_name(value.func)
+        if fn and fn.split(".")[-1] == "field":  # dataclasses.field
+            for kw in value.keywords:
+                if kw.arg != "default_factory":
+                    continue
+                v = kw.value
+                if isinstance(v, ast.Lambda):
+                    for sub in ast.walk(v.body):
+                        if isinstance(sub, ast.Call):
+                            f2 = _lock_factory(sub)
+                            if f2:
+                                return f2[1] or f"{cls}.{attr}"
+                else:
+                    d = dotted_name(v)
+                    if d and d.split(".")[-1] in ("Lock", "RLock"):
+                        return f"{cls}.{attr}"
+    if isinstance(value, (ast.List, ast.ListComp)):
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                fac = _lock_factory(sub)
+                if fac:
+                    return fac[1] if fac[0] == "named" else f"{cls}.{attr}[*]"
+    return None
+
+
+def _find_locks(cls_node: ast.ClassDef, cls_name: str) -> dict:
+    locks: dict[str, str] = {}
+    for node in ast.walk(cls_node):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            d = dotted_name(t)
+            if not (d and d.startswith("self.") and d.count(".") == 1):
+                continue
+            attr = d.split(".", 1)[1]
+            label = _lock_value_label(node.value, cls_name, attr)
+            if label:
+                locks[attr] = label
+    for stmt in cls_node.body:  # class attrs, incl. dataclass fields
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            attr = stmt.target.id
+            if stmt.value is not None:
+                label = _lock_value_label(stmt.value, cls_name, attr)
+                if label:
+                    locks[attr] = label
+                    continue
+            ann = dotted_name(stmt.annotation)
+            if ann and ann.split(".")[-1] in ("Lock", "RLock"):
+                locks.setdefault(attr, f"{cls_name}.{attr}")
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    label = _lock_value_label(stmt.value, cls_name, t.id)
+                    if label:
+                        locks[t.id] = label
+    return locks
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    d = dotted_name(node)
+    if d and d.startswith("self.") and d.count(".") >= 1:
+        return d.split(".")[1]
+    return None
+
+
+def _analyze_class(mod: ModuleInfo, cls_node: ast.ClassDef) -> _ClassInfo:
+    ci = _ClassInfo(name=cls_node.name, mod=mod,
+                    locks=_find_locks(cls_node, cls_node.name))
+
+    for method in cls_node.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mname = method.name
+        public = not mname.startswith("_")
+        aliases: dict[str, str] = {}  # local var -> lock node name
+        blocks: list[_WithBlock] = []
+        ci.with_blocks[mname] = blocks
+        with_stack: list[_WithBlock] = []
+        acquired: set[str] = set()
+
+        def resolve_lock(expr: ast.AST) -> Optional[str]:
+            attr = _self_attr(expr)
+            if attr is not None and attr in ci.locks:
+                return ci.locks[attr]
+            if isinstance(expr, ast.Name) and expr.id in aliases:
+                return aliases[expr.id]
+            if isinstance(expr, ast.Subscript):
+                base = _self_attr(expr.value)
+                if base is not None and base in ci.locks:
+                    return ci.locks[base]
+            if isinstance(expr, ast.BoolOp):
+                for v in expr.values:
+                    r = resolve_lock(v)
+                    if r:
+                        return r
+            if isinstance(expr, ast.IfExp):
+                for v in (expr.body, expr.orelse):
+                    r = resolve_lock(v)
+                    if r:
+                        return r
+            return None
+
+        def record_access(attr: str, write: bool, line: int, held: tuple):
+            if attr in ci.locks:
+                return
+            ci.accesses.append(_Access(attr, write, mname, public, line, held))
+            for wb in with_stack:
+                (wb.writes if write else wb.reads).add(attr)
+
+        def scan(node: ast.AST, held: tuple):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                taken: list[str] = []
+                for item in node.items:
+                    scan(item.context_expr, held)
+                    lk = resolve_lock(item.context_expr)
+                    if lk:
+                        taken.append(lk)
+                for lk in taken:
+                    acquired.add(lk)
+                    for h in held:
+                        if h != lk:
+                            ci.edges.append(LockEdge(
+                                h, lk, mod.display_path, node.lineno))
+                entries = [
+                    _WithBlock(lk, mname, node.lineno,
+                               getattr(node, "end_lineno", node.lineno))
+                    for lk in taken
+                ]
+                blocks.extend(entries)
+                with_stack.extend(entries)
+                for stmt in node.body:
+                    scan(stmt, held + tuple(lk for lk in taken
+                                            if lk not in held))
+                del with_stack[len(with_stack) - len(entries):]
+                return
+            if isinstance(node, ast.Assign):
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Subscript)):
+                    base = _self_attr(node.value.value)
+                    if base is not None and base in ci.locks:
+                        aliases[node.targets[0].id] = ci.locks[base]
+            if isinstance(node, ast.Call):
+                func = node.func
+                callee = None
+                recv_self = False
+                if isinstance(func, ast.Attribute):
+                    callee = func.attr
+                    recv_self = dotted_name(func.value) == "self"
+                    if callee in MUTATORS:
+                        base = _self_attr(func.value)
+                        if base is not None:
+                            record_access(base, True, node.lineno, held)
+                        callee = None
+                elif (isinstance(func, ast.Call)
+                        and dotted_name(func.func) == "getattr"
+                        and len(func.args) >= 2
+                        and isinstance(func.args[1], ast.Constant)
+                        and isinstance(func.args[1].value, str)):
+                    callee = func.args[1].value
+                    recv_self = dotted_name(func.args[0]) == "self"
+                if callee and held:
+                    ci.held_calls.append(
+                        _HeldCall(held, callee, recv_self, node.lineno))
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                record_access(node.attr,
+                              isinstance(node.ctx, (ast.Store, ast.Del)),
+                              node.lineno, held)
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, (ast.Store, ast.Del))):
+                base = _self_attr(node.value)
+                if base is not None:
+                    record_access(base, True, node.lineno, held)
+            for child in ast.iter_child_nodes(node):
+                scan(child, held)
+
+        for stmt in method.body:
+            scan(stmt, ())
+        ci.method_locks[mname] = acquired
+    return ci
+
+
+def _guarded_attrs(ci: _ClassInfo) -> dict:
+    """attr -> lock node name believed to guard it."""
+    locked_under: dict[str, dict] = {}
+    written_late: set[str] = set()
+    for a in ci.accesses:
+        if a.held:
+            counts = locked_under.setdefault(a.attr, {})
+            counts[a.held[-1]] = counts.get(a.held[-1], 0) + 1
+        if a.write and a.method != "__init__":
+            written_late.add(a.attr)
+    out = {}
+    for attr, counts in locked_under.items():
+        if attr in written_late:
+            out[attr] = sorted(counts, key=lambda k: (-counts[k], k))[0]
+    return out
+
+
+def _mst201(ci: _ClassInfo, guarded: dict) -> list[Finding]:
+    findings = []
+    seen = set()
+    for a in ci.accesses:
+        if a.held or not a.public or a.attr not in guarded:
+            continue
+        msg = (f"'{a.attr}' is guarded by {guarded[a.attr]} elsewhere but "
+               f"accessed with no lock held in public method "
+               f"{ci.name}.{a.method}()")
+        key = (a.attr, a.method, a.line)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            "MST201", ci.mod.display_path, a.line, 0, msg,
+            context=qualname_for_line(ci.mod.tree, a.line)))
+    return findings
+
+
+def _mst202(ci: _ClassInfo, guarded: dict) -> list[Finding]:
+    findings = []
+    for method, blocks in ci.with_blocks.items():
+        ordered = sorted(blocks, key=lambda b: b.line)
+        for i, first in enumerate(ordered):
+            for second in ordered[i + 1:]:
+                if second.lock != first.lock or second.line <= first.end:
+                    continue  # different lock, or nested/overlapping
+                for attr in sorted(first.reads & second.writes):
+                    if attr not in guarded:
+                        continue
+                    findings.append(Finding(
+                        "MST202", ci.mod.display_path, second.line, 0,
+                        f"check-then-act: '{attr}' read under {first.lock} "
+                        f"then mutated under a separate acquisition in "
+                        f"{ci.name}.{method}() — the state can change "
+                        "between the two lock scopes",
+                        context=qualname_for_line(ci.mod.tree, second.line)))
+    return findings
+
+
+def _find_cycles(edges: list[LockEdge]) -> list[Finding]:
+    graph: dict[str, set] = {}
+    rep: dict[tuple, LockEdge] = {}
+    for e in edges:
+        graph.setdefault(e.src, set()).add(e.dst)
+        graph.setdefault(e.dst, set())
+        rep.setdefault((e.src, e.dst), e)
+    findings = []
+    color: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(u: str):
+        color[u] = 1
+        stack.append(u)
+        for v in sorted(graph[u]):
+            if color.get(v, 0) == 0:
+                dfs(v)
+            elif color[v] == 1:  # back edge: cycle
+                e = rep[(u, v)]
+                cyc = stack[stack.index(v):] + [v]
+                findings.append(Finding(
+                    "MST203", e.path, e.line, 0,
+                    "lock-order cycle (potential ABBA deadlock): "
+                    + " -> ".join(cyc),
+                    context=f"{e.src}->{e.dst}"))
+        color[u] = 2
+        stack.pop()
+
+    for u in sorted(graph):
+        if color.get(u, 0) == 0:
+            dfs(u)
+    return findings
+
+
+def check_modules(modules: list[ModuleInfo]) -> tuple[list[Finding], list[LockEdge]]:
+    classes: list[_ClassInfo] = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                classes.append(_analyze_class(mod, node))
+
+    # cross-class: method name -> locks that method acquires, any class
+    global_map: dict[str, set] = {}
+    for ci in classes:
+        for m, lks in ci.method_locks.items():
+            if lks:
+                global_map.setdefault(m, set()).update(lks)
+
+    edges: list[LockEdge] = []
+    for ci in classes:
+        edges.extend(ci.edges)
+        for hc in ci.held_calls:
+            callee_locks = (
+                ci.method_locks.get(hc.method_name, set()) if hc.recv_is_self
+                else global_map.get(hc.method_name, set())
+            )
+            for src in hc.held:
+                for dst in sorted(callee_locks):
+                    if src != dst:
+                        edges.append(LockEdge(
+                            src, dst, ci.mod.display_path, hc.line))
+
+    findings: list[Finding] = []
+    for ci in classes:
+        guarded = _guarded_attrs(ci)
+        findings += _mst201(ci, guarded)
+        findings += _mst202(ci, guarded)
+    findings += _find_cycles(edges)
+
+    uniq: dict[tuple, LockEdge] = {}
+    for e in edges:
+        uniq.setdefault((e.src, e.dst), e)
+    return findings, sorted(uniq.values(), key=lambda e: (e.src, e.dst))

@@ -1,0 +1,143 @@
+"""Runtime companion to mstcheck: named locks + dynamic lock-order recording.
+
+The serving modules construct their locks through :func:`make_lock`, naming
+each one with the same ``ClassName.attr`` vocabulary the static analyzer
+uses for its lock-order graph. In normal operation ``make_lock`` returns a
+plain ``threading.Lock`` — zero overhead. When a test calls
+:func:`enable_tracing` first, subsequently constructed locks are
+instrumented: every acquire records "<held> -> <acquired>" edges into a
+:class:`LockOrderRecorder`, giving the *dynamic* lock-order graph actually
+exercised by a workload. ``tests/test_lock_order_dynamic.py`` drives the
+resilience-style workload under tracing and asserts the dynamic graph is
+acyclic and never reverses a static edge.
+
+This module imports only ``threading`` so production modules can depend on
+it without cycles or heavyweight imports.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_TRACE: Optional["LockOrderRecorder"] = None
+_TLS = threading.local()  # per-thread stack of held instrumented-lock names
+
+
+class LockOrderRecorder:
+    """Accumulates (held, acquired) lock-order edges across all threads."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._edges: dict[tuple, int] = {}
+
+    def record(self, held: tuple, acquired: str):
+        with self._mu:
+            for h in held:
+                if h != acquired:
+                    key = (h, acquired)
+                    self._edges[key] = self._edges.get(key, 0) + 1
+
+    def edges(self) -> set:
+        with self._mu:
+            return set(self._edges)
+
+    def find_cycle(self, extra_edges: set = frozenset()) -> Optional[list]:
+        """A node list forming a cycle in edges ∪ extra_edges, or None."""
+        graph: dict[str, set] = {}
+        for src, dst in self.edges() | set(extra_edges):
+            graph.setdefault(src, set()).add(dst)
+            graph.setdefault(dst, set())
+        color: dict[str, int] = {}
+        stack: list[str] = []
+
+        def dfs(u):
+            color[u] = 1
+            stack.append(u)
+            for v in sorted(graph[u]):
+                if color.get(v, 0) == 0:
+                    found = dfs(v)
+                    if found:
+                        return found
+                elif color[v] == 1:
+                    return stack[stack.index(v):] + [v]
+            color[u] = 2
+            stack.pop()
+            return None
+
+        for u in sorted(graph):
+            if color.get(u, 0) == 0:
+                found = dfs(u)
+                if found:
+                    return found
+        return None
+
+
+def _held_stack() -> list:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+class InstrumentedLock:
+    """threading.Lock wrapper that reports acquisition order to a recorder."""
+
+    def __init__(self, name: str, recorder: LockOrderRecorder):
+        self.name = name
+        self._recorder = recorder
+        self._inner = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._recorder.record(tuple(_held_stack()), self.name)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _held_stack().append(self.name)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == self.name:
+                del stack[i]
+                break
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"InstrumentedLock({self.name!r})"
+
+
+def make_lock(name: str):
+    """A lock for the serving layer, named for the lock-order graphs.
+
+    Returns a plain ``threading.Lock`` unless tracing is enabled, in which
+    case locks constructed from here on are instrumented. ``name`` should
+    be the static graph's node name (``ClassName.attr``).
+    """
+    recorder = _TRACE
+    if recorder is None:
+        return threading.Lock()
+    return InstrumentedLock(name, recorder)
+
+
+def enable_tracing() -> LockOrderRecorder:
+    """Instrument locks constructed after this call; returns the recorder."""
+    global _TRACE
+    _TRACE = LockOrderRecorder()
+    return _TRACE
+
+
+def disable_tracing():
+    global _TRACE
+    _TRACE = None

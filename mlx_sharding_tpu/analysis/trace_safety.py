@@ -1,0 +1,311 @@
+"""Trace-safety rules (MST10x): hazards inside or around jit-traced code.
+
+- **MST101 trace-host-effect** — a host side effect inside a function that
+  is (transitively) traced by ``jax.jit`` / ``jax.vmap`` / ``jax.lax.scan``
+  etc.: wall clocks (``time.time``/``time_ns``/…), ``print``, the stdlib
+  ``random`` module or ``np.random``, and ``global``-statement mutation.
+  These run once at trace time and silently freeze into the compiled
+  program (or recompile it), the classic "my timestamp never changes" bug.
+- **MST102 sync-in-hot-path** — a blocking device synchronization
+  (``.item()``, ``jax.device_get``, ``np.asarray``/``np.array``) inside a
+  serving hot path: the continuous-batching scheduler tick and its helpers,
+  plus any function annotated ``# mst: hot-path``. Every such call stalls
+  the dispatch pipeline for a full device round trip; intentional,
+  amortized sync points carry an inline ``# mst: allow(MST102): …``.
+- **MST103 recompile-hazard** — a call to a jit-compiled callable passing a
+  freshly built array whose shape derives from request data (``len(...)``,
+  ``.size``, ``.shape[...]``) without going through a recognized bucketing
+  helper. Data-dependent shapes recompile the program per distinct value —
+  the scheduler's chunked prefill (``_chunk_at``) and the page-rounded pool
+  exist precisely to avoid this.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from mlx_sharding_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    qualname_for_line,
+)
+
+# functions that register their callable argument(s) for tracing
+TRACING_ENTRY_POINTS = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "jax.vmap", "vmap", "jax.pmap",
+    "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.switch", "lax.switch",
+    "jax.lax.map", "lax.map",
+}
+
+HOST_CLOCKS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+}
+HOST_RANDOM_ROOTS = ("random.", "np.random.", "numpy.random.")
+
+# serving hot paths checked by MST102 (beyond '# mst: hot-path' annotations):
+# the scheduler tick and everything it runs per decode block
+HOT_PATH_FUNCS = {
+    "scheduler.py": {
+        # the per-tick path only: _preempt/_release_pages etc. run on rare
+        # events (pool pressure), not every decode block
+        "_tick", "_decode_once", "_spec_once", "_prefill_one_chunk",
+        "_grow_for_decode", "_emit",
+    },
+}
+
+SYNC_CALLS = {"jax.device_get", "np.asarray", "np.array", "numpy.asarray",
+              "numpy.array"}
+
+# shape expressions routed through these calls are considered bucketed
+BUCKETING_FUNCS = {"_chunk_at", "_pages_needed", "round_up", "bucket",
+                   "next_power_of_two"}
+
+ARRAY_BUILDERS = {"zeros", "ones", "full", "empty", "arange"}
+
+
+def _collect_functions(tree: ast.Module) -> dict[str, list[ast.AST]]:
+    """name -> every FunctionDef/Lambda-holding def in the file (any scope)."""
+    table: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            table.setdefault(node.name, []).append(node)
+    return table
+
+
+def _callable_args(call: ast.Call) -> list[ast.AST]:
+    """Positional args of a tracing entry point that name/define callables."""
+    out = []
+    for arg in call.args:
+        if isinstance(arg, (ast.Lambda, ast.Name, ast.Attribute)):
+            out.append(arg)
+    return out
+
+
+def _traced_roots(tree: ast.Module, table: dict) -> list[ast.AST]:
+    """Function nodes handed to a tracing entry point anywhere in the file."""
+    roots: list[ast.AST] = []
+
+    def note(arg: ast.AST):
+        if isinstance(arg, ast.Lambda):
+            roots.append(arg)
+        else:
+            name = dotted_name(arg)
+            if name is None:
+                return
+            bare = name.split(".")[-1]  # self._first_sample_fn -> method name
+            roots.extend(table.get(bare, ()))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in TRACING_ENTRY_POINTS:
+                for arg in _callable_args(node):
+                    note(arg)
+            # functools.partial(jax.jit, ...) decorator form
+            if fname in ("functools.partial", "partial") and node.args:
+                inner = dotted_name(node.args[0])
+                if inner in TRACING_ENTRY_POINTS:
+                    pass  # the decorated function is traced; handled below
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dname = dotted_name(dec)
+                if dname in TRACING_ENTRY_POINTS:
+                    roots.append(node)
+                elif isinstance(dec, ast.Call):
+                    cname = dotted_name(dec.func)
+                    if cname in TRACING_ENTRY_POINTS:
+                        roots.append(node)
+                    elif cname in ("functools.partial", "partial") and dec.args:
+                        if dotted_name(dec.args[0]) in TRACING_ENTRY_POINTS:
+                            roots.append(node)
+    return roots
+
+
+def _traced_closure(roots: list[ast.AST], table: dict) -> list[ast.AST]:
+    """Roots plus every same-file function they (transitively) call or
+    define — host effects two frames down still run at trace time."""
+    seen: list[ast.AST] = []
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        if any(fn is s for s in seen):
+            continue
+        seen.append(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                bare = name.split(".")[-1]
+                if bare in table:
+                    work.extend(table[bare])
+    return seen
+
+
+def _check_host_effects(mod: ModuleInfo, traced: list[ast.AST]) -> list[Finding]:
+    findings = []
+
+    def flag(node, what):
+        findings.append(Finding(
+            "MST101", mod.display_path, node.lineno, node.col_offset,
+            f"host effect in jit-traced code: {what} runs once at trace "
+            "time, not per step",
+            context=qualname_for_line(mod.tree, node.lineno),
+        ))
+
+    for fn in traced:
+        globals_declared: set[str] = set()
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                if name in HOST_CLOCKS:
+                    flag(node, f"{name}()")
+                elif name == "print":
+                    flag(node, "print() (use jax.debug.print for traced "
+                         "values)")
+                elif any(name.startswith(root) for root in HOST_RANDOM_ROOTS):
+                    flag(node, f"{name}() (use jax.random with an explicit "
+                         "key)")
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in globals_declared:
+                        flag(node, f"mutation of global {t.id!r}")
+    return findings
+
+
+def _hot_functions(mod: ModuleInfo) -> list[ast.FunctionDef]:
+    configured = HOT_PATH_FUNCS.get(mod.basename, set())
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        annotated = any(
+            line in mod.hot_lines
+            for line in (node.lineno, node.lineno - 1)
+        )
+        if node.name in configured or annotated:
+            out.append(node)
+    return out
+
+
+def _check_hot_syncs(mod: ModuleInfo) -> list[Finding]:
+    findings = []
+    for fn in _hot_functions(mod):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            what = None
+            if name in SYNC_CALLS:
+                what = f"{name}()"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args
+            ):
+                what = ".item()"
+            if what:
+                findings.append(Finding(
+                    "MST102", mod.display_path, node.lineno, node.col_offset,
+                    f"blocking device sync in hot path {fn.name}(): {what} "
+                    "stalls the tick for a device round trip",
+                    context=qualname_for_line(mod.tree, node.lineno),
+                ))
+    return findings
+
+
+def _jitted_names(tree: ast.Module) -> set[str]:
+    """Names (locals and self.attrs) bound to a jax.jit(...) result."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if dotted_name(node.value.func) in ("jax.jit", "jit", "pjit",
+                                                "jax.pjit"):
+                for t in node.targets:
+                    name = dotted_name(t)
+                    if name:
+                        names.add(name)
+    return names
+
+
+def _dynamic_shape(expr: ast.AST) -> bool:
+    """Does ``expr`` derive from request data sizes (len/.size/.shape[..])
+    without passing through a bucketing helper?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name == "len":
+                return True
+            if name and name.split(".")[-1] in BUCKETING_FUNCS:
+                return False  # routed through bucketing: fine
+        if isinstance(node, ast.Attribute) and node.attr == "size":
+            return True
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "shape"
+        ):
+            return True
+    return False
+
+
+def _check_recompile_hazards(mod: ModuleInfo) -> list[Finding]:
+    jitted = _jitted_names(mod.tree)
+    if not jitted:
+        return []
+    findings = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if callee not in jitted:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if not isinstance(sub, ast.Call):
+                    continue
+                bname = dotted_name(sub.func)
+                if bname is None:
+                    continue
+                parts = bname.split(".")
+                if parts[-1] not in ARRAY_BUILDERS or len(parts) < 2:
+                    continue
+                if sub.args and _dynamic_shape(sub.args[0]):
+                    findings.append(Finding(
+                        "MST103", mod.display_path, sub.lineno,
+                        sub.col_offset,
+                        f"data-dependent shape at jitted call site "
+                        f"{callee}(): {bname} sized from request data "
+                        "recompiles per distinct length — route through a "
+                        "bucketing helper",
+                        context=qualname_for_line(mod.tree, sub.lineno),
+                    ))
+    return findings
+
+
+def check_module(mod: ModuleInfo) -> list[Finding]:
+    table = _collect_functions(mod.tree)
+    traced = _traced_closure(_traced_roots(mod.tree, table), table)
+    findings = _check_host_effects(mod, traced)
+    findings += _check_hot_syncs(mod)
+    findings += _check_recompile_hazards(mod)
+    return findings

@@ -142,12 +142,12 @@ class ControlPlane:
         self.last_ok: Optional[float] = None  # monotonic stamp of the last
         # completed collective — proof every rank was alive at that moment
         self._thread = None  # lazy daemon worker (timed exchanges only)
-        import threading
+        from mlx_sharding_tpu.analysis.runtime import make_lock
 
         # serializes the timed path: two callers racing the lazy init would
         # spawn duplicate broadcast threads, and interleaved _work/_out
         # queue traffic could hand one caller the other's reply
-        self._lock = threading.Lock()
+        self._lock = make_lock("ControlPlane._lock")
 
     @staticmethod
     def _broadcast(buf):
@@ -176,7 +176,8 @@ class ControlPlane:
             inject("multihost.exchange")
         except Exception as e:  # noqa: BLE001 — any injected failure means
             # the plane can no longer be trusted; normalize like a timeout
-            self.dead = True
+            with self._lock:  # exchange's dead-check reads under this lock
+                self.dead = True
             raise WorkerTimeoutError(
                 "multi-host collective dropped (injected fault) — marking "
                 "the control plane down (restart the deployment)"

@@ -25,10 +25,10 @@ set keeps serving and ``health()`` reports degraded, not dead.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Optional
 
+from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.resilience import (
     QueueFullError,
     ReplicasUnavailableError,
@@ -70,11 +70,12 @@ class ReplicaSet:
         # request may probe it (_probing guards against a probe stampede).
         self._open_until = [0.0] * n
         self._probing = [False] * n
-        self._lock = threading.Lock()
+        self._lock = make_lock("ReplicaSet._lock")
         # non-concurrent replicas (plain engines) serve one request at a
         # time each; per-replica locks replace the server's global one
-        self._serial_locks: list[Optional[threading.Lock]] = [
-            None if getattr(r, "concurrent", False) else threading.Lock()
+        self._serial_locks: list = [
+            None if getattr(r, "concurrent", False)
+            else make_lock("ReplicaSet._serial_locks[*]")
             for r in self.replicas
         ]
 
@@ -158,7 +159,8 @@ class ReplicaSet:
                 i, probe = self._pick(excluded)
             except ReplicasUnavailableError:
                 if last_exc is not None:
-                    raise last_exc  # the concrete failure beats the generic 503
+                    # mst: allow(MST302): _pick raised — no ticket was taken
+                    raise last_exc  # concrete failure beats the generic 503
                 raise
             started = False
             try:
@@ -219,15 +221,17 @@ class ReplicaSet:
         """Aggregate (slots, active, queued) across replicas for /metrics.
         Non-batcher replicas count as one slot each, active while a request
         is in flight."""
+        with self._lock:
+            inflight = list(self._inflight)
         slots = active = queued = 0
         for i, r in enumerate(self.replicas):
-            if hasattr(r, "stats"):
-                s, a, q = r.stats()
+            if hasattr(r, "stats"):  # replica stats outside our lock: the
+                s, a, q = r.stats()  # batcher takes its own admission lock
                 slots, active, queued = slots + s, active + a, queued + q
             else:
                 slots += 1
-                active += min(self._inflight[i], 1)
-                queued += max(self._inflight[i] - 1, 0)
+                active += min(inflight[i], 1)
+                queued += max(inflight[i] - 1, 0)
         return slots, active, queued
 
     def page_stats(self):

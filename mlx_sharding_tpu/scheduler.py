@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.cache import KVCache
 from mlx_sharding_tpu.generate import block_lp_outputs, block_token_logprobs
 from mlx_sharding_tpu.resilience import (
@@ -192,7 +193,7 @@ class ContinuousBatcher:
         self._submit: queue.Queue = queue.Queue()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
-        self._start_lock = threading.Lock()
+        self._start_lock = make_lock("ContinuousBatcher._start_lock")
         # Admission control: generate_step rejects (QueueFullError → HTTP
         # 429) when queued requests reach max_queue, instead of letting the
         # unbounded submit queue grow without limit under overload. The lock
@@ -202,7 +203,7 @@ class ContinuousBatcher:
         # be momentarily invisible to the depth read — the bound is exact
         # across submitters and soft by at most that one in-flight drain.
         self.max_queue = max_queue
-        self._admission_lock = threading.Lock()
+        self._admission_lock = make_lock("ContinuousBatcher._admission_lock")
         # resilience counters (read by /metrics via resilience_stats)
         self.timeouts = 0        # consumer-side deadline expiries
         self.shed_queue_full = 0  # rejected at admission (429)
@@ -431,6 +432,7 @@ class ContinuousBatcher:
                     raise QueueFullError(depth, self.max_queue)
                 self._submit.put(req)
         else:
+            # mst: allow(MST201): no admission bound to keep atomic with
             self._submit.put(req)
         return self._consume(req)
 
@@ -496,41 +498,50 @@ class ContinuousBatcher:
     def stats(self) -> tuple[int, int, int]:
         """(total slots, active slots, queued requests) — the /metrics
         contract, kept here so scheduler internals can change freely."""
-        return (
-            self.M,
-            sum(1 for r in self._slots if r is not None),
-            self._submit.qsize() + len(self._waiting),
-        )
+        with self._admission_lock:
+            queued = self._submit.qsize() + len(self._waiting)
+        # _slots is owned by the scheduler thread; this is a racy snapshot
+        # by design (a metric, not a decision input)
+        return (self.M, sum(1 for r in self._slots if r is not None), queued)
 
-    def scheduler_thread_live(self) -> bool:
-        """True while the scheduler thread is healthy: running, cleanly
-        stopped, or not yet started. False only after close() observed a
-        join timeout (a tick wedged mid-device-op)."""
+    def _live_locked(self) -> bool:
+        """scheduler_thread_live body; caller holds ``_start_lock``."""
         if self.thread_wedged:
             return False
         t = self._thread
         return t is None or t.is_alive() or self._stop
 
+    def scheduler_thread_live(self) -> bool:
+        """True while the scheduler thread is healthy: running, cleanly
+        stopped, or not yet started. False only after close() observed a
+        join timeout (a tick wedged mid-device-op)."""
+        with self._start_lock:
+            return self._live_locked()
+
     def resilience_stats(self) -> dict:
         """Deadline/shedding counters + queue bound for /metrics."""
-        return {
-            "timeouts": self.timeouts,
-            "shed_queue_full": self.shed_queue_full,
-            "shed_deadline": self.shed_deadline,
-            "max_queue": self.max_queue,
-            "scheduler_thread_live": self.scheduler_thread_live(),
-        }
+        live = self.scheduler_thread_live()  # own lock; taken before ours
+        with self._admission_lock:
+            return {
+                "timeouts": self.timeouts,
+                "shed_queue_full": self.shed_queue_full,
+                "shed_deadline": self.shed_deadline,
+                "max_queue": self.max_queue,
+                "scheduler_thread_live": live,
+            }
 
     def health(self) -> dict:
         """Serving health for the /health endpoint: ``status`` in
         ok/degraded/draining, ``serving`` decides 200 vs 503."""
-        live = self.scheduler_thread_live()
+        with self._start_lock:
+            live = self._live_locked()
+            draining = self._stop
         if not live:
             # a wedged thread (even one noticed during close) beats draining:
             # the operator needs to see the leak, not a polite shutdown
             return {"status": "degraded", "serving": False,
                     "scheduler_thread_live": False}
-        if self._stop:
+        if draining:
             return {"status": "draining", "serving": False,
                     "scheduler_thread_live": live}
         return {"status": "ok", "serving": True,
@@ -668,16 +679,20 @@ class ContinuousBatcher:
                 self._page_ref[p] = r
 
     def close(self, timeout: float = 10.0):
-        self._stop = True
-        if self._thread is not None:
+        with self._start_lock:
+            self._stop = True
+            t = self._thread
+        if t is not None:
+            # mst: allow(MST201): wake sentinel; Queue locks internally
             self._submit.put(None)  # wake the idle wait
-            self._thread.join(timeout=timeout)
-            if self._thread.is_alive():
+            t.join(timeout=timeout)
+            if t.is_alive():
                 # a tick is wedged (stuck device op / injected fault): the
                 # daemon thread can't be reclaimed, so record the leak —
                 # /health flips to degraded and mst_scheduler_thread_live
                 # drops to 0 instead of pretending the close succeeded
-                self.thread_wedged = True
+                with self._start_lock:
+                    self.thread_wedged = True
                 logging.getLogger(__name__).error(
                     "scheduler thread failed to exit within %.0fs — a tick "
                     "is wedged; the thread is abandoned (daemon) and /health "
@@ -1079,6 +1094,7 @@ class ContinuousBatcher:
             eng.shared_params, self.last_tok, self.cache, self.active,
             self.recent, self.keys, self.sp, self.rep_sizes, self.table,
         )
+        # mst: allow(MST102): THE tick sync — tokens must reach the host
         outs = jax.device_get(outs)
         toks = outs[0]  # (K, M, 1)
         if self.draft is not None and live:
@@ -1090,7 +1106,9 @@ class ContinuousBatcher:
             # toks[j-1] (step 0 consumed prev_tok), so the replay chain is
             # [prev_tok, toks[:-1]]. Deterministic device ops only — every
             # multi-host mirror computes the identical replay in lockstep.
+            # mst: allow(MST102): replay chain needs last block's tokens
             prev = np.asarray(jax.device_get(prev_tok))  # (M, 1)
+            # mst: allow(MST102): toks is already host-side (free copy)
             chain = np.concatenate([prev[None], np.asarray(toks[:-1])], 0)
             self.dcache = self.draft.spec_replay_cb(self.decode_block)(
                 self.draft.layer_params, self.draft.layer_masks,
@@ -1173,7 +1191,9 @@ class ContinuousBatcher:
         self.dcache = self.dcache._replace(
             offset=self._drewind(self.dcache.offset, count, self.active)
         )
+        # mst: allow(MST102): THE spec-tick sync — accepted tokens to host
         counts = np.asarray(jax.device_get(count))
+        # mst: allow(MST102): same sync point; gs rides the same transfer
         gs_h = np.asarray(jax.device_get(gs))
         self.rounds += len(live)
         for slot, req in live:
@@ -1220,7 +1240,8 @@ class ContinuousBatcher:
                 and now > r.deadlines.ttft_deadline
             ]:
                 self._waiting.remove(req)
-                self.shed_deadline += 1
+                with self._admission_lock:  # read by resilience_stats()
+                    self.shed_deadline += 1
                 req.cancelled = True
                 req.out.put(RequestTimeoutError(
                     "queue", now - req.deadlines.submitted_at,
@@ -1272,6 +1293,7 @@ class ContinuousBatcher:
             r for r in self._slots
             if r is not None and not self._prefill_done(r)
         ]
+        # mst: allow(MST102): M-bool mask, tiny transfer, gates the branch
         decoding = bool(np.asarray(self.active).any())
         if prefilling:
             if decoding:
@@ -1282,6 +1304,7 @@ class ContinuousBatcher:
             else:
                 for req in prefilling:
                     self._prefill_one_chunk(req)
+        # mst: allow(MST102): M-bool mask, tiny transfer, gates the branch
         if bool(np.asarray(self.active).any()):
             if self.draft is not None and self._spec_ok():
                 self._spec_once()

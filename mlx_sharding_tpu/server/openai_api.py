@@ -33,6 +33,7 @@ from typing import Optional
 
 import numpy as np
 
+from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.generate import TokenLogprobs
 from mlx_sharding_tpu.resilience import (
     QueueFullError,
@@ -170,7 +171,7 @@ class ModelProvider:
         # hot-swap loads must be serialized: two concurrent requests naming
         # different models would otherwise race _key/generator mutation and
         # double-load onto the device
-        self._load_lock = threading.Lock()
+        self._load_lock = make_lock("ModelProvider._load_lock")
         self.generator = None
         self.tokenizer = None
         if default_model:
@@ -1036,7 +1037,7 @@ def make_server(
         (APIHandler,),
         {
             "provider": provider,
-            "gen_lock": threading.Lock(),
+            "gen_lock": make_lock("APIHandler.gen_lock"),
             "metrics": ServingMetrics(
                 batcher_fn=lambda: provider.generator
                 if getattr(provider.generator, "concurrent", False)

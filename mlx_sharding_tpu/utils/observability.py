@@ -18,6 +18,8 @@ import random
 import threading
 from dataclasses import dataclass, field
 
+from mlx_sharding_tpu.analysis.runtime import make_lock
+
 
 @contextlib.contextmanager
 def profile_trace(log_dir: str | None):
@@ -59,7 +61,11 @@ class _Reservoir:
 
 @dataclass
 class ServingMetrics:
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    # named lock (ordering: ServingMetrics.lock is taken BEFORE any engine
+    # lock — render() calls the engine's locked accessors while holding it)
+    lock: threading.Lock = field(
+        default_factory=lambda: make_lock("ServingMetrics.lock")
+    )
     requests_total: int = 0
     requests_failed: int = 0
     prompt_tokens_total: int = 0

@@ -1,0 +1,7 @@
+"""MST001: a suppression without a reason is itself a finding."""
+import time
+
+
+def stamp():
+    # mst: allow(MST101)
+    return time.time()

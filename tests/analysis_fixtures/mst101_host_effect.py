@@ -1,0 +1,11 @@
+"""MST101: wall-clock read inside jit-traced code freezes at trace time."""
+import time
+
+import jax
+
+
+def _step(x):
+    return x * time.time()
+
+
+step = jax.jit(_step)

@@ -1,0 +1,7 @@
+"""MST102: blocking device sync inside an annotated hot path."""
+import numpy as np
+
+
+# mst: hot-path
+def decode_tick(token_buf):
+    return np.asarray(token_buf)

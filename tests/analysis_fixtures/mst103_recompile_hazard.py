@@ -1,0 +1,9 @@
+"""MST103: data-dependent array shape at a jitted call site."""
+import jax
+import jax.numpy as jnp
+
+prog = jax.jit(lambda x: x + 1)
+
+
+def run(tokens):
+    return prog(jnp.zeros((len(tokens),), jnp.float32))

@@ -1,0 +1,15 @@
+"""MST201: guarded attribute read with no lock held in a public method."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def incr(self):
+        with self._lock:
+            self._count += 1
+
+    def snapshot(self):
+        return self._count

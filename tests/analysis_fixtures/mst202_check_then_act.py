@@ -1,0 +1,15 @@
+"""MST202: read under the lock, mutate under a later separate acquisition."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put_if_absent(self, key, value):
+        with self._lock:
+            present = key in self._items
+        if not present:
+            with self._lock:
+                self._items[key] = value

@@ -1,0 +1,7 @@
+"""MST301: resource-acquiring generator with an unprotected yield."""
+
+
+def stream(pool):
+    ticket = pool.acquire()
+    for _ in range(4):
+        yield ticket

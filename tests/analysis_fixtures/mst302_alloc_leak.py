@@ -1,0 +1,12 @@
+"""MST302: pool allocation leaks when a later raise exits early."""
+
+
+class Pages:
+    def __init__(self):
+        self._free_pages = list(range(8))
+
+    def take(self, count):
+        page = self._free_pages.pop()
+        if count > 8:
+            raise ValueError("request too large")
+        return page

@@ -1,0 +1,6 @@
+"""MST303: a typo'd fault-injection site can never be armed."""
+from mlx_sharding_tpu.testing.faults import inject
+
+
+def tick():
+    inject("scheduler.tik")

@@ -1,0 +1,5 @@
+"""MST304: a scheduler.py that lost its inject("scheduler.tick") hook."""
+
+
+def tick():
+    return 1

@@ -11,6 +11,8 @@ admission, and early stream termination (stop sequences → OP_B_CANCEL).
 import signal
 import threading
 
+import pytest
+
 from tests.test_multihost import (
     _env,
     _free_port,
@@ -135,6 +137,7 @@ def _metric(port, name):
     return None
 
 
+@pytest.mark.slow  # ~75s: spawns a live 2-process deployment
 def test_two_process_concurrent_matches_single_process(ckpt, tmp_path):  # noqa: F811
     forced = _forced_token(ckpt)
     # reference: single process, 4 local devices, same batching config

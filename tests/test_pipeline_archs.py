@@ -13,6 +13,8 @@ from mlx_sharding_tpu.models.mixtral import MixtralModel
 from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
 from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
 
+pytestmark = pytest.mark.slow  # arch-matrix sweep; excluded from tier-1
+
 
 def test_gemma2_pipeline_odd_layers_per_stage():
     """4 stages x 1 layer: stages 1 and 3 hold GLOBAL odd (non-sliding)

@@ -689,10 +689,18 @@ def test_http_504_then_429_retry_after(cb_server):
     assert b.shed_queue_full == 2 and b.timeouts == 1
     gate.set()
     faults.disarm()
-    # revived: the cancelled request is reaped and the server serves again
-    status, _, _ = _request(
-        port, "POST", "/v1/completions", {"prompt": "hi", "max_tokens": 4}
-    )
+    # revived: the cancelled request is reaped and the server serves again.
+    # Reaping takes the revived scheduler one tick, and a request racing
+    # that tick legitimately sees the still-full queue — retry 429s briefly
+    # instead of racing the reap.
+    deadline = time.monotonic() + 30
+    while True:
+        status, _, _ = _request(
+            port, "POST", "/v1/completions", {"prompt": "hi", "max_tokens": 4}
+        )
+        if status != 429 or time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
     assert status == 200
 
 

@@ -17,6 +17,8 @@ from tests.test_scheduler import (  # noqa: F401 — shared tiny-model helpers
     _spec_batcher,
 )
 
+pytestmark = pytest.mark.slow  # compile-bound combos; excluded from tier-1
+
 
 def test_overcommit_interleaves_where_reserve_serializes():
     """Two requests whose reserved needs (6 pages each) exceed the 8-page

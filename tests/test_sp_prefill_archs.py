@@ -19,6 +19,8 @@ from mlx_sharding_tpu.models.gemma2 import Gemma2Model
 from mlx_sharding_tpu.parallel.mesh import make_mesh
 from mlx_sharding_tpu.parallel.sp_prefill import supports_sp_prefill
 
+pytestmark = pytest.mark.slow  # arch-matrix sweep; excluded from tier-1
+
 GEMMA_TINY = dict(
     vocab_size=160,
     hidden_size=32,

@@ -1,0 +1,190 @@
+"""mstcheck: the self-scan CI gate plus checker unit coverage.
+
+``test_repo_self_scan`` IS the static-analysis gate: it runs every rule
+family over ``mlx_sharding_tpu/`` and fails on any finding that is neither
+inline-suppressed (``# mst: allow(<rule>): <reason>``) nor recorded in
+``mlx_sharding_tpu/analysis/baseline.json`` — no external runner needed.
+The fixture corpus in ``tests/analysis_fixtures/`` pins each rule to a
+minimal known-bad snippet: exactly one finding, with the expected span.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.analysis.core import (
+    DEFAULT_BASELINE,
+    analyze_paths,
+    load_baseline,
+    main,
+    write_baseline,
+)
+from mlx_sharding_tpu.analysis.runtime import LockOrderRecorder
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "mlx_sharding_tpu"
+FIXTURES = REPO / "tests" / "analysis_fixtures"
+
+# fixture file -> (rule, line, col) of the single expected finding
+EXPECTED = {
+    "mst001_bad_suppression.py": ("MST001", 6, 0),
+    "mst101_host_effect.py": ("MST101", 8, 15),
+    "mst102_sync_hot_path.py": ("MST102", 7, 11),
+    "mst103_recompile_hazard.py": ("MST103", 9, 16),
+    "mst201_unlocked_attr.py": ("MST201", 15, 0),
+    "mst202_check_then_act.py": ("MST202", 14, 0),
+    "mst203_lock_cycle.py": ("MST203", 17, 0),
+    "mst301_generator_leak.py": ("MST301", 7, 8),
+    "mst302_alloc_leak.py": ("MST302", 11, 12),
+    "mst303_unknown_fault_site.py": ("MST303", 6, 4),
+    "mst304/scheduler.py": ("MST304", 1, 0),
+}
+
+
+# ----------------------------------------------------------- the CI gate
+def test_repo_self_scan_is_clean():
+    baseline = load_baseline(DEFAULT_BASELINE) if DEFAULT_BASELINE.exists() else None
+    report = analyze_paths([str(PACKAGE)], baseline=baseline)
+    rendered = "\n".join(f.render() for f in report.findings)
+    assert not report.findings, (
+        f"mstcheck found new violations in mlx_sharding_tpu/:\n{rendered}\n"
+        "Fix them, add an inline '# mst: allow(<rule>): <reason>', or (for "
+        "grandfathered findings only) regenerate the baseline with "
+        "`python -m mlx_sharding_tpu.analysis mlx_sharding_tpu/ "
+        "--write-baseline`."
+    )
+    assert report.files_scanned > 40  # the scan actually covered the tree
+
+
+def test_static_lock_graph_is_acyclic_with_expected_edges():
+    report = analyze_paths([str(PACKAGE)], baseline=None)
+    edges = {(e.src, e.dst) for e in report.lock_edges}
+    # metrics render() holds its lock while reading the engine's locked
+    # accessors: the one cross-class ordering the stack relies on
+    assert ("ServingMetrics.lock",
+            "ContinuousBatcher._admission_lock") in edges
+    assert ("ReplicaSet._serial_locks[*]",
+            "ContinuousBatcher._admission_lock") in edges
+    cycle = LockOrderRecorder().find_cycle(extra_edges=edges)
+    assert cycle is None, f"static lock-order cycle: {' -> '.join(cycle)}"
+
+
+def test_cli_module_exit_codes():
+    # the acceptance contract, verbatim, via the real entry point; the
+    # non-zero-on-findings side runs in-process (main() == 1) per fixture
+    clean = subprocess.run(
+        [sys.executable, "-m", "mlx_sharding_tpu.analysis",
+         "mlx_sharding_tpu/"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "0 finding(s)" in clean.stdout
+
+
+# ------------------------------------------------------- fixture corpus
+@pytest.mark.parametrize("name", sorted(EXPECTED))
+def test_fixture_fires_exactly_once_with_span(name):
+    rule, line, col = EXPECTED[name]
+    report = analyze_paths([str(FIXTURES / name)], baseline=None)
+    assert len(report.findings) == 1, [f.render() for f in report.findings]
+    f = report.findings[0]
+    assert (f.rule, f.line, f.col) == (rule, line, col), f.render()
+    # and the CLI exits non-zero on it (no baseline applies to tests/)
+    assert main([str(FIXTURES / name)]) == 1
+
+
+def test_every_fixture_is_covered():
+    on_disk = {
+        p.relative_to(FIXTURES).as_posix()
+        for p in FIXTURES.rglob("*.py")
+    }
+    assert on_disk == set(EXPECTED)
+
+
+# ------------------------------------------------- suppression workflow
+def test_suppression_with_reason_is_honored(tmp_path):
+    bad = tmp_path / "counter.py"
+    bad.write_text(
+        "import threading\n\n\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._count = 0\n\n"
+        "    def incr(self):\n"
+        "        with self._lock:\n"
+        "            self._count += 1\n\n"
+        "    def snapshot(self):\n"
+        "        # mst: allow(MST201): racy read is fine for a gauge\n"
+        "        return self._count\n"
+    )
+    report = analyze_paths([str(bad)], baseline=None)
+    assert report.findings == []
+
+
+def test_suppression_without_reason_is_mst001(tmp_path):
+    bad = tmp_path / "counter.py"
+    bad.write_text(
+        "import threading\n\n\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._count = 0\n\n"
+        "    def incr(self):\n"
+        "        with self._lock:\n"
+        "            self._count += 1\n\n"
+        "    def snapshot(self):\n"
+        "        # mst: allow(MST201)\n"
+        "        return self._count\n"
+    )
+    report = analyze_paths([str(bad)], baseline=None)
+    rules = sorted(f.rule for f in report.findings)
+    # the reasonless allow does NOT silence the finding and adds MST001
+    assert rules == ["MST001", "MST201"]
+
+
+def test_unparseable_file_is_mst000(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n    pass\n")
+    report = analyze_paths([str(bad)], baseline=None)
+    assert [f.rule for f in report.findings] == ["MST000"]
+
+
+# --------------------------------------------------- baseline workflow
+def test_baseline_grandfathers_findings(tmp_path):
+    src = (FIXTURES / "mst201_unlocked_attr.py").read_text()
+    bad = tmp_path / "counter.py"
+    bad.write_text(src)
+
+    first = analyze_paths([str(bad)], baseline=None)
+    assert len(first.findings) == 1
+
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(baseline_path, first.findings)
+    again = analyze_paths([str(bad)], baseline=load_baseline(baseline_path))
+    assert again.findings == []
+    assert [f.rule for f in again.baselined] == ["MST201"]
+
+    # the key is line-number-free: shifting the file must not invalidate it
+    bad.write_text("# a new leading comment\n" + src)
+    shifted = analyze_paths([str(bad)], baseline=load_baseline(baseline_path))
+    assert shifted.findings == []
+    assert [f.rule for f in shifted.baselined] == ["MST201"]
+
+
+def test_write_baseline_cli_roundtrip(tmp_path):
+    bad = tmp_path / "counter.py"
+    bad.write_text((FIXTURES / "mst201_unlocked_attr.py").read_text())
+    baseline_path = tmp_path / "baseline.json"
+
+    assert main([str(bad), "--baseline", str(baseline_path),
+                 "--write-baseline"]) == 0
+    data = json.loads(baseline_path.read_text())
+    assert data["version"] == 1 and len(data["findings"]) == 1
+    assert main([str(bad), "--baseline", str(baseline_path)]) == 0
+    assert main([str(bad), "--baseline", str(baseline_path),
+                 "--no-baseline"]) == 1

@@ -51,6 +51,7 @@ def test_sharded_train_step_matches_single_device():
     np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
 
 
+@pytest.mark.slow  # ~45s: full dry-run compile of the graft entry point
 def test_graft_entry_dryrun():
     import __graft_entry__
 
