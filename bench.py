@@ -173,6 +173,26 @@ def _is_real_chip_detail(detail: dict) -> bool:
     return "TPU" in str(detail.get("device", "")).upper()
 
 
+def _detail_file_provenance() -> tuple[str, str]:
+    """(commit, date) of the last commit that touched BENCH_DETAIL.json —
+    the backfill for committed real-chip details that predate the
+    measured_at/git_commit stamps (every fresh run writes them now, see the
+    detail dict in main())."""
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%h %cI", "--",
+             os.path.basename(DETAIL_PATH)],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        commit, _, date = out.stdout.strip().partition(" ")
+        if out.returncode == 0 and commit:
+            return commit, date or "unknown"
+    except Exception:  # noqa: BLE001
+        pass
+    return "unknown", "unknown"
+
+
 def _last_good_real_chip() -> dict | None:
     """The last committed real-chip BENCH_DETAIL.json, if any — the
     provenance block the fallback path attaches so a wedged tunnel at
@@ -187,11 +207,17 @@ def _last_good_real_chip() -> dict | None:
     primary = detail.get("decode_bf16") or {}
     if not primary.get("decode_tps"):
         return None
+    if "measured_at" not in detail or "git_commit" not in detail:
+        # detail predates the provenance stamps: the commit that landed the
+        # file is the best-available measurement provenance
+        commit, date = _detail_file_provenance()
+        detail.setdefault("git_commit", commit)
+        detail.setdefault("measured_at", date)
     return {
         "decode_tps": primary["decode_tps"],
         "ttft_ms": primary.get("ttft_ms"),
-        "measured_at": detail.get("measured_at", "unknown"),
-        "git_commit": detail.get("git_commit", "unknown"),
+        "measured_at": detail["measured_at"],
+        "git_commit": detail["git_commit"],
         "device": detail.get("device"),
         "best_config_tps": max(
             (v.get("decode_tps", 0.0) for v in detail.values()
@@ -705,6 +731,108 @@ def measure_overload_shedding(model, params, label: str) -> dict:
     return res
 
 
+def measure_async_tick_overlap(model, params, label: str) -> dict:
+    """The async tick-pipelining A/B (ISSUE 4 tentpole): the same saturated
+    continuous-batching load through the classic dispatch-then-harvest loop
+    (``async_sched="off"``) and the double-buffered pipeline
+    (``async_sched="on"``), at slots in {2, 4, 8}. Both paths emit identical
+    tokens; what changes is where tick wall-time goes. Per tick, sync pays
+    host work (dispatch, emit, admission — ``host_ms``, during which the
+    device is blocked on the host) PLUS the device wait (``device_blocked``,
+    THE tick sync); async dispatches block t+1 first so all of that host
+    work runs while the device computes, and only the device wait remains
+    on the tick's critical path. ``host_blocked_reduction_pct`` — how much
+    of the per-tick host-blocked time (tick_timing_stats ``host_ms_avg``)
+    the overlap removed — is the headline (acceptance: >= 40% on CPU
+    fallback, aggregate tok/s no worse at slots >= 4); the device wait is
+    reported alongside but is irreducible while the device is saturated."""
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(13)
+
+    res: dict = {"label": label}
+    for slots in (2, 4, 8):
+        prompts = [
+            [int(x) for x in rng.integers(1, vocab - 64, 32)]
+            for _ in range(slots)
+        ]
+        # one engine per slot count, shared by both modes sequentially (the
+        # batcher re-derives its cache/slot state from the engine at
+        # construction, so close-then-reuse is clean) — the A/B then compares
+        # identical compiled programs, only the run loop differs
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1), microbatches=slots,
+            max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+        )
+        entry = {}
+        for mode in ("off", "on"):
+            batcher = ContinuousBatcher(
+                eng, decode_block=8, async_sched=mode
+            )
+            try:
+                for _ in batcher.generate_step(prompts[0][:16], max_tokens=8):
+                    pass  # compile prefill + the decode block
+                # compile lands in the warmup ticks' host_ms (jit lowering
+                # blocks the dispatching thread) — drop it from the averages
+                batcher.reset_tick_timing()
+
+                done = [0] * slots
+
+                def run(i):
+                    for _ in batcher.generate_step(
+                        prompts[i], max_tokens=48
+                    ):
+                        done[i] += 1
+
+                threads = [
+                    threading.Thread(target=run, args=(i,))
+                    for i in range(slots)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                timing = batcher.tick_timing_stats()
+            finally:
+                batcher.close()
+            entry["async" if mode == "on" else "sync"] = dict(
+                aggregate_tps=round(sum(done) / wall, 2),
+                host_ms_avg=round(timing["host_ms_avg"], 3),
+                device_blocked_ms_avg=round(
+                    timing["device_blocked_ms_avg"], 3
+                ),
+                ticks=timing["ticks"],
+            )
+        del eng
+        sync_h = entry["sync"]["host_ms_avg"]
+        async_h = entry["async"]["host_ms_avg"]
+        entry["host_blocked_reduction_pct"] = round(
+            100.0 * (1.0 - async_h / max(sync_h, 1e-9)), 1
+        )
+        entry["tps_ratio"] = round(
+            entry["async"]["aggregate_tps"]
+            / max(entry["sync"]["aggregate_tps"], 1e-9), 3
+        )
+        res[f"slots{slots}"] = entry
+        log(f"[{label}] slots={slots} sync={entry['sync']['aggregate_tps']} "
+            f"tok/s (host {sync_h} ms/tick) "
+            f"async={entry['async']['aggregate_tps']} tok/s "
+            f"(host {async_h} ms/tick) — "
+            f"{entry['host_blocked_reduction_pct']}% less host-blocked, "
+            f"{entry['tps_ratio']}x tok/s")
+    return res
+
+
 def kernel_smoke(detail: dict) -> None:
     """Compile (for real) + numerically cross-check both Pallas kernels
     against the XLA paths they replace, and time them."""
@@ -958,6 +1086,18 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 detail["overload_shedding_cpu"] = dict(error=repr(e)[:300])
                 log(f"[overload_shedding_cpu] FAILED: {e!r}")
+            # the 0.28B fallback model, not tiny2: the A/B needs decode
+            # blocks whose device time is non-trivial next to the host work,
+            # or there is nothing for the async loop to overlap
+            try:
+                detail["async_tick_overlap_cpu"] = (
+                    measure_async_tick_overlap(
+                        model, params, "async_tick_overlap_cpu"
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["async_tick_overlap_cpu"] = dict(error=repr(e)[:300])
+                log(f"[async_tick_overlap_cpu] FAILED: {e!r}")
 
     if not cpu_fallback:
         n_params = param_count(cfg_dict)
@@ -1105,6 +1245,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["overload_shedding"] = dict(error=repr(e)[:300])
             log(f"[overload_shedding] FAILED: {e!r}")
+        gc.collect()
+        try:
+            detail["async_tick_overlap"] = measure_async_tick_overlap(
+                model, params, "async_tick_overlap"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["async_tick_overlap"] = dict(error=repr(e)[:300])
+            log(f"[async_tick_overlap] FAILED: {e!r}")
 
         # HEADLINE (BASELINE.json primary config): DeepSeek-Coder-V2-Lite at
         # its real architecture and scale — 27 layers, 64-expert MoE + 2

@@ -36,8 +36,8 @@ RELEASE_PREFIXES = ("release", "free", "_done")
 POOL_HINTS = ("free", "pool", "pages", "slots")
 
 KNOWN_FAULT_SITES = {
-    "scheduler.tick", "replica.dispatch", "multihost.exchange",
-    "server.sse_write",
+    "scheduler.tick", "scheduler.harvest", "replica.dispatch",
+    "multihost.exchange", "server.sse_write",
 }
 # basename -> the inject() site that file must keep calling
 REQUIRED_FAULT_SITES = {

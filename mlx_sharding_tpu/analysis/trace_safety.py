@@ -18,6 +18,13 @@
   helper. Data-dependent shapes recompile the program per distinct value —
   the scheduler's chunked prefill (``_chunk_at``) and the page-rounded pool
   exist precisely to avoid this.
+- **MST104 double-harvest** — a SECOND ``jax.device_get`` inside one
+  tick-hot function. The async scheduler pipeline is built around a single
+  consolidated harvest point per tick (pass a tuple pytree and unpack);
+  each extra ``device_get`` is an extra serialization of the dispatch
+  stream that silently re-introduces the host-blocked gap the pipeline
+  exists to hide. An MST102 suppression on the sync does NOT cover this
+  rule — a second harvest needs its own justification.
 """
 
 from __future__ import annotations
@@ -57,8 +64,9 @@ HOT_PATH_FUNCS = {
     "scheduler.py": {
         # the per-tick path only: _preempt/_release_pages etc. run on rare
         # events (pool pressure), not every decode block
-        "_tick", "_decode_once", "_spec_once", "_prefill_one_chunk",
-        "_grow_for_decode", "_emit",
+        "_tick", "_tick_async", "_decode_once", "_dispatch_block",
+        "_harvest", "_quiesce", "_decoding", "_growth_fits", "_spec_once",
+        "_prefill_one_chunk", "_grow_for_decode", "_emit",
     },
 }
 
@@ -233,6 +241,33 @@ def _check_hot_syncs(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+def _check_double_harvest(mod: ModuleInfo) -> list[Finding]:
+    """MST104: more than one ``jax.device_get`` in a tick-hot function.
+    The pipelined scheduler loop must keep exactly one harvest point —
+    consolidate extra pulls into the first one's tuple pytree."""
+    findings = []
+    for fn in _hot_functions(mod):
+        first = None
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "jax.device_get":
+                continue
+            if first is None:
+                first = node
+                continue
+            findings.append(Finding(
+                "MST104", mod.display_path, node.lineno, node.col_offset,
+                f"second device_get in hot path {fn.name}() (first at line "
+                f"{first.lineno}): consolidate into one harvest — pass a "
+                "tuple pytree and unpack host-side",
+                context=qualname_for_line(mod.tree, node.lineno),
+            ))
+    return findings
+
+
 def _jitted_names(tree: ast.Module) -> set[str]:
     """Names (locals and self.attrs) bound to a jax.jit(...) result."""
     names: set[str] = set()
@@ -307,5 +342,6 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     traced = _traced_closure(_traced_roots(mod.tree, table), table)
     findings = _check_host_effects(mod, traced)
     findings += _check_hot_syncs(mod)
+    findings += _check_double_harvest(mod)
     findings += _check_recompile_hazards(mod)
     return findings

@@ -103,3 +103,18 @@ def reset(cache: KVCache) -> KVCache:
     """Equivalent of the reference's ResetCache RPC (shard/server/server.py:59-71):
     invalidate without reallocating."""
     return cache._replace(offset=jnp.zeros((), jnp.int32))
+
+
+def rewind_slot_offset(cache: KVCache, slot, steps) -> KVCache:
+    """Roll one slot's write offset back by ``steps`` positions (floored at
+    0). ``offset`` must be the per-slot ``(M,)`` layout of the batched
+    engines, not the scalar single-stream layout.
+
+    Used by the async continuous batcher when reclaiming a slot that
+    retired while a lookahead decode block was still in flight: the block's
+    frozen active mask advanced the dead slot's offset up to one block past
+    its true end, and the offset must not point past the pages being
+    returned to the pool."""
+    steps = jnp.asarray(steps, jnp.int32)
+    new = jnp.maximum(cache.offset[slot] - steps, 0)
+    return cache._replace(offset=cache.offset.at[slot].set(new))

@@ -69,6 +69,16 @@ def main(argv=None):
                         "'gather' keeps the contiguous per-slot view, 'auto' "
                         "picks ragged where supported; forwarded to the "
                         "engine, a no-op on dense single-stream runs")
+    parser.add_argument("--async-sched",
+                        choices=("on", "off", "auto"), default="auto",
+                        help="tick pipelining for the continuous batcher: "
+                        "dispatch decode block t+1 before harvesting block "
+                        "t's tokens so host scheduling overlaps device "
+                        "compute ('auto' enables it for plain decode, "
+                        "disables it when a draft engine is attached); "
+                        "accepted here for flag parity with the server — "
+                        "the single-stream CLI path always harvests "
+                        "synchronously, so this is a no-op")
     parser.add_argument("--keep-quantized", action="store_true",
                         help="keep 4-bit decoder weights packed in HBM "
                         "(fused dequant-matmul) instead of dequantizing at "

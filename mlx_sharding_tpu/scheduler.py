@@ -26,6 +26,23 @@ of max_seq — see _spec_ok) shifts where those rounds fall — so a sampled
 stream is replay-stable only among spec-compatible neighbors. Every stream
 remains distribution-exact regardless, and GREEDY streams never consume
 keys, so their token-exactness holds unconditionally.
+
+Async tick pipelining (``async_sched``): the decode loop can run
+double-buffered — dispatch decode block t+1 (a pure device-side state
+chain; last_tok/cache/recent/keys/active never round-trip through the
+host) BEFORE harvesting block t's tokens, so the blocking ``device_get``
+of an already-finished block overlaps the next block's compute and all
+host-side work (emit, stop/cancel, admission bookkeeping) runs while the
+device is busy. Token streams are bit-identical to sync mode: the same
+jitted block programs consume the same device state chain in the same
+order, and per-slot PRNG/repetition state is untouched by neighbors. The
+cost is a one-tick control lag — a slot that finishes during block t
+still participates in the in-flight block t+1 (its lookahead tokens are
+dropped host-side, its pages are retired only at t+1's harvest, and its
+paged-KV overrun is bounded to one decode block by the doubled
+``_grow_ahead``) — and every host-visible state transition (admission
+prefill, preemption, pool-pressure growth, shutdown) must quiesce the
+in-flight block first.
 """
 
 from __future__ import annotations
@@ -44,7 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mlx_sharding_tpu.analysis.runtime import make_lock
-from mlx_sharding_tpu.cache import KVCache
+from mlx_sharding_tpu.cache import KVCache, rewind_slot_offset
 from mlx_sharding_tpu.generate import block_lp_outputs, block_token_logprobs
 from mlx_sharding_tpu.resilience import (
     Deadlines,
@@ -103,6 +120,17 @@ class _Request:
     resume_recent: Optional[np.ndarray] = None
 
 
+@dataclass
+class _InflightBlock:
+    """A dispatched-but-unharvested decode block: the device-side output
+    futures plus the host-side snapshot needed to emit its tokens later."""
+
+    outs: object                     # block output futures (tokens [+ lp])
+    live: list                       # [(slot, req)] snapshot at dispatch
+    want_lp: bool
+    prev_tok: Optional[object] = None  # block's first input (draft replay)
+
+
 class ContinuousBatcher:
     """Drives a :class:`PipelineEngine` (built with ``microbatches=M``,
     ``batch=1``) as an M-slot continuous-batching server backend.
@@ -121,7 +149,7 @@ class ContinuousBatcher:
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
                  policy: str = "fifo", prefix_cache: bool = False,
                  overcommit: bool = False, draft_engine=None, spec_k: int = 4,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None, async_sched: str = "auto"):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
         if max_queue is not None and (not isinstance(max_queue, int) or max_queue < 1):
@@ -173,6 +201,24 @@ class ContinuousBatcher:
             # op stream — worker ranks would desync into a collective hang
             raise ValueError(
                 "overcommit admission is not supported in multi-host serving"
+            )
+        if async_sched not in ("on", "off", "auto"):
+            raise ValueError(
+                f"async_sched must be 'on', 'off' or 'auto', got {async_sched!r}"
+            )
+        if async_sched == "on" and draft_engine is not None:
+            # speculative rounds already harvest per-round accept counts —
+            # the next round's proposals depend on them, so there is no
+            # device-side chain to run ahead on
+            raise ValueError(
+                "async_sched='on' is incompatible with a draft engine; use "
+                "'auto' (resolves to sync when speculating)"
+            )
+        if async_sched == "on" and jax.process_count() > 1:
+            # worker mirrors replay the op stream per broadcast tick; a
+            # rank-local lookahead block would desync the mirrored streams
+            raise ValueError(
+                "async_sched='on' is not supported in multi-host serving"
             )
         self.engine = engine
         self.M = engine.microbatches
@@ -240,6 +286,7 @@ class ContinuousBatcher:
         )
         self._set_last = jax.jit(lambda lt, slot, tok: lt.at[slot, 0].set(tok))
         self._zeros_like = jax.jit(jnp.zeros_like)
+        self._rewind_offset = jax.jit(rewind_slot_offset)
 
         # device-side per-slot state. Paged engines share a page pool across
         # slots — packing mixed-length requests into far less HBM than M
@@ -269,11 +316,33 @@ class ContinuousBatcher:
         # differently than non-speculative decode, as in speculative.py).
         self.draft = draft_engine
         self.spec_k = spec_k
+        # async tick pipelining: resolved mode ("auto" = on for plain
+        # single-host decode, off when speculating or multi-host)
+        self.async_sched = async_sched
+        self._async = async_sched == "on" or (
+            async_sched == "auto"
+            and draft_engine is None
+            and jax.process_count() <= 1
+        )
+        # the block in flight (dispatched, not harvested); owned by the
+        # scheduler thread, always None in sync mode outside _decode_once
+        self._inflight: Optional[_InflightBlock] = None
+        # per-tick timing (racy gauges by design, like kv_bytes_read_*):
+        # device_blocked measures the harvest device_get; host is the rest
+        # of the tick's wall time — the work the async path overlaps
+        self.tick_host_ms_last = 0.0
+        self.tick_device_blocked_ms_last = 0.0
+        self._tick_host_s_total = 0.0
+        self._tick_blocked_s_total = 0.0
+        self._tick_count = 0  # ticks that harvested a block
         # over-commit page growth must cover whichever step writes furthest
-        # ahead: a decode block (1 write/step) or a T=K speculative verify
+        # ahead: a decode block (1 write/step), TWO decode blocks when the
+        # pipeline runs a block ahead of the host's emitted counts (at
+        # dispatch of block t+1 the host has harvested only through t-1),
+        # or a T=K speculative verify
         self._grow_ahead = (
             max(decode_block, spec_k) if draft_engine is not None
-            else self.decode_block
+            else (2 if self._async else 1) * self.decode_block
         )
         if draft_engine is not None:
             self.rounds = 0          # spec telemetry: verify rounds x slots
@@ -573,6 +642,33 @@ class ContinuousBatcher:
             self.kv_bytes_read_total,
         )
 
+    def tick_timing_stats(self) -> dict:
+        """Per-tick host/device-blocked timing for /metrics and the bench:
+        ``device_blocked_ms`` is the harvest ``device_get`` wait (what the
+        async pipeline shrinks by overlapping it with the next block's
+        compute), ``host_ms`` is the rest of the tick's wall time. Racy
+        snapshot by design — a gauge, not a decision input."""
+        n = max(1, self._tick_count)
+        return {
+            "path": "async" if self._async else "sync",
+            "host_ms_last": self.tick_host_ms_last,
+            "device_blocked_ms_last": self.tick_device_blocked_ms_last,
+            "host_ms_avg": 1000.0 * self._tick_host_s_total / n,
+            "device_blocked_ms_avg": 1000.0 * self._tick_blocked_s_total / n,
+            "ticks": self._tick_count,
+        }
+
+    def reset_tick_timing(self):
+        """Zero the tick-timing accumulators. The first ticks after
+        construction pay jit compilation (dispatch-side, so it lands in
+        host_ms) — benchmarks reset after their warmup request so the
+        averages reflect steady state only."""
+        self.tick_host_ms_last = 0.0
+        self.tick_device_blocked_ms_last = 0.0
+        self._tick_host_s_total = 0.0
+        self._tick_blocked_s_total = 0.0
+        self._tick_count = 0
+
     def _account_kv_read(self, live, steps: int, path: Optional[str] = None):
         if not self.paged or not live:
             return
@@ -669,14 +765,17 @@ class ContinuousBatcher:
         in_use = self.engine.pool_pages - len(self._free_pages)
         self.pages_high_water = max(self.pages_high_water, in_use)
 
-    def _release_pages(self, slot: int):
-        for p in self._pages_of.pop(slot, []):
+    def _unref_pages(self, pages):
+        for p in pages:
             r = self._page_ref.get(p, 1) - 1
             if r <= 0:
                 self._page_ref.pop(p, None)
                 self._free_pages.append(p)
             else:
                 self._page_ref[p] = r
+
+    def _release_pages(self, slot: int):
+        self._unref_pages(self._pages_of.pop(slot, []))
 
     def close(self, timeout: float = 10.0):
         with self._start_lock:
@@ -930,12 +1029,32 @@ class ContinuousBatcher:
                 self._put(jnp.asarray(False)),
             )
             if self.paged:
-                # the slot is inactive from the next block on (garbage ticks
-                # route to the scratch table row), so its pages can be
-                # reused immediately; index-registered prompt pages survive
-                # as cache entries (their index ref keeps them off the free
-                # list) until LRU eviction needs them back
+                # The slot is inactive from the next DISPATCH on (garbage
+                # ticks route to the scratch table row), so its pages go
+                # back to the pool immediately — even when an async
+                # lookahead block is still writing them. Safe because the
+                # only later writers of a recycled page (growth for another
+                # slot's NEXT dispatch; admission prefill, which quiesces
+                # first) are blocks the in-flight one strictly precedes on
+                # the device stream, and both attention paths mask rows past
+                # each owner's frontier — the same property that makes
+                # dirty-page recycling sound in sync mode. Decode-region
+                # garbage can never reach an index-registered prompt page
+                # (registration covers only full PROMPT pages; decode
+                # writes start past them). Index-registered pages survive
+                # as cache entries until LRU eviction needs them back.
                 self._release_pages(req.slot)
+                if self._inflight is not None:
+                    # the in-flight block's frozen active mask advances this
+                    # dead slot's offset one block past its true end; queue
+                    # a rewind CHAINED AFTER it (self.cache is its output
+                    # future) so the reclaimed slot's offset never points
+                    # past the pages just returned — no host sync involved
+                    self.cache = self._rewind_offset(
+                        self.cache,
+                        self._put(jnp.asarray(req.slot, jnp.int32)),
+                        self._put(jnp.asarray(self.decode_block, jnp.int32)),
+                    )
             self._slots[req.slot] = None
             req.slot = -1
         req.out.put(None)
@@ -975,8 +1094,15 @@ class ContinuousBatcher:
                 )
                 return outs, tok, cache, recent, keys
 
+            # The CPU client executes donated computations inline at
+            # dispatch (no async stream to alias on), which would serialize
+            # the async pipeline: block t+1's dispatch would block for its
+            # own execution. Donation only pays on accelerator backends —
+            # there it aliases the cache buffers without blocking; on CPU
+            # skip it so dispatch stays async and the overlap is real.
+            donate = () if jax.default_backend() == "cpu" else (5, 7, 8)
             self._decode_block_progs[want_lp] = jax.jit(
-                block, donate_argnums=(5, 7, 8)
+                block, donate_argnums=donate
             )
         return self._decode_block_progs[want_lp]
 
@@ -990,8 +1116,12 @@ class ContinuousBatcher:
         slot = req.slot
         self.preemptions += 1
         if self._prefill_done(req):
-            req.resume_keys = np.asarray(jax.device_get(self.keys)[slot])
-            req.resume_recent = np.asarray(jax.device_get(self.recent)[slot])
+            # one transfer for both sampler rows; runs only quiesced (no
+            # in-flight block) in async mode, so this sync is off the
+            # steady-state decode path
+            keys_h, recent_h = jax.device_get((self.keys, self.recent))
+            req.resume_keys = np.asarray(keys_h[slot])
+            req.resume_recent = np.asarray(recent_h[slot])
             if req.history:
                 req.prompt = np.concatenate(
                     [req.prompt, np.asarray(req.history, np.int32)]
@@ -1074,7 +1204,12 @@ class ContinuousBatcher:
                     break
                 self._preempt(max(victims, key=lambda r: r.admit_seq))
 
-    def _decode_once(self):
+    def _dispatch_block(self) -> Optional[_InflightBlock]:
+        """Dispatch one decode block on the device and return its handle
+        WITHOUT waiting for it: pure device-side state chain (last_tok /
+        cache / recent / keys rebind to output futures), no host reads.
+        The paired :meth:`_harvest` pulls the tokens; the async tick runs
+        them a block apart so the device never waits on host work."""
         eng = self.engine
         if self.paged and self.overcommit:
             self._grow_for_decode()
@@ -1083,20 +1218,42 @@ class ContinuousBatcher:
             (slot, req) for slot, req in enumerate(self._slots)
             if req is not None and self._prefill_done(req)
         ]
+        if not live:
+            return None
         want_lp = any(req.want_logprobs for _, req in live)
+        # analytic gauge; in async mode the lengths are one block stale
         self._account_kv_read(live, self.decode_block)
         # the block's first input token, kept so a draft engine can replay
-        # the exact chain the target consumed (see below)
-        prev_tok = self.last_tok
+        # the exact chain the target consumed (sync/spec fallback only)
+        prev_tok = self.last_tok if self.draft is not None else None
         block = self._decode_block_prog(want_lp)
         outs, self.last_tok, self.cache, self.recent, self.keys = block(
             eng.layer_params, eng.layer_masks, eng.vocab_parts,
             eng.shared_params, self.last_tok, self.cache, self.active,
             self.recent, self.keys, self.sp, self.rep_sizes, self.table,
         )
+        return _InflightBlock(outs=outs, live=live, want_lp=want_lp,
+                              prev_tok=prev_tok)
+
+    def _harvest(self, inf: Optional[_InflightBlock]):
+        """Pull a dispatched block's tokens to the host and run all of its
+        host-side consequences: emit per slot (lookahead tokens of a slot
+        that finished after dispatch are dropped by the ``req.slot != slot``
+        skip), draft replay, finish/reclaim. The ONE ``device_get`` here is
+        the tick sync — the async loop must never grow a second harvest
+        point (MST104)."""
+        if inf is None:
+            return
+        inject("scheduler.harvest")  # fault harness: kill the harvest
+        t0 = time.perf_counter()
         # mst: allow(MST102): THE tick sync — tokens must reach the host
-        outs = jax.device_get(outs)
+        outs, prev = jax.device_get((inf.outs, inf.prev_tok))
+        blocked = time.perf_counter() - t0
+        self.tick_device_blocked_ms_last = blocked * 1000.0
+        self._tick_blocked_s_total += blocked
+        self._tick_count += 1
         toks = outs[0]  # (K, M, 1)
+        live = inf.live
         if self.draft is not None and live:
             # This tick fell back to plain decode (spec paused — logprobs
             # wanted, or a slot within K of max_seq): the target just
@@ -1106,10 +1263,7 @@ class ContinuousBatcher:
             # toks[j-1] (step 0 consumed prev_tok), so the replay chain is
             # [prev_tok, toks[:-1]]. Deterministic device ops only — every
             # multi-host mirror computes the identical replay in lockstep.
-            # mst: allow(MST102): replay chain needs last block's tokens
-            prev = np.asarray(jax.device_get(prev_tok))  # (M, 1)
-            # mst: allow(MST102): toks is already host-side (free copy)
-            chain = np.concatenate([prev[None], np.asarray(toks[:-1])], 0)
+            chain = np.concatenate([prev[None], toks[:-1]], 0)
             self.dcache = self.draft.spec_replay_cb(self.decode_block)(
                 self.draft.layer_params, self.draft.layer_masks,
                 self.draft.vocab_parts, self.draft.shared_params,
@@ -1122,9 +1276,14 @@ class ContinuousBatcher:
                 if req.slot != slot:  # finished (max_tokens) earlier in block
                     continue
                 lp = None
-                if want_lp and req.want_logprobs:
+                if inf.want_lp and req.want_logprobs:
                     lp = block_token_logprobs(outs, j, slot)
                 self._emit(req, int(toks[j, slot, 0]), lp)
+
+    def _decode_once(self):
+        # the sync composition point — MultiHostBatcher overrides THIS to
+        # broadcast the tick before the mirrored dispatch+harvest
+        self._harvest(self._dispatch_block())
 
     def _need_pages(self, req: _Request) -> int:
         """Pages to map at admission. Reserve mode (default) claims the whole
@@ -1191,10 +1350,10 @@ class ContinuousBatcher:
         self.dcache = self.dcache._replace(
             offset=self._drewind(self.dcache.offset, count, self.active)
         )
-        # mst: allow(MST102): THE spec-tick sync — accepted tokens to host
-        counts = np.asarray(jax.device_get(count))
-        # mst: allow(MST102): same sync point; gs rides the same transfer
-        gs_h = np.asarray(jax.device_get(gs))
+        # THE spec-tick sync — accepted counts + token ids reach the host
+        # in one transfer (the round's single harvest)
+        # mst: allow(MST102): the spec round's one consolidated harvest
+        counts, gs_h = jax.device_get((count, gs))
         self.rounds += len(live)
         for slot, req in live:
             emitted = 0
@@ -1274,6 +1433,102 @@ class ContinuousBatcher:
         except queue.Empty:
             pass
 
+    def _decoding(self) -> bool:
+        """Host mirror of the device ``active`` mask: a slot is decoding iff
+        it holds a request whose prefill completed. Exact by construction —
+        ``active[slot]`` flips True only at prefill completion and False
+        only in _finish/_preempt/_fail_all, each of which also clears
+        ``_slots[slot]`` — so the branch gates on host state instead of a
+        per-tick device round-trip."""
+        return any(
+            r is not None and self._prefill_done(r) for r in self._slots
+        )
+
+    def _quiesce(self):
+        """Drain the pipeline: harvest the in-flight block (if any) so every
+        host-visible consequence of it — emitted tokens, finishes, freed
+        pages — has landed and the device is idle. Required before anything
+        that reads device state or host token counts the lookahead block is
+        still mutating: admission prefill, preemption, pool-pressure growth
+        that might preempt, shutdown."""
+        inf, self._inflight = self._inflight, None
+        self._harvest(inf)
+
+    def _growth_fits(self) -> bool:
+        """True iff the next ``_grow_for_decode`` is guaranteed to cover
+        every decoding slot's block from free + evictable pages alone, i.e.
+        growth cannot preempt. Mirrors _grow_for_decode's want/cap math
+        exactly; the aggregate bound is exact because evictions only free
+        index-only pages (never counted in any slot's ``have``) and nothing
+        else allocates between the check and the growth. The emitted/
+        produced counts are one block stale in async mode — which the
+        doubled ``_grow_ahead`` already covers — and ``cap`` is
+        staleness-invariant (history and produced increment together)."""
+        if not (self.paged and self.overcommit):
+            return True
+        page = self.engine.page_size
+        K = self._grow_ahead
+        need = 0
+        for slot, req in enumerate(self._slots):
+            if req is None or not self._prefill_done(req):
+                continue
+            have = len(self._pages_of.get(slot, ()))
+            emitted = len(req.history)
+            offset = req.prompt.size + max(0, emitted - 1)
+            cap = self._pages_needed(
+                req.prompt.size, emitted + (req.max_tokens - req.produced)
+            )
+            want = min(-(-(offset + K) // page), cap)
+            need += max(0, want - have)
+        return need <= len(self._free_pages) + self._evictable_pages()
+
+    def _tick_async(self):
+        """One double-buffered scheduler iteration: dispatch decode block
+        t+1 BEFORE harvesting block t, so the harvest's device_get waits
+        only on the already-finished block while the device computes ahead,
+        and the host-side emit/stop/admission work below runs concurrently
+        with it. Admission prefill, growth that could preempt, and the
+        idle path quiesce the pipeline first (one-block drain), then the
+        double-buffering resumes on the next tick."""
+        inject("scheduler.tick")  # fault harness: wedge/delay/fail a tick
+        self._reap_cancelled()
+        self._drain_submissions()
+        if (self._waiting and None in self._slots) or any(
+            r is not None and not self._prefill_done(r) for r in self._slots
+        ):
+            # prefill (admission or mid-admission chunks) samples the first
+            # token host-side and rewrites slot state: drain the lookahead
+            # block before touching the engine
+            self._quiesce()
+        self._admit_waiting()
+        prefilling = [
+            r for r in self._slots
+            if r is not None and not self._prefill_done(r)
+        ]
+        if prefilling:
+            if self._decoding():
+                self._prefill_rr += 1
+                self._prefill_one_chunk(
+                    prefilling[self._prefill_rr % len(prefilling)]
+                )
+            else:
+                for req in prefilling:
+                    self._prefill_one_chunk(req)
+        if self._decoding():
+            if self.paged and self.overcommit and not self._growth_fits():
+                # growth might preempt (device_get of sampler rows + page
+                # reshuffle): only safe against a drained pipeline
+                self._quiesce()
+            prev, self._inflight = self._inflight, None
+            self._inflight = self._dispatch_block()
+            self._harvest(prev)
+        else:
+            self._quiesce()  # leftover lookahead block of finished slots
+            if not any(self._slots):
+                # idle: block until the next request arrives
+                self._drain_submissions(block=True)
+                self._admit_waiting()
+
     def _tick(self):
         """One scheduler iteration: reap, admit waiting requests into free
         slots (policy + page-reservation gated), prefill mid-admission
@@ -1293,8 +1548,7 @@ class ContinuousBatcher:
             r for r in self._slots
             if r is not None and not self._prefill_done(r)
         ]
-        # mst: allow(MST102): M-bool mask, tiny transfer, gates the branch
-        decoding = bool(np.asarray(self.active).any())
+        decoding = self._decoding()
         if prefilling:
             if decoding:
                 self._prefill_rr += 1
@@ -1304,8 +1558,7 @@ class ContinuousBatcher:
             else:
                 for req in prefilling:
                     self._prefill_one_chunk(req)
-        # mst: allow(MST102): M-bool mask, tiny transfer, gates the branch
-        if bool(np.asarray(self.active).any()):
+        if self._decoding():
             if self.draft is not None and self._spec_ok():
                 self._spec_once()
             else:
@@ -1316,6 +1569,9 @@ class ContinuousBatcher:
             self._admit_waiting()
 
     def _fail_all(self, exc: BaseException):
+        # drop the lookahead block's futures (host-side); the wholesale
+        # pool reset below reclaims whatever it was still writing
+        self._inflight = None
         for slot, req in enumerate(self._slots):
             if req is not None:
                 req.slot = -1
@@ -1341,9 +1597,23 @@ class ContinuousBatcher:
                 req.out.put(exc)
 
     def _loop(self):
+        tick = self._tick_async if self._async else self._tick
         while not self._stop:
             try:
-                self._tick()
+                t0 = time.perf_counter()
+                b0 = self._tick_blocked_s_total
+                c0 = self._tick_count
+                tick()
+                if self._tick_count > c0:
+                    # only ticks that harvested a block carry the timing
+                    # signal (idle waits would swamp the host-side average)
+                    host = max(
+                        0.0,
+                        (time.perf_counter() - t0)
+                        - (self._tick_blocked_s_total - b0),
+                    )
+                    self.tick_host_ms_last = host * 1000.0
+                    self._tick_host_s_total += host
             except Exception as exc:  # noqa: BLE001 — a dead scheduler thread
                 # would hang every consumer; surface the error to them instead
                 self._fail_all(exc)
@@ -1351,6 +1621,7 @@ class ContinuousBatcher:
         # Host-side only — no device ops here: the engine is being dropped,
         # and in multi-host serving a device op after the final broadcast
         # would be a one-rank collective entry (a hang, not a cleanup).
+        self._inflight = None  # abandon the lookahead block's futures
         for slot, req in enumerate(self._slots):
             if req is not None:
                 self._slots[slot] = None

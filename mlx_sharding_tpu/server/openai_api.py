@@ -125,10 +125,15 @@ class ModelProvider:
         prompt_cache: bool = False,
         replicas: int = 1,
         max_queue: Optional[int] = None,
+        async_sched: str = "auto",
     ):
         # admission control: per-batcher bound on queued requests; a full
         # queue rejects with QueueFullError (HTTP 429 + Retry-After)
         self.max_queue = max_queue
+        # async tick pipelining in the continuous batcher: dispatch decode
+        # block t+1 before harvesting block t ("auto" = on for plain
+        # single-host decode, off when speculating/multi-host)
+        self.async_sched = async_sched
         # data-parallel serving: R independent engine replicas, each on its
         # own slice of jax.devices(), least-loaded request routing
         self.replicas = max(1, replicas)
@@ -320,6 +325,7 @@ class ModelProvider:
                                 draft_engine=draft_eng,
                                 spec_k=self.spec_k,
                                 max_queue=self.max_queue,
+                                async_sched=self.async_sched,
                             )
                         return engine
 
@@ -1141,6 +1147,16 @@ def main(argv=None):
                         help="decode steps fused per program launch (token "
                              "pulls amortize over this many tokens; set 1 "
                              "for strict per-token streaming on a local chip)")
+    parser.add_argument("--async-sched", choices=("on", "off", "auto"),
+                        default="auto",
+                        help="with --concurrent: async tick pipelining — "
+                             "dispatch decode block t+1 before harvesting "
+                             "block t, overlapping host-side emit/stop/"
+                             "admission work with device compute (token "
+                             "streams stay bit-identical to sync). 'auto' "
+                             "(default) enables it for plain decode and "
+                             "falls back to sync with --draft-model or "
+                             "multi-host; 'off' forces the sequential tick")
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
     parser.add_argument("--request-timeout", type=float, default=None,
@@ -1271,6 +1287,19 @@ def main(argv=None):
         if args.concurrent <= 1:
             parser.error("--max-queue requires --concurrent N (N > 1): only "
                          "the continuous batcher has a submit queue to bound")
+    if args.async_sched != "auto" and args.concurrent <= 1:
+        parser.error("--async-sched requires --concurrent N (N > 1): only "
+                     "the continuous batcher has a tick loop to pipeline")
+    if args.async_sched == "on" and args.draft_model:
+        parser.error("--async-sched on is incompatible with --draft-model "
+                     "(speculative rounds harvest per-round accept counts); "
+                     "use 'auto'")
+    if args.async_sched == "on" and args.coordinator and (
+        args.num_processes or 1
+    ) > 1:
+        parser.error("--async-sched on is not supported in multi-host "
+                     "serving (worker mirrors replay the op stream per "
+                     "broadcast tick); use 'auto'")
     for flag, val in (("--request-timeout", args.request_timeout),
                       ("--ttft-timeout", args.ttft_timeout)):
         if val is not None and val <= 0:
@@ -1290,6 +1319,7 @@ def main(argv=None):
         draft_model=args.draft_model, spec_k=args.spec_k,
         prompt_cache=args.prompt_cache, replicas=args.replicas,
         max_queue=args.max_queue,
+        async_sched=args.async_sched,
     )
     if multihost:
         import jax

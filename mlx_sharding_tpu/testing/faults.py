@@ -12,6 +12,9 @@ Sites wired into the serving stack:
 
 - ``scheduler.tick``      — top of every ContinuousBatcher scheduler tick
   (arm a gate/delay here to wedge the engine mid-generation)
+- ``scheduler.harvest``   — the harvest boundary of a dispatched decode
+  block, just before THE tick sync (kill the in-flight block here to test
+  that the async pipeline sheds cleanly: no wedged slots, pages returned)
 - ``replica.dispatch``    — before a ReplicaSet routes a request into a
   replica; ctx ``replica=<i>`` (match to delay/fail one specific replica)
 - ``multihost.exchange``  — top of every ControlPlane collective (raise

@@ -162,6 +162,23 @@ class ServingMetrics:
                         "# TYPE mst_kv_bytes_read_total counter",
                         f"mst_kv_bytes_read_total {total_bytes}",
                     ]
+                tick = getattr(b, "tick_timing_stats", lambda: None)()
+                if tick is not None:
+                    # which run-loop the batcher is on (1 = double-buffered
+                    # async pipeline, 0 = classic dispatch-then-harvest) and
+                    # where each tick's wall time went: blocked on the
+                    # harvest device_get vs. doing host-side scheduling work
+                    path = tick["path"]
+                    lines += [
+                        "# TYPE mst_sched_async gauge",
+                        f"mst_sched_async {int(path == 'async')}",
+                        "# TYPE mst_tick_host_ms gauge",
+                        f'mst_tick_host_ms{{path="{path}"}} '
+                        f"{tick['host_ms_last']:.3f}",
+                        "# TYPE mst_tick_device_blocked_ms gauge",
+                        f'mst_tick_device_blocked_ms{{path="{path}"}} '
+                        f"{tick['device_blocked_ms_last']:.3f}",
+                    ]
                 res = getattr(b, "resilience_stats", lambda: None)()
                 if res is not None:
                     lines += [

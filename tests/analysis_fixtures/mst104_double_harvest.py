@@ -1,0 +1,9 @@
+"""MST104: a second blocking device_get inside one tick-hot function."""
+import jax
+
+
+# mst: hot-path
+def harvest_tick(outs, prev):
+    toks = jax.device_get(outs)  # mst: allow(MST102): THE tick sync
+    hist = jax.device_get(prev)  # mst: allow(MST102): also reaches the host
+    return toks, hist

@@ -97,3 +97,46 @@ def test_metrics_expose_batcher_slots():
     assert "mst_batch_queue_depth 3" in text
     # and none of it when no batcher is live
     assert "mst_batch_slots" not in ServingMetrics().render()
+
+
+def test_metrics_expose_tick_timing():
+    """/metrics reports the scheduler path (sync vs async tick pipeline)
+    and the per-tick host/device-blocked split (tick_timing_stats()
+    contract)."""
+    from mlx_sharding_tpu.utils.observability import ServingMetrics
+
+    class _FakeBatcher:
+        def stats(self):
+            return (2, 1, 0)
+
+        def tick_timing_stats(self):
+            return {
+                "path": "async",
+                "host_ms_last": 1.25,
+                "device_blocked_ms_last": 0.5,
+                "host_ms_avg": 1.0,
+                "device_blocked_ms_avg": 0.75,
+                "ticks": 7,
+            }
+
+    text = ServingMetrics(batcher_fn=lambda: _FakeBatcher()).render()
+    assert "mst_sched_async 1" in text
+    assert 'mst_tick_host_ms{path="async"} 1.250' in text
+    assert 'mst_tick_device_blocked_ms{path="async"} 0.500' in text
+
+    class _SyncBatcher(_FakeBatcher):
+        def tick_timing_stats(self):
+            return dict(_FakeBatcher.tick_timing_stats(self), path="sync")
+
+    text = ServingMetrics(batcher_fn=lambda: _SyncBatcher()).render()
+    assert "mst_sched_async 0" in text
+    assert 'mst_tick_host_ms{path="sync"} 1.250' in text
+
+    class _NoTickBatcher:
+        def stats(self):
+            return (2, 1, 0)
+
+    # a batcher without the accessor (or a plain fake) emits no tick gauges
+    text = ServingMetrics(batcher_fn=lambda: _NoTickBatcher()).render()
+    assert "mst_tick_host_ms" not in text
+    assert "mst_sched_async" not in text

@@ -717,3 +717,235 @@ def test_spec_draft_replay_after_fallback_keeps_acceptance(spec_perfect):
     # a desynced draft degenerates to ~1 accepted/round; the replayed one
     # keeps the perfect draft's multi-token acceptance
     assert accepted >= 2 * rounds
+
+
+# ------------------------------------------- async tick pipelining (ISSUE 4)
+# The module fixtures above already run the async path (async_sched defaults
+# to "auto" = on for plain single-host decode), so every stream-vs-serial
+# assertion in this file doubles as async-correctness coverage — including
+# overcommit preemption (oc_setup) and pool exhaustion. The tests below pin
+# the explicit sync-vs-async contract: BIT-IDENTICAL token streams, clean
+# one-tick-lag handling, and clean shedding when the in-flight block dies.
+
+
+def test_async_sched_validation(setup, spec_setup):
+    batcher, _ = setup
+    spec, _ = spec_setup
+    assert batcher._async  # auto -> on for plain single-host decode
+    assert not spec._async  # auto -> off with a draft engine attached
+    with pytest.raises(ValueError, match="async_sched"):
+        ContinuousBatcher(batcher.engine, async_sched="sometimes")
+    with pytest.raises(ValueError, match="draft"):
+        ContinuousBatcher(
+            spec.engine, draft_engine=spec.draft, async_sched="on"
+        )
+    off = ContinuousBatcher(batcher.engine, async_sched="off")
+    try:
+        assert not off._async
+        assert off.tick_timing_stats()["path"] == "sync"
+    finally:
+        off.close()
+    assert batcher.tick_timing_stats()["path"] == "async"
+
+
+def test_async_matches_sync_token_exact_matrix():
+    """The core contract: the double-buffered pipeline emits BIT-IDENTICAL
+    streams to the classic loop across the request matrix — greedy, seeded
+    sampling, multi-chunk admission, repetition penalty, and max_tokens
+    boundaries (1-token streams and streams that run to their budget)."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+
+    def make(mode):
+        eng = PipelineEngine(
+            model, params, pipeline_mesh(2), microbatches=2, max_seq=64,
+            cache_dtype=jnp.float32, prefill_chunk=8,
+        )
+        return ContinuousBatcher(eng, async_sched=mode)
+
+    jobs = [
+        ([3, 17, 42], dict(max_tokens=10)),  # greedy
+        ([5, 6, 2], dict(temperature=0.9, top_p=0.8, seed=11,
+                         max_tokens=8)),  # seeded sampled
+        (list(range(1, 20)), dict(max_tokens=6)),  # multi-chunk admission
+        ([9, 1, 4, 7], dict(max_tokens=1)),  # max_tokens boundary: one token
+        ([3, 3, 7, 7, 2], dict(repetition_penalty=1.4,
+                               repetition_context_size=6, max_tokens=12)),
+    ]
+    streams = {}
+    for mode in ("off", "on"):
+        batcher = make(mode)
+        try:
+            got, _ = _concurrent(batcher, jobs[:2])
+            got += _concurrent(batcher, jobs[2:4])[0]
+            got.append(_run(batcher, jobs[4][0], **jobs[4][1]))
+            streams[mode] = got
+        finally:
+            batcher.close()
+    assert streams["on"] == streams["off"]
+    assert all(len(s) for s in streams["on"])
+
+
+def test_async_mid_stream_cancellation_sheds_lookahead():
+    """A client dropping its stream mid-generation under the async loop: the
+    one-tick control lag means a lookahead block for the dead slot may still
+    complete on device — its tokens must be dropped host-side, its pages
+    returned, and the surviving stream must stay token-exact. (Server-side
+    stop sequences cancel streams through this same path.)"""
+    batcher, ref = _paged_batcher(pool_pages=8)
+    try:
+        assert batcher._async
+        survivor_kw = dict(max_tokens=16)
+        want = _run(ref, [9, 4, 4, 6], **survivor_kw)
+        got = []
+        cancelled_tokens = []
+
+        def cancel_worker():
+            gen = batcher.generate_step([7, 7, 2, 1], max_tokens=30)
+            for t, _ in gen:
+                cancelled_tokens.append(t)
+                if len(cancelled_tokens) == 3:
+                    gen.close()  # client walked away mid-stream
+                    return
+
+        def survivor_worker():
+            got.extend(_run(batcher, [9, 4, 4, 6], **survivor_kw))
+
+        threads = [
+            threading.Thread(target=cancel_worker),
+            threading.Thread(target=survivor_worker),
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+            assert not th.is_alive(), "generation thread hung"
+        assert got == want
+        assert len(cancelled_tokens) == 3
+        # a follow-up request forces the loop through quiesce + admission;
+        # after it the cancelled slot's pages must all be home
+        assert _run(batcher, [1, 2], max_tokens=3) == _run(
+            ref, [1, 2], max_tokens=3
+        )
+        total, in_use, _ = batcher.page_stats()
+        assert in_use == 0 and len(batcher._free_pages) == total
+        assert all(r is None for r in batcher._slots)
+    finally:
+        batcher.close()
+
+
+def test_async_harvest_fault_sheds_cleanly():
+    """Kill the in-flight block at the harvest boundary (the new
+    scheduler.harvest fault site): every consumer gets the error instead of
+    hanging, no slot stays wedged, every page returns to the pool, and the
+    batcher serves the next request normally."""
+    from mlx_sharding_tpu.testing import faults
+
+    batcher, ref = _paged_batcher(pool_pages=8)
+    try:
+        assert batcher._async
+        f = faults.arm("scheduler.harvest", exc=RuntimeError("harvest kill"),
+                       after=2, times=1)
+        errors = []
+
+        def worker(prompt):
+            try:
+                _run(batcher, prompt, max_tokens=24)
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        threads = [
+            threading.Thread(target=worker, args=(p,))
+            for p in ([7, 7, 2, 1], [9, 4, 4, 6])
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+            assert not th.is_alive(), "consumer hung after harvest fault"
+        assert f.fired == 1
+        assert len(errors) == 2 and all("harvest kill" in e for e in errors)
+        # clean shed: no wedged slots, the whole pool back on the free list.
+        # _fail_all surfaces the error to consumers BEFORE its pool reset,
+        # so give the scheduler thread a beat to finish the reset.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            total, in_use, _ = batcher.page_stats()
+            if in_use == 0 and len(batcher._free_pages) == total:
+                break
+            time.sleep(0.01)
+        assert all(r is None for r in batcher._slots)
+        total, in_use, _ = batcher.page_stats()
+        assert in_use == 0 and len(batcher._free_pages) == total
+        # and the scheduler thread survived to serve the next request
+        assert _run(batcher, [3, 4], max_tokens=4) == _run(
+            ref, [3, 4], max_tokens=4
+        )
+    finally:
+        faults.disarm()
+        batcher.close()
+
+
+@pytest.mark.slow  # engine-pair sweep; the quick tier covers async prefix
+def test_async_prefix_cache_hits_match_sync():
+    """Prefix-cache hits through both run loops: identical streams and
+    identical hit/reuse accounting (admission prefill quiesces the in-flight
+    block, so a hit can never race the lookahead)."""
+    stats = {}
+    streams = {}
+    prompt = [((7 * i) % 251) + 1 for i in range(20)]
+    for mode in ("off", "on"):
+        batcher, ref = _paged_cached_batcher(async_sched=mode)
+        try:
+            first = _run(batcher, prompt, max_tokens=8)
+            second = _run(batcher, prompt, max_tokens=8)
+            assert first == second == _run(ref, prompt, max_tokens=8)
+            streams[mode] = (first, second)
+            q, h, reused, _, cached = batcher.prefix_stats()
+            stats[mode] = (q, h, reused, cached)
+        finally:
+            batcher.close()
+    assert streams["on"] == streams["off"]
+    assert stats["on"] == stats["off"]
+    assert stats["on"][1] == 1  # the repeat really hit
+
+
+@pytest.mark.slow  # engine-pair sweep; oc_setup covers async+overcommit
+def test_async_overcommit_preemption_matches_sync():
+    """Preemption under over-commit through both run loops: identical
+    streams (quiesce-before-preempt keeps token accounting exact under the
+    one-tick lag) and a fully-free pool afterwards."""
+    streams = {}
+    jobs = [
+        ([7, 7, 2, 1], dict(max_tokens=40)),
+        ([9, 4, 4, 6], dict(temperature=0.9, top_p=0.85, seed=321,
+                            repetition_penalty=1.3, repetition_context_size=8,
+                            max_tokens=36)),
+    ]
+    for mode in ("off", "on"):
+        batcher, _ = _paged_batcher(pool_pages=8, overcommit=True,
+                                    async_sched=mode)
+        try:
+            before = batcher.preemptions
+            got, _ = _concurrent(batcher, jobs)
+            assert batcher.preemptions > before
+            total, in_use, _ = batcher.page_stats()
+            assert in_use == 0 and len(batcher._free_pages) == total
+            streams[mode] = got
+        finally:
+            batcher.close()
+    assert streams["on"] == streams["off"]
+
+
+def test_async_tick_timing_stats_populated(setup):
+    """The per-tick host / device-blocked split feeding /metrics and the
+    bench's async_tick_overlap phase: ticks counted, averages finite."""
+    batcher, _ = setup
+    _run(batcher, [2, 9, 5], max_tokens=6)
+    t = batcher.tick_timing_stats()
+    assert t["path"] == "async"
+    assert t["ticks"] > 0
+    assert t["device_blocked_ms_avg"] >= 0.0
+    assert t["host_ms_avg"] >= 0.0
+    assert t["device_blocked_ms_last"] >= 0.0
