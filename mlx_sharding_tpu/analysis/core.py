@@ -30,6 +30,7 @@ SUPPRESS_RE = re.compile(
     r"#\s*mst:\s*allow\(\s*([A-Za-z0-9_\-, ]+?)\s*\)(?:\s*:\s*(\S.*))?"
 )
 HOT_PATH_RE = re.compile(r"#\s*mst:\s*hot-path\b")
+DECODE_HOT_RE = re.compile(r"#\s*mst:\s*decode-hot\b")
 
 
 @dataclass(frozen=True)
@@ -62,6 +63,7 @@ class ModuleInfo:
     suppressions: dict[int, set[str]] = field(default_factory=dict)
     bad_suppressions: list[int] = field(default_factory=list)
     hot_lines: set[int] = field(default_factory=set)  # '# mst: hot-path'
+    decode_hot_lines: set[int] = field(default_factory=set)  # 'decode-hot'
 
     @property
     def basename(self) -> str:
@@ -123,6 +125,8 @@ def parse_module(path: Path, display_path: str) -> tuple[Optional[ModuleInfo], l
             continue
         if HOT_PATH_RE.search(text):
             mod.hot_lines.add(i)
+        if DECODE_HOT_RE.search(text):
+            mod.decode_hot_lines.add(i)
         m = SUPPRESS_RE.search(text)
         if m:
             rules = {r.strip() for r in m.group(1).split(",") if r.strip()}

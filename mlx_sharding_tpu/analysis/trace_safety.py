@@ -25,6 +25,16 @@
   stream that silently re-introduces the host-blocked gap the pipeline
   exists to hide. An MST102 suppression on the sync does NOT cover this
   rule — a second harvest needs its own justification.
+- **MST105 dense-dequant-in-decode** — a ``dequantize(...)`` result bound
+  to a name inside a decode-hot function (the packed-matmul dispatchers in
+  ``quant.py``, plus anything annotated ``# mst: decode-hot``) or anything
+  it transitively calls in the same file. Materializing the dense bf16
+  weight tile in HBM re-pays the full 4x weight traffic the packed path
+  exists to delete, once per decode step. Fused-kernel dequant is invisible
+  to this rule (Pallas kernel bodies are passed to ``pallas_call``, never
+  called by name, so the call-closure walk never enters them); a guarded
+  fallback whose dense tile is transient carries an inline
+  ``# mst: allow(MST105): …``.
 """
 
 from __future__ import annotations
@@ -72,6 +82,15 @@ HOT_PATH_FUNCS = {
 
 SYNC_CALLS = {"jax.device_get", "np.asarray", "np.array", "numpy.asarray",
               "numpy.array"}
+
+# decode-hot roots checked by MST105 (beyond '# mst: decode-hot'
+# annotations): every packed decode matmul funnels through these
+DECODE_HOT_FUNCS = {
+    "quant.py": {"linear", "_quant_matmul"},
+}
+
+# call names that materialize a dense weight tile from a packed triple
+DEQUANT_CALLS = {"dequantize", "dequant"}
 
 # shape expressions routed through these calls are considered bucketed
 BUCKETING_FUNCS = {"_chunk_at", "_pages_needed", "round_up", "bucket",
@@ -268,6 +287,47 @@ def _check_double_harvest(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+def _check_dense_dequant(mod: ModuleInfo, table: dict) -> list[Finding]:
+    """MST105: a dense dequantized-weight materialization reachable from a
+    decode-hot function. Roots come from ``DECODE_HOT_FUNCS`` (by basename)
+    and ``# mst: decode-hot`` annotations; reachability is the same
+    same-file call closure the trace rules use. Only a dequant call bound
+    by an assignment fires — a dequant expression consumed in place inside
+    a kernel body never appears here, because kernel bodies are passed to
+    ``pallas_call`` rather than called by name."""
+    roots: list[ast.AST] = []
+    configured = DECODE_HOT_FUNCS.get(mod.basename, set())
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        annotated = any(
+            line in mod.decode_hot_lines
+            for line in (node.lineno, node.lineno - 1)
+        )
+        if node.name in configured or annotated:
+            roots.append(node)
+    findings = []
+    for fn in _traced_closure(roots, table):
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            name = dotted_name(node.value.func)
+            if name is None or name.split(".")[-1] not in DEQUANT_CALLS:
+                continue
+            fname = getattr(fn, "name", "<lambda>")
+            findings.append(Finding(
+                "MST105", mod.display_path, node.lineno, node.col_offset,
+                f"dense dequantized weight materialized in decode-hot "
+                f"{fname}(): {name}(...) rebuilds the full-precision tile "
+                "in HBM every step — fuse the dequant into the kernel or "
+                "justify the guarded fallback",
+                context=qualname_for_line(mod.tree, node.lineno),
+            ))
+    return findings
+
+
 def _jitted_names(tree: ast.Module) -> set[str]:
     """Names (locals and self.attrs) bound to a jax.jit(...) result."""
     names: set[str] = set()
@@ -344,4 +404,5 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_hot_syncs(mod)
     findings += _check_double_harvest(mod)
     findings += _check_recompile_hazards(mod)
+    findings += _check_dense_dequant(mod, table)
     return findings

@@ -27,17 +27,53 @@ import jax.numpy as jnp
 
 
 class KVCache(NamedTuple):
-    k: jax.Array  # (L, B, S, H_kv, D_k)
-    v: jax.Array  # (L, B, S, H_kv, D_v)
+    k: jax.Array  # (L, B, S, H_kv, D_k) — or {"d": int8, "s": f32} (paged int8)
+    v: jax.Array  # (L, B, S, H_kv, D_v) — same
     offset: jax.Array  # scalar int32 — number of valid positions
 
     @property
     def max_seq(self) -> int:
-        return self.k.shape[2]
+        return kv_data(self.k).shape[2]
 
     @property
     def num_layers(self) -> int:
-        return self.k.shape[0]
+        return kv_data(self.k).shape[0]
+
+
+def is_quantized_kv(buf) -> bool:
+    """True for an int8 KV buffer: ``{"d": int8 data, "s": float scales}``
+    with the scale's trailing dim 1 broadcasting over head_dim."""
+    return isinstance(buf, dict) and "d" in buf
+
+
+def kv_data(buf) -> jax.Array:
+    """The data leaf of a KV buffer — the int8 payload for quantized pools,
+    the array itself otherwise. Shape-only bookkeeping (page counts, slot
+    geometry) reads this so it never cares about the storage mode."""
+    return buf["d"] if is_quantized_kv(buf) else buf
+
+
+def quantize_kv_rows(rows: jax.Array) -> dict:
+    """(…, H, D) float rows → ``{"d": int8, "s": f32 (…, H, 1)}`` with a
+    per-row-per-head symmetric scale ``max|x| / 127``.
+
+    Per-ROW scales (not per-page) are deliberate: ragged decode writes one
+    row into a page per tick, and a per-page scale would force a read-
+    modify-write rescale of the other rows on every write. Rows are
+    independent — writeback, scatter, and rewind all stay pure writes."""
+    x = rows.astype(jnp.float32)
+    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    d = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    return {"d": d, "s": s.astype(jnp.float32)}
+
+
+def dequantize_kv(buf, dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_kv_rows`; passes dense buffers through
+    (after a dtype cast) so call sites handle both storage modes."""
+    if not is_quantized_kv(buf):
+        return buf.astype(dtype)
+    return (buf["d"].astype(jnp.float32) * buf["s"]).astype(dtype)
 
 
 def init_cache(

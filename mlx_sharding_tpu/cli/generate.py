@@ -79,6 +79,12 @@ def main(argv=None):
                         "accepted here for flag parity with the server — "
                         "the single-stream CLI path always harvests "
                         "synchronously, so this is a no-op")
+    parser.add_argument("--kv-dtype", choices=("bf16", "int8"), default=None,
+                        help="KV-pool storage dtype; accepted for flag "
+                        "parity with the server. 'int8' needs a paged pool "
+                        "(server --concurrent/--paged-pool) — the "
+                        "single-stream CLI allocates dense caches, so only "
+                        "'bf16' is valid here")
     parser.add_argument("--keep-quantized", action="store_true",
                         help="keep 4-bit decoder weights packed in HBM "
                         "(fused dequant-matmul) instead of dequantizing at "
@@ -96,6 +102,9 @@ def main(argv=None):
     if args.draft_model and (args.sp or args.stage_bounds or args.num_stages
                              or args.tp > 1 or args.ep > 1):
         parser.error("--draft-model applies to the single-chip generator")
+    if args.kv_dtype == "int8":
+        parser.error("--kv-dtype int8 requires a paged KV pool; serve with "
+                     "--concurrent N --paged-pool P instead")
 
     import jax.numpy as jnp
 
@@ -135,6 +144,7 @@ def main(argv=None):
             stage_bounds=bounds,
             max_seq=args.max_seq, prefill_chunk=args.prefill_chunk,
             paged_attention=args.paged_attention,
+            kv_dtype=args.kv_dtype,
         )
     else:
         model, params = load_model(

@@ -43,6 +43,11 @@ class BaseConfig:
     # MLX-style grouped affine quantization descriptor, e.g.
     # {"group_size": 64, "bits": 4} (ref: shard/utils.py:54-65).
     quantization: Optional[dict] = None
+    # KV-cache storage dtype for paged engines: "int8" stores per-row-per-
+    # head-scaled codes ({d, s} pools, see cache.quantize_kv_rows); None/
+    # "bf16" keeps the dense cache_dtype pool. Server/CLI --kv-dtype
+    # overrides; checkpoints may pin it here.
+    kv_cache_dtype: Optional[str] = None
 
     def __post_init__(self):
         if self.num_key_value_heads is None:

@@ -21,6 +21,7 @@ TPU-shaped design:
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -149,6 +150,26 @@ class Generator:
         prompt_cache: bool = False,
     ):
         self.model = model
+        # Build-time projection fusion (keep-quantized loads, single-chip):
+        # concatenate each declared group's packed triples along OUT so
+        # decode runs QKV / gate+up as one fused-GEMV launch each. The
+        # caller's params are not mutated (shallow-copied layer stack);
+        # sp paths keep the separate projections (long-prefill bound, and
+        # their params are placed before fusion would apply).
+        self.fused_projections: list[str] = []
+        if sp_mesh is None and os.environ.get("MST_FUSE_PROJ", "1") != "0":
+            from mlx_sharding_tpu.models.base import apply_projection_fusion
+
+            layers = params.get("layers")
+            if isinstance(layers, dict):
+                layers = {
+                    k: dict(v) if isinstance(v, dict) else v
+                    for k, v in layers.items()
+                }
+                fused = apply_projection_fusion(model, layers)
+                if fused:
+                    params = {**params, "layers": layers}
+                    self.fused_projections = fused
         self.params = params
         # Prompt-prefix caching: keep the previous request's KV cache and
         # token sequence; a new request prefills only past the longest

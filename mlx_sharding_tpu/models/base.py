@@ -56,9 +56,11 @@ def scan_layers(layer_fn, h, layer_params, k, v, mask=None):
             return h, (k_buf, v_buf)
         p, k_buf, v_buf, m = xs
         h2, k2, v2 = layer_fn(h, p, k_buf, v_buf)
+        # tree-map: K/V buffers may be int8 {d, s} leaf pairs (paged pools)
+        sel = lambda a, b: jnp.where(m, a, b)  # noqa: E731
         return jnp.where(m, h2, h), (
-            jnp.where(m, k2, k_buf),
-            jnp.where(m, v2, v_buf),
+            jax.tree.map(sel, k2, k_buf),
+            jax.tree.map(sel, v2, v_buf),
         )
 
     xs = (layer_params, k, v) if mask is None else (layer_params, k, v, mask)
@@ -72,6 +74,38 @@ def stack_layers(per_layer: list[dict]) -> dict:
     for name in per_layer[0]:
         out[name] = jnp.stack([p[name] for p in per_layer])
     return out
+
+
+def apply_projection_fusion(model, layer_stack: dict) -> list[str]:
+    """Fuse each group the model declares via ``fused_projection_groups``
+    IN PLACE in ``layer_stack`` (a flat ``{name: w}`` stack, or nested
+    ``{group: {name: w}}`` keyed like ``layer_group_ranges``): the group's
+    packed triples concatenate along OUT (ops.quant.fuse_packed) and the
+    sources are removed, so decode serves the whole group with one fused-
+    GEMV launch over one pass of the activation planes. Groups with any
+    dense (non-packed) member are left untouched. Returns the fused names
+    added. Callers gate on tp == 1 and the MST_FUSE_PROJ env switch."""
+    from mlx_sharding_tpu.ops.quant import fuse_packed
+
+    groups = model.fused_projection_groups()
+    if not groups:
+        return []
+    ranges = model.layer_group_ranges()
+    stacks = (
+        [layer_stack] if list(ranges) == [None]
+        else [layer_stack[k] for k in ranges if k in layer_stack]
+    )
+    fused = []
+    for stack in stacks:
+        for fname, parts in groups.items():
+            if not all(p in stack and is_quantized(stack[p]) for p in parts):
+                continue
+            stack[fname] = fuse_packed([stack[p] for p in parts])
+            for p in parts:
+                del stack[p]
+            if fname not in fused:
+                fused.append(fname)
+    return fused
 
 
 class BaseModel:
@@ -99,6 +133,15 @@ class BaseModel:
         from mlx_sharding_tpu.ops.quant import linear
 
         return linear(x, w, self._gs, self._bits)
+
+    def fused_projection_groups(self) -> dict:
+        """{fused_param_name: (source_param_names, …)} — groups of packed
+        per-layer projections sharing the same input activations that the
+        engines may concatenate along OUT at build time (ops.quant.fuse_packed)
+        so one kernel invocation serves the whole group. The forward code must
+        dispatch on the fused name's presence in the layer pytree. Empty dict
+        → the architecture has no fusable groups wired."""
+        return {}
 
     def packed_keep_dense_re(self) -> str | None:
         """Regex over HF weight names that must stay DENSE under

@@ -52,9 +52,18 @@ class LlamaModel(BaseModel):
         d = cfg.head_dim
 
         r = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
-        q = self._linear(r, p["q_proj"])
-        k = self._linear(r, p["k_proj"])
-        v = self._linear(r, p["v_proj"])
+        if "qkv_proj" in p:
+            # build-time fused packed projection (engine applied
+            # fused_projection_groups): one kernel launch, one pass over the
+            # activation planes. Split sizes come from the CONFIG (not the
+            # shard) because fusion is only applied at tp == 1.
+            nq, nkv = cfg.num_attention_heads * d, cfg.num_key_value_heads * d
+            qkv = self._linear(r, p["qkv_proj"])
+            q, k, v = jnp.split(qkv, [nq, nq + nkv], axis=-1)
+        else:
+            q = self._linear(r, p["q_proj"])
+            k = self._linear(r, p["k_proj"])
+            v = self._linear(r, p["v_proj"])
         if cfg.attention_bias:  # Qwen2-style QKV biases
             q = q + p["q_bias"]
             k = k + p["k_bias"]
@@ -78,11 +87,16 @@ class LlamaModel(BaseModel):
             attn_out = jax.lax.psum(attn_out, tp_axis)
         h = h + attn_out
         r = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
-        ff = self._linear(
-            jax.nn.silu(self._linear(r, p["gate_proj"]))
-            * self._linear(r, p["up_proj"]),
-            p["down_proj"],
-        )
+        if "gate_up_proj" in p:  # build-time fused packed gate+up (tp == 1)
+            gu = self._linear(r, p["gate_up_proj"])
+            gate, up = jnp.split(gu, [cfg.intermediate_size], axis=-1)
+            ff = self._linear(jax.nn.silu(gate) * up, p["down_proj"])
+        else:
+            ff = self._linear(
+                jax.nn.silu(self._linear(r, p["gate_proj"]))
+                * self._linear(r, p["up_proj"]),
+                p["down_proj"],
+            )
         if tp_axis is not None:
             ff = jax.lax.psum(ff, tp_axis)
         return h + ff
@@ -120,6 +134,15 @@ class LlamaModel(BaseModel):
         if self.config.attention_bias:
             axes.update({"q_bias": 0, "k_bias": 0, "v_bias": 0})
         return axes
+
+    def fused_projection_groups(self) -> dict:
+        """QKV and gate+up share their input activations — the engines may
+        concatenate each group's packed triples along OUT at build time so
+        decode issues one kernel launch per group instead of three/two."""
+        return {
+            "qkv_proj": ("q_proj", "k_proj", "v_proj"),
+            "gate_up_proj": ("gate_proj", "up_proj"),
+        }
 
     def head_input(self, params, h):
         """Final norm before the (tied-embedding aware) LM head — ref

@@ -70,12 +70,14 @@ def kernel_eligible(
     return jax.default_backend() == "tpu" and dk % 64 == 0 and dv % 64 == 0
 
 
-def _kernel(
+def _kernel_body(
     tables_ref,  # (M, SPG) int32 — scalar-prefetch
     lens_ref,  # (M,) int32 — scalar-prefetch
     q_ref,  # (1, 1, G, Dk) block
     k_ref,  # (1, page, 1, Dk) block — the page named by tables[m, j]
     v_ref,  # (1, page, 1, Dv) block
+    ks_ref,  # (1, page, 1, 1) per-row K scales (int8 pool) or None
+    vs_ref,  # (1, page, 1, 1) per-row V scales (int8 pool) or None
     o_ref,  # (1, 1, G, Dv) block
     m_scr,  # (G, 128) f32 VMEM — running max, lane-replicated
     l_scr,  # (G, 128) f32 VMEM — running normalizer
@@ -102,6 +104,12 @@ def _kernel(
         q = q_ref[0, 0].astype(jnp.float32)  # (G, Dk)
         kblk = k_ref[0, :, 0, :].astype(jnp.float32)  # (page, Dk)
         vblk = v_ref[0, :, 0, :].astype(jnp.float32)  # (page, Dv)
+        if ks_ref is not None:
+            # int8 pool: dequant fused into the page read — the pool's
+            # HBM→VMEM traffic is the int8 bytes; the (page, 1) scale
+            # broadcasts over the head dim in registers
+            kblk = kblk * ks_ref[0, :, 0, :]
+            vblk = vblk * vs_ref[0, :, 0, :]
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -130,8 +138,21 @@ def _kernel(
         o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
+def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+            m_scr, l_scr, acc_scr, **kw):
+    _kernel_body(tables_ref, lens_ref, q_ref, k_ref, v_ref, None, None,
+                 o_ref, m_scr, l_scr, acc_scr, **kw)
+
+
+def _kernel_int8(tables_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                 o_ref, m_scr, l_scr, acc_scr, **kw):
+    _kernel_body(tables_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                 o_ref, m_scr, l_scr, acc_scr, **kw)
+
+
 def _paged_attention_kernel(
-    q, k_pool, v_pool, tables, lengths, scale, interpret
+    q, k_pool, v_pool, tables, lengths, scale, interpret,
+    k_scale=None, v_scale=None,
 ):
     m, hq, dk = q.shape
     pages, page_size, hkv, dv = (
@@ -140,23 +161,30 @@ def _paged_attention_kernel(
     spg = tables.shape[1]
     g = hq // hkv
     qg = q.reshape(m, hkv, g, dk)
+    quant = k_scale is not None
+
+    def page_spec(d):
+        # data-dependent page fetch: the block index comes from the
+        # prefetched table row — this is the whole point of the kernel
+        return pl.BlockSpec(
+            (1, page_size, 1, d),
+            lambda mi, hi, ji, t, ln: (t[mi, ji], 0, hi, 0),
+        )
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, dk), lambda mi, hi, ji, t, ln: (mi, hi, 0, 0)),
+        page_spec(dk),
+        page_spec(dv),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:  # the scale planes ride the same table-indexed fetch
+        in_specs += [page_spec(1), page_spec(1)]
+        operands += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
 
     spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(m, hkv, spg),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, dk), lambda mi, hi, ji, t, ln: (mi, hi, 0, 0)),
-            # data-dependent page fetch: the block index comes from the
-            # prefetched table row — this is the whole point of the kernel
-            pl.BlockSpec(
-                (1, page_size, 1, dk),
-                lambda mi, hi, ji, t, ln: (t[mi, ji], 0, hi, 0),
-            ),
-            pl.BlockSpec(
-                (1, page_size, 1, dv),
-                lambda mi, hi, ji, t, ln: (t[mi, ji], 0, hi, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, g, dv), lambda mi, hi, ji, t, ln: (mi, hi, 0, 0)
         ),
@@ -168,32 +196,41 @@ def _paged_attention_kernel(
     )
     out = pl.pallas_call(
         functools.partial(
-            _kernel, scale=scale, page_size=page_size, pages_per_slot=spg
+            _kernel_int8 if quant else _kernel,
+            scale=scale, page_size=page_size, pages_per_slot=spg,
         ),
         grid_spec=spec,
         out_shape=jax.ShapeDtypeStruct((m, hkv, g, dv), q.dtype),
         interpret=interpret,
-    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
     return out.reshape(m, hq, dv)
 
 
 def _paged_attention_xla(
     q, k_pool, v_pool, tables, lengths, scale,
     logit_softcap, sliding_window, values_from_k,
+    k_scale=None, v_scale=None,
 ):
     m, hq, dk = q.shape
     page_size, hkv = k_pool.shape[1], k_pool.shape[2]
     spg = tables.shape[1]
     g = hq // hkv
 
-    k = jnp.take(k_pool, tables, axis=0)  # (M, SPG, page, Hkv, Dk)
-    k = k.reshape(m, spg * page_size, hkv, dk)
+    def gathered(pool, scl):
+        x = jnp.take(pool, tables, axis=0)  # (M, SPG, page, Hkv, D)
+        x = x.reshape(m, spg * page_size, hkv, -1)
+        if scl is not None:  # int8 pool: dequant the gathered rows only
+            s = jnp.take(scl, tables, axis=0).reshape(
+                m, spg * page_size, hkv, 1
+            )
+            x = x.astype(jnp.float32) * s
+        return x
+
+    k = gathered(k_pool, k_scale)
     if values_from_k is not None:
         v = k[..., :values_from_k]  # MLA: values are the latent prefix of k
     else:
-        v = jnp.take(v_pool, tables, axis=0).reshape(
-            m, spg * page_size, hkv, -1
-        )
+        v = gathered(v_pool, v_scale)
     qg = q.reshape(m, hkv, g, dk)
     scores = jnp.einsum(
         "mhgd,mshd->mhgs", qg, k, preferred_element_type=jnp.float32
@@ -230,21 +267,29 @@ def paged_attention(
     logit_softcap: Optional[float] = None,
     sliding_window=None,  # int or traced scalar
     values_from_k: Optional[int] = None,  # MLA latent-as-values
+    k_scale: Optional[jax.Array] = None,  # (P+1, page, Hkv, 1) int8-pool scales
+    v_scale: Optional[jax.Array] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Ragged decode attention over one layer's page pool. Returns
     (M, Hq, Dv). Row m attends to positions 0..lengths[m] of its own pages;
     lengths[m] == 0 (an inactive slot) yields zeros. The new token's K/V
     must already be written into the pool (the engine scatters the single
-    row before calling this)."""
+    row before calling this). With ``k_scale``/``v_scale`` the pools are
+    int8 codes and dequant (code × per-row-per-head scale) fuses into the
+    page reads — both paths stream the int8 bytes, never a dense bf16 copy
+    of the pages."""
     dk, dv = q.shape[-1], v_pool.shape[-1]
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
     if kernel_eligible(
         dk, dv, logit_softcap, sliding_window, values_from_k, interpret
     ):
         return _paged_attention_kernel(
-            q, k_pool, v_pool, tables, lengths, scale, interpret
+            q, k_pool, v_pool, tables, lengths, scale, interpret,
+            k_scale, v_scale,
         )
     return _paged_attention_xla(
         q, k_pool, v_pool, tables, lengths, scale,
-        logit_softcap, sliding_window, values_from_k,
+        logit_softcap, sliding_window, values_from_k, k_scale, v_scale,
     )

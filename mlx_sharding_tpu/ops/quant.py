@@ -54,6 +54,23 @@ def is_quantized(w) -> bool:
     return isinstance(w, dict) and "q" in w
 
 
+def fuse_packed(parts):
+    """Concatenate packed triples that share an IN dimension along OUT
+    (axis -2 of every leaf in the MLX layout) into one packed param.
+
+    Build-time only: the fused param serves N projections (QKV, gate+up)
+    with a single kernel invocation, so the activation planes are read
+    once instead of N times and decode issues one launch where it issued
+    N. Per output row the fused GEMV computes the exact same sub-dot
+    sequence as the separate calls, so results are bit-identical."""
+    if not all(is_quantized(p) for p in parts):
+        raise ValueError("fuse_packed expects packed {q, scales, biases} triples")
+    return {
+        leaf: jnp.concatenate([p[leaf] for p in parts], axis=-2)
+        for leaf in ("q", "scales", "biases")
+    }
+
+
 def linear(x: jax.Array, w, group_size: int = 64, bits: int = 4) -> jax.Array:
     """``x @ w`` that transparently serves packed params.
 
@@ -97,15 +114,56 @@ def _pallas_ok(m, in_dim, out_dim, group_size, bits) -> bool:
     )
 
 
+def _gemv_ok(m, in_dim, out_dim, group_size, bits) -> bool:
+    """Decode shapes route to the pipelined GEMV: M ≤ 8, TPU backend (or
+    MST_QMM_GEMV=interpret, which forces the kernel in interpret mode for
+    end-to-end parity tests on CPU), blocks dividing cleanly with
+    128-aligned word lanes (Mosaic's DMA tiling)."""
+    import os
+
+    mode = os.environ.get("MST_QMM_GEMV", "1")
+    if mode == "0" or os.environ.get("MST_QMM", "1") == "0":
+        return False
+    from mlx_sharding_tpu.ops.quant_matmul import GEMV_MAX_M, get_gemv_blocks
+
+    if m > GEMV_MAX_M:
+        return False
+    if mode != "interpret" and jax.default_backend() != "tpu":
+        return False
+    per_word = 32 // bits
+    block_out, block_in = get_gemv_blocks(m, out_dim, in_dim, group_size, bits)
+    words_ok = mode == "interpret" or (
+        (block_in // per_word) % 128 == 0 and block_out % 128 == 0
+    )
+    return (
+        out_dim % block_out == 0
+        and in_dim % block_in == 0
+        and block_in % group_size == 0
+        and block_in % per_word == 0
+        and words_ok
+    )
+
+
 def _quant_matmul(x2, q, scales, biases, group_size, bits):
+    import os
+
     m, in_dim = x2.shape
     out_dim = q.shape[0]
+    if _gemv_ok(m, in_dim, out_dim, group_size, bits):
+        from mlx_sharding_tpu.ops.quant_matmul import quant_gemv_pipelined
+
+        return quant_gemv_pipelined(
+            x2, q, scales, biases, group_size=group_size, bits=bits,
+            interpret=os.environ.get("MST_QMM_GEMV") == "interpret",
+        )
     if _pallas_ok(m, in_dim, out_dim, group_size, bits):
         from mlx_sharding_tpu.ops.quant_matmul import quant_matmul_pallas
 
         return quant_matmul_pallas(
             x2, q, scales, biases, group_size=group_size, bits=bits
         )
+    # Guarded XLA fallback: only shapes/backends no kernel serves reach it.
+    # mst: allow(MST105): dense tile is transient inside this one matmul
     w = dequantize(q, scales, biases, group_size, bits, jnp.float32)
     return (x2 @ w.astype(x2.dtype).T).astype(x2.dtype)
 

@@ -29,6 +29,7 @@ Correctness of garbage ticks: devices compute every tick, but
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -37,7 +38,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mlx_sharding_tpu.cache import KVCache
+from mlx_sharding_tpu.cache import (
+    KVCache,
+    dequantize_kv,
+    quantize_kv_rows,
+)
 from mlx_sharding_tpu.ops.quant import dequantize, is_quantized
 from mlx_sharding_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_TP, shard_map
 from mlx_sharding_tpu.sample import (
@@ -192,6 +197,7 @@ class PipelineEngine:
         pool_pages: Optional[int] = None,
         page_size: Optional[int] = None,
         paged_attention: str = "auto",
+        kv_dtype: Optional[str] = None,
     ):
         cfg = model.config
         if not (cfg.is_first_stage and cfg.is_last_stage):
@@ -229,6 +235,21 @@ class PipelineEngine:
                     f"{self.max_seq}"
                 )
         self.slot_pages = self.max_seq // self.page_size  # table width
+
+        # int8 paged KV: pool leaves become {d: int8 data, s: f32 per-row-
+        # per-head scale (trailing dim 1)} dicts — halves KV bytes per
+        # ragged-attention tick and ~doubles the slots a fixed pool holds.
+        if kv_dtype is None and self.paged:
+            # checkpoint may pin it (config.kv_cache_dtype); dense engines
+            # ignore the pin rather than erroring on int8-tagged checkpoints
+            kv_dtype = getattr(model.config, "kv_cache_dtype", None)
+        if kv_dtype not in (None, "bf16", "bfloat16", "int8"):
+            raise ValueError(f"kv_dtype={kv_dtype!r}: want int8 or bf16")
+        self.kv_quant = kv_dtype == "int8"
+        if self.kv_quant and not self.paged:
+            raise ValueError(
+                "kv_dtype='int8' requires a paged engine (pool_pages)"
+            )
 
         S = self.num_stages
         stage_sharding = NamedSharding(mesh, P(AXIS_PP))
@@ -305,6 +326,41 @@ class PipelineEngine:
             if self.tp > 1 and not model.cache_tp_replicated() else P(AXIS_PP)
         )
         split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
+
+        # Build-time projection fusion (keep-quantized loads): concatenate
+        # each declared group's packed triples along OUT so decode runs QKV
+        # (and gate+up) as ONE fused-GEMV launch sharing a single pass over
+        # the activation planes. tp == 1 only — the fused OUT axis
+        # interleaves the group's rows, which the column-parallel slicing
+        # wouldn't split correctly. Forward code dispatches on the fused
+        # name's presence in the layer pytree (models/llama.py).
+        self.fused_projections: list[str] = []
+        if self.tp == 1 and os.environ.get("MST_FUSE_PROJ", "1") != "0":
+            from mlx_sharding_tpu.models.base import apply_projection_fusion
+
+            self.fused_projections = apply_projection_fusion(model, split)
+
+        # Shape-keyed GEMV autotune: sweep candidate block sizes once per
+        # distinct packed (OUT, IN) at load time (quant_matmul caches the
+        # winner; every layer with that shape reuses it). No-op off-TPU.
+        if os.environ.get("MST_QMM_AUTOTUNE", "1") != "0":
+            from mlx_sharding_tpu.ops.quant_matmul import autotune_gemv
+
+            gs_a, bits_a = model._quant_args()
+            seen_shapes: set = set()
+
+            def _sweep(stack):
+                for w in stack.values():
+                    if isinstance(w, dict) and not is_quantized(w):
+                        _sweep(w)
+                    elif is_quantized(w):
+                        out_dim = int(w["q"].shape[-2])
+                        in_dim = int(w["scales"].shape[-1]) * gs_a
+                        if (out_dim, in_dim) not in seen_shapes:
+                            seen_shapes.add((out_dim, in_dim))
+                            autotune_gemv(1, out_dim, in_dim, gs_a, bits_a)
+
+            _sweep(split)
 
         # Per-name shard axes: tp (heads/MLP columns) and ep (expert stacks).
         # Models declare flat maps (homogeneous stacks) or nested
@@ -440,6 +496,18 @@ class PipelineEngine:
             replicated,
         )
 
+        # total weight bytes one decode tick streams from HBM (every param
+        # leaf is read once per forward) — numerator of the
+        # mst_decode_hbm_bytes_per_token{kind="weights"} gauge. Packed
+        # triples count their actual packed bytes: this is where 4-bit shows
+        # up as 4x less traffic than dense bf16.
+        self.weight_stream_bytes = sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves(
+                (self.layer_params, self.vocab_parts, self.shared_params)
+            )
+        )
+
         self._decode = self._build_step(t_len=1, with_sampling=True)
         self._prefill = self._build_step(t_len=prefill_chunk, with_sampling=False)
         self._sample = jax.jit(self._sample_fn, donate_argnums=(1,))
@@ -541,9 +609,20 @@ class PipelineEngine:
             self.model.cache_num_heads(),
         )
         sharding = NamedSharding(self.mesh, self._kv_spec)
+
+        def pool(dim):
+            if not self.kv_quant:
+                return jnp.zeros((*shape, dim), self.cache_dtype)
+            # int8 pool: data + per-row-per-head scale (trailing dim 1
+            # broadcasts over head_dim) — D+4 bytes per row-head vs 2D bf16
+            return {
+                "d": jnp.zeros((*shape, dim), jnp.int8),
+                "s": jnp.zeros((*shape, 1), jnp.float32),
+            }
+
         cache = KVCache(
-            k=put_global(jnp.zeros((*shape, k_dim), self.cache_dtype), sharding),
-            v=put_global(jnp.zeros((*shape, v_dim), self.cache_dtype), sharding),
+            k=put_global(pool(k_dim), sharding),
+            v=put_global(pool(v_dim), sharding),
             offset=put_global(
                 jnp.zeros((M,), jnp.int32), NamedSharding(self.mesh, P())
             ),
@@ -583,13 +662,19 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     def _paged_read(self, k, v, table_row):
         """Gather one slot's pages into the contiguous (L, B, S_virt, H, D)
-        view run_layers expects. k/v: local pool (L, P+1, B, page, H, D)."""
-        outs = []
-        for pool in (k, v):
+        view run_layers expects. k/v: local pool (L, P+1, B, page, H, D) —
+        or the int8 ``{d, s}`` pair, which dequantizes AFTER the gather so
+        the pool→registers traffic is the int8 bytes, not the dense view."""
+
+        def gather(pool):
             g = jnp.take(pool, table_row, axis=1)  # (L, SPG, B, page, H, D)
             g = jnp.moveaxis(g, 1, 2)  # (L, B, SPG, page, H, D)
-            outs.append(g.reshape(*g.shape[:2], -1, *g.shape[4:]))
-        return tuple(outs)
+            return g.reshape(*g.shape[:2], -1, *g.shape[4:])
+
+        return tuple(
+            dequantize_kv(jax.tree.map(gather, pool), self.cache_dtype)
+            for pool in (k, v)
+        )
 
     def _paged_writeback(self, pool, buf, table_row, offset, n_pages=1):
         """Scatter the dirty page(s) of a slot's contiguous buffer back into
@@ -598,7 +683,11 @@ class PipelineEngine:
         chunk-aligned), so prefill and T=1 decode pass n_pages=1; a T=K
         speculative verify writes K rows at an arbitrary offset and passes
         the worst-case straddle count. Writing back a page the step didn't
-        touch is idempotent (it holds exactly what the gather read)."""
+        touch is idempotent (it holds exactly what the gather read — for the
+        int8 pool, requantizing a dequantized row reproduces the same codes
+        because the stored max element sits exactly at ±127, pinning the
+        recomputed scale)."""
+        quant = isinstance(pool, dict)
         l, b = buf.shape[:2]
         page = self.page_size
         buf6 = buf.reshape(l, b, self.slot_pages, page, *buf.shape[3:])
@@ -607,9 +696,18 @@ class PipelineEngine:
             # buffer page and its table entry — an idempotent re-write
             pidx = jnp.minimum(offset // page + i, self.slot_pages - 1)
             dirty = jax.lax.dynamic_index_in_dim(buf6, pidx, 2, keepdims=False)
-            pool = jax.lax.dynamic_update_index_in_dim(
-                pool, dirty.astype(pool.dtype), table_row[pidx], 1
-            )
+            if quant:  # quantize-on-writeback: the dense page never lands
+                dirty = quantize_kv_rows(dirty)
+                pool = jax.tree.map(
+                    lambda p, d: jax.lax.dynamic_update_index_in_dim(
+                        p, d.astype(p.dtype), table_row[pidx], 1
+                    ),
+                    pool, dirty,
+                )
+            else:
+                pool = jax.lax.dynamic_update_index_in_dim(
+                    pool, dirty.astype(pool.dtype), table_row[pidx], 1
+                )
         return pool
 
     def _kv_read(self, paged, k, v, table, m_write):
@@ -650,6 +748,11 @@ class PipelineEngine:
         rl_kwargs = self._rl_kwargs
         if keep_all and S != 1:
             raise ValueError("keep_all logits need the S == 1 vectorized body")
+        # int8 pools are {d, s} dicts: index/stack per leaf, and take the
+        # compute dtype from the engine instead of the storage leaf
+        cdt = self.cache_dtype
+        unstack = lambda t: jax.tree.map(lambda x: x[0], t)  # noqa: E731
+        restack = lambda t: jax.tree.map(lambda x: x[None], t)  # noqa: E731
 
         def body(layer_params, masks, vparts, shared, tokens, k, v, offsets, active, n_valid, table):
             # Per-device views: layer_params (1, L, …) → (L, …); k/v
@@ -661,12 +764,12 @@ class PipelineEngine:
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
             masks = jax.tree.map(lambda x: x[0], masks)
             vparts = jax.tree.map(lambda x: x[0], vparts)
-            k, v = k[0], v[0]
+            k, v = unstack(k), unstack(v)
             s = jax.lax.axis_index(AXIS_PP)
-            h0 = jnp.zeros((B, t_len, model.config.hidden_size), k.dtype)
+            h0 = jnp.zeros((B, t_len, model.config.hidden_size), cdt)
             # bank HIDDEN states, not logits: the vocab projection runs once
             # post-scan against this device's vocab shard
-            out0 = jnp.zeros((M, B, model.config.hidden_size), k.dtype)
+            out0 = jnp.zeros((M, B, model.config.hidden_size), cdt)
             offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
 
             def tick(carry, t):
@@ -710,7 +813,7 @@ class PipelineEngine:
             )
             out = jax.lax.psum(out, AXIS_PP)  # only stage S-1 contributed
             logits = self._vs_head(shared, vparts, out)  # (M, B, V) f32
-            return logits, k[None], v[None]
+            return logits, restack(k), restack(v)
 
         def body_s1(layer_params, masks, vparts, shared, tokens, k, v,
                     offsets, active, n_valid, table):
@@ -728,7 +831,7 @@ class PipelineEngine:
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
             masks = jax.tree.map(lambda x: x[0], masks)
             vparts = jax.tree.map(lambda x: x[0], vparts)
-            k, v = k[0], v[0]
+            k, v = unstack(k), unstack(v)
             s = jax.lax.axis_index(AXIS_PP)
             offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
             m_write = jnp.where(active, jnp.arange(M), M)  # inactive → scratch
@@ -738,7 +841,7 @@ class PipelineEngine:
                 # the continuous-batching step passes (M, B) single tokens
                 # (the tick body relied on where() broadcasting them up)
                 tokens = tokens[..., None]
-            h_all = self._vs_embed(s, vparts, tokens).astype(k.dtype)  # (M, B, T, H)
+            h_all = self._vs_embed(s, vparts, tokens).astype(cdt)  # (M, B, T, H)
 
             def read(mw):
                 k_m, v_m, row = self._kv_read(paged, k, v, table, mw)
@@ -771,16 +874,16 @@ class PipelineEngine:
             if keep_all:
                 out = jnp.where(
                     active[:, None, None, None], h_outs, 0
-                ).astype(k.dtype)  # (M, B, T, H) — every position's hidden
+                ).astype(cdt)  # (M, B, T, H) — every position's hidden
             else:
                 out = jax.lax.dynamic_index_in_dim(
                     h_outs, n_valid - 1, 2, keepdims=False
                 )  # (M, B, H)
-                out = jnp.where(active[:, None, None], out, 0).astype(k.dtype)
+                out = jnp.where(active[:, None, None], out, 0).astype(cdt)
             out = jax.lax.psum(out, AXIS_PP)  # identity at S=1; keeps the
             # body shape identical to the rotated one
             logits = self._vs_head(shared, vparts, out)
-            return logits, k[None], v[None]
+            return logits, restack(k), restack(v)
 
         if S == 1:
             body = body_s1
@@ -835,6 +938,7 @@ class PipelineEngine:
         Gated to S==1/tp=1/ep=1/B==1/supports_sp by the constructor."""
         model, M, B = self.model, self.microbatches, self.batch
         page = self.page_size
+        cdt, kv_quant = self.cache_dtype, self.kv_quant
         from mlx_sharding_tpu.models.base import scan_layers
         from mlx_sharding_tpu.ops.paged_attention import paged_attention
 
@@ -843,7 +947,9 @@ class PipelineEngine:
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
             masks = jax.tree.map(lambda x: x[0], masks)
             vparts = jax.tree.map(lambda x: x[0], vparts)
-            k, v = k[0], v[0]  # (L, P+1, B, page, H, D)
+            # (L, P+1, B, page, H, D) — int8 pools are {d, s} leaf pairs
+            k = jax.tree.map(lambda x: x[0], k)
+            v = jax.tree.map(lambda x: x[0], v)
             s = jax.lax.axis_index(AXIS_PP)
 
             offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
@@ -860,7 +966,7 @@ class PipelineEngine:
 
             # B == 1: treat the slot axis as the batch axis, (M, 1) tokens
             # embed straight to (M, T=1, hidden)
-            h = self._vs_embed(s, vparts, tokens).astype(k.dtype)
+            h = self._vs_embed(s, vparts, tokens).astype(cdt)
 
             def make_layer(g):
                 def layer(h, p, k_buf, v_buf):
@@ -871,20 +977,34 @@ class PipelineEngine:
 
                     def attn_fn(q, k_new, v_new, logit_softcap=None,
                                 sliding_window=None, values_from_k=None):
-                        kl = k_buf[:, 0]  # (P+1, page, Hkv, Dk)
-                        vl = v_buf[:, 0]
-                        kl = kl.at[page_ids, row_pos].set(
-                            k_new[:, 0].astype(kl.dtype)
-                        )
-                        vl = vl.at[page_ids, row_pos].set(
-                            v_new[:, 0].astype(vl.dtype)
-                        )
-                        done["k"], done["v"] = kl[:, None], vl[:, None]
+                        # drop the B == 1 axis per leaf → (P+1, page, H, D)
+                        kl = jax.tree.map(lambda x: x[:, 0], k_buf)
+                        vl = jax.tree.map(lambda x: x[:, 0], v_buf)
+
+                        def put(pool, new):
+                            if kv_quant:  # quantize the M rows, scatter both
+                                new = quantize_kv_rows(new)
+                            return jax.tree.map(
+                                lambda p, n: p.at[page_ids, row_pos].set(
+                                    n.astype(p.dtype)
+                                ),
+                                pool, new,
+                            )
+
+                        kl = put(kl, k_new[:, 0])
+                        vl = put(vl, v_new[:, 0])
+                        done["k"] = jax.tree.map(lambda x: x[:, None], kl)
+                        done["v"] = jax.tree.map(lambda x: x[:, None], vl)
                         out = paged_attention(
-                            q[:, 0], kl, vl, rows, lengths, model.scale,
+                            q[:, 0],
+                            kl["d"] if kv_quant else kl,
+                            vl["d"] if kv_quant else vl,
+                            rows, lengths, model.scale,
                             logit_softcap=logit_softcap,
                             sliding_window=sliding_window,
                             values_from_k=values_from_k,
+                            k_scale=kl["s"] if kv_quant else None,
+                            v_scale=vl["s"] if kv_quant else None,
                         )
                         return out[:, None]  # (M, T=1, Hq, Dv)
 
@@ -905,19 +1025,28 @@ class PipelineEngine:
                 n_g = jax.tree.leaves(stack)[0].shape[0]
                 h, k_g, v_g = scan_layers(
                     make_layer(g), h, stack,
-                    k[lo : lo + n_g], v[lo : lo + n_g], mask_g,
+                    jax.tree.map(lambda x: x[lo : lo + n_g], k),
+                    jax.tree.map(lambda x: x[lo : lo + n_g], v),
+                    mask_g,
                 )
                 k_parts.append(k_g)
                 v_parts.append(v_g)
                 lo += n_g
-            k = jnp.concatenate(k_parts, axis=0) if len(k_parts) > 1 else k_parts[0]
-            v = jnp.concatenate(v_parts, axis=0) if len(v_parts) > 1 else v_parts[0]
+            cat = lambda *xs: (  # noqa: E731
+                jnp.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+            )
+            k = jax.tree.map(cat, *k_parts)
+            v = jax.tree.map(cat, *v_parts)
 
-            out = jnp.where(active[:, None, None], h, 0).astype(k.dtype)
+            out = jnp.where(active[:, None, None], h, 0).astype(cdt)
             out = jax.lax.psum(out, AXIS_PP)  # identity at S=1; keeps the
             # body shape identical to the gather one
             logits = self._vs_head(shared, vparts, out)  # (M, B, V) f32
-            return logits, k[None], v[None]
+            return (
+                logits,
+                jax.tree.map(lambda x: x[None], k),
+                jax.tree.map(lambda x: x[None], v),
+            )
 
         spec_stage, spec_rep = P(AXIS_PP), P()
         return shard_map(
@@ -1249,10 +1378,11 @@ class PipelineEngine:
             layer_params = jax.tree.map(lambda x: x[0], layer_params)
             masks = jax.tree.map(lambda x: x[0], masks)
             vparts = jax.tree.map(lambda x: x[0], vparts)
-            k, v = k[0], v[0]
+            k = jax.tree.map(lambda x: x[0], k)
+            v = jax.tree.map(lambda x: x[0], v)
             s = jax.lax.axis_index(AXIS_PP)
-            h0 = jnp.zeros((B, t_len, model.config.hidden_size), k.dtype)
-            out0 = jnp.zeros((B, model.config.hidden_size), k.dtype)
+            h0 = jnp.zeros((B, t_len, model.config.hidden_size), self.cache_dtype)
+            out0 = jnp.zeros((B, model.config.hidden_size), self.cache_dtype)
             offsets_pad = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
 
             def tick(carry, t):
@@ -1282,7 +1412,11 @@ class PipelineEngine:
             (_, k, v, out), _ = jax.lax.scan(tick, (h0, k, v, out0), jnp.arange(S))
             out = jax.lax.psum(out, AXIS_PP)
             logits = self._vs_head(shared, vparts, out)  # (B, V) f32
-            return logits, k[None], v[None]
+            return (
+                logits,
+                jax.tree.map(lambda x: x[None], k),
+                jax.tree.map(lambda x: x[None], v),
+            )
 
         spec_stage, spec_rep = P(AXIS_PP), P()
         smapped = shard_map(

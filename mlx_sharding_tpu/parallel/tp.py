@@ -36,6 +36,10 @@ def llama_param_specs(tp: str | None = "tp", layers: str | None = None) -> dict:
             "gate_proj": col,
             "up_proj": col,
             "down_proj": row,
+            # build-time fused packed groups (model.fused_projection_groups);
+            # engines only fuse at tp == 1 today, entries kept for parity
+            "qkv_proj": col,
+            "gate_up_proj": col,
             # Qwen2-style QKV biases and Qwen3 per-head q/k norms — present
             # only for those variants; prune_specs drops unused entries
             "q_bias": bias,

@@ -370,6 +370,10 @@ class ContinuousBatcher:
             self.kv_path = getattr(engine, "paged_attention", "gather")
             self.kv_bytes_read_last_tick = 0
             self.kv_bytes_read_total = 0
+            # per-decoded-token HBM traffic, split by side (weights vs KV):
+            # the headline numbers of the quantized memory hierarchy
+            self.weight_bytes_per_token_last = 0.0
+            self.kv_bytes_per_token_last = 0.0
             self._kv_row_bytes = sum(
                 leaf.shape[0] * leaf.shape[1] * leaf.shape[-2]
                 * leaf.shape[-1] * leaf.dtype.itemsize
@@ -683,6 +687,25 @@ class ContinuousBatcher:
         b = rows * self._kv_row_bytes * steps
         self.kv_bytes_read_last_tick = b
         self.kv_bytes_read_total += b
+        # weights stream once per step regardless of slot count, so per
+        # token they amortize over the live slots; KV does not amortize
+        tokens = len(live) * steps
+        self.kv_bytes_per_token_last = b / tokens
+        self.weight_bytes_per_token_last = (
+            getattr(self.engine, "weight_stream_bytes", 0) / len(live)
+        )
+
+    def hbm_bytes_per_token_stats(self) -> Optional[dict]:
+        """{"weights": bytes, "kv": bytes} streamed per decoded token on the
+        last decode tick (analytic, from the same model as kv_read_stats);
+        None on dense engines. Exported as
+        ``mst_decode_hbm_bytes_per_token{kind=}``."""
+        if not self.paged:
+            return None
+        return {
+            "weights": self.weight_bytes_per_token_last,
+            "kv": self.kv_bytes_per_token_last,
+        }
 
     def prefix_stats(self) -> Optional[tuple[int, int, int, int, int]]:
         """(queries, hits, tokens reused, evictions, cached pages) for

@@ -118,6 +118,7 @@ class ModelProvider:
         paged_pool: Optional[int] = None,
         page_size: Optional[int] = None,
         paged_attention: str = "auto",
+        kv_dtype: Optional[str] = None,
         admission_policy: str = "fifo",
         overcommit: bool = False,
         draft_model: Optional[str] = None,
@@ -156,6 +157,9 @@ class ModelProvider:
         # (ops/paged_attention.py), "gather" materializes the contiguous
         # per-slot view, "auto" picks ragged where the engine supports it
         self.paged_attention = paged_attention
+        # KV-pool storage: "int8" stores {codes, per-row-per-head scale}
+        # pools at ~half the bytes of bf16 (see cache.quantize_kv_rows)
+        self.kv_dtype = kv_dtype
         self.admission_policy = admission_policy
         self.overcommit = overcommit
         self.default_model = default_model
@@ -298,6 +302,7 @@ class ModelProvider:
                             if self.concurrent > 1 else None,
                             page_size=self.page_size,
                             paged_attention=self.paged_attention,
+                            kv_dtype=self.kv_dtype,
                         )
                         if self.concurrent > 1 and not self.multihost:
                             from mlx_sharding_tpu.scheduler import (
@@ -1109,6 +1114,11 @@ def main(argv=None):
                              "contiguous per-slot view, 'auto' (default) "
                              "picks ragged where the engine supports it "
                              "(pp=1, tp=ep=1)")
+    parser.add_argument("--kv-dtype", choices=("bf16", "int8"), default=None,
+                        help="with --paged-pool: KV-pool storage. 'int8' "
+                             "stores quantized codes plus a per-row-per-head "
+                             "float32 scale (~2x the tokens per page of "
+                             "bf16); default keeps the cache dtype")
     parser.add_argument("--admission-policy", choices=("fifo", "first_fit"),
                         default="fifo",
                         help="waiting-line policy when a request doesn't fit "
@@ -1272,6 +1282,8 @@ def main(argv=None):
         parser.error("--page-size requires --paged-pool")
     if args.paged_attention != "auto" and not args.paged_pool:
         parser.error("--paged-attention requires --paged-pool")
+    if args.kv_dtype and not args.paged_pool:
+        parser.error("--kv-dtype requires --paged-pool")
     if args.admission_policy != "fifo" and not args.paged_pool:
         parser.error("--admission-policy requires --paged-pool")
     if args.overcommit and not args.paged_pool:
@@ -1314,6 +1326,7 @@ def main(argv=None):
         chat_template=chat_template, keep_quantized=args.keep_quantized,
         decode_block=args.decode_block, paged_pool=args.paged_pool,
         page_size=args.page_size, paged_attention=args.paged_attention,
+        kv_dtype=args.kv_dtype,
         admission_policy=args.admission_policy,
         overcommit=args.overcommit,
         draft_model=args.draft_model, spec_k=args.spec_k,

@@ -162,6 +162,15 @@ class ServingMetrics:
                         "# TYPE mst_kv_bytes_read_total counter",
                         f"mst_kv_bytes_read_total {total_bytes}",
                     ]
+                hbm = getattr(b, "hbm_bytes_per_token_stats", lambda: None)()
+                if hbm is not None:
+                    lines += [
+                        "# TYPE mst_decode_hbm_bytes_per_token gauge",
+                        'mst_decode_hbm_bytes_per_token{kind="weights"} '
+                        f"{hbm['weights']:.1f}",
+                        'mst_decode_hbm_bytes_per_token{kind="kv"} '
+                        f"{hbm['kv']:.1f}",
+                    ]
                 tick = getattr(b, "tick_timing_stats", lambda: None)()
                 if tick is not None:
                     # which run-loop the batcher is on (1 = double-buffered

@@ -1,0 +1,11 @@
+"""MST105: dense dequantized weight materialized in a decode-hot path."""
+
+
+def dequantize(q, scales, biases):
+    return q  # stand-in for ops.quant.dequantize
+
+
+# mst: decode-hot
+def decode_linear(x, w):
+    full = dequantize(w["q"], w["scales"], w["biases"])
+    return x @ full.T
