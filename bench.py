@@ -592,6 +592,150 @@ def measure_cb_overcommit(model, params, label: str) -> dict:
     return res
 
 
+def measure_preempt_spill_vs_discard(model, params, label: str) -> dict:
+    """KV spill A/B (ISSUE 6 tentpole): the same over-commit-pressure batch
+    run with preemption-as-discard (re-prefill the victim from its folded
+    prompt) and preemption-as-spill (--spill-bytes: export the victim's
+    page block to host DRAM, re-import on resume). Two requests whose full
+    need is over half a 4-page pool thrash each other; the spill run should
+    show re-import hits and fewer re-prefilled tokens for comparable wall."""
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    vocab = model.config.vocab_size
+    prompts = [
+        [int(x) for x in np.random.default_rng(s).integers(1, vocab - 64, 64)]
+        for s in range(2)
+    ]
+
+    def run(spill_bytes) -> dict:
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1), microbatches=2,
+            max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+            pool_pages=4, page_size=128,
+        )
+        batcher = ContinuousBatcher(
+            eng, decode_block=8, overcommit=True, spill_bytes=spill_bytes
+        )
+        try:
+            for _ in batcher.generate_step(prompts[0][:16], max_tokens=8):
+                pass  # compile prefill + decode block
+
+            def consume(p):
+                for _ in batcher.generate_step(p, max_tokens=320):
+                    pass
+
+            threads = [
+                threading.Thread(target=consume, args=(p,)) for p in prompts
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            s = batcher.spill_stats() or {}
+            return dict(
+                wall_s=round(wall, 2),
+                preemptions=s.get("preemptions", 0),
+                spills=s.get("spills", 0),
+                spill_hits=s.get("spill_hits", 0),
+                spill_fallbacks=s.get("spill_fallbacks", 0),
+                reprefill_tokens=s.get("reprefill_tokens", 0),
+            )
+        finally:
+            batcher.close()
+
+    discard = run(None)
+    spill = run(256 << 20)
+    res = dict(label=label, discard=discard, spill=spill,
+               speedup=round(discard["wall_s"] / max(spill["wall_s"], 1e-9), 2))
+    log(f"[{label}] discard: wall={discard['wall_s']}s "
+        f"preempt={discard['preemptions']} "
+        f"reprefill={discard['reprefill_tokens']} | spill: "
+        f"wall={spill['wall_s']}s hits={spill['spill_hits']} "
+        f"reprefill={spill['reprefill_tokens']} ({res['speedup']}x)")
+    return res
+
+
+def measure_replica_drain(model, params, label: str) -> dict:
+    """Graceful-drain evidence (ISSUE 6): two single-stage paged batcher
+    replicas, a stream live on replica 0, drain(0) mid-stream. Records how
+    long the drain took, how many requests it migrated, and — the actual
+    contract — that the client stream completed with zero drops."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.replicas import ReplicaSet
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return dict(label=label, skipped="needs 2 devices")
+    reps = []
+    for i in range(2):
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1, devices=devices[i : i + 1]),
+            microbatches=2, max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16,
+            prefill_chunk=128, pool_pages=8, page_size=128,
+        )
+        reps.append(ContinuousBatcher(eng, decode_block=8))
+    rs = ReplicaSet(reps)
+    vocab = model.config.vocab_size
+    prompt = [
+        int(x) for x in np.random.default_rng(9).integers(1, vocab - 64, 64)
+    ]
+    try:
+        for _ in reps[1].generate_step(prompt[:16], max_tokens=8):
+            pass  # compile the survivor's programs off the clock
+        toks: list = []
+        errs: list = []
+        started = threading.Event()
+
+        def consume():
+            try:
+                for t, _ in rs.generate_step(prompt, max_tokens=96):
+                    toks.append(t)
+                    started.set()
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                errs.append(repr(e)[:200])
+                started.set()
+
+        th = threading.Thread(target=consume)
+        th.start()
+        started.wait(120)
+        t0 = time.perf_counter()
+        out = rs.drain(0)
+        drain_s = time.perf_counter() - t0
+        th.join(timeout=120)
+        res = dict(
+            label=label,
+            drain_s=round(drain_s, 2),
+            migrated=out.get("migrated", 0),
+            closed=bool(out.get("closed")),
+            stream_tokens=len(toks),
+            dropped_streams=len(errs) + (1 if th.is_alive() else 0),
+            errors=errs,
+        )
+        log(f"[{label}] drain={res['drain_s']}s migrated={res['migrated']} "
+            f"stream_tokens={res['stream_tokens']} "
+            f"dropped={res['dropped_streams']}")
+        return res
+    finally:
+        rs.close()
+
+
 def measure_paged_ragged_vs_gather(model, params, label: str) -> dict:
     """The ragged paged-attention A/B (ISSUE 1 tentpole): mixed-length
     continuous batching decode through the same page pool on both paths.
@@ -1205,6 +1349,24 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 detail["overload_shedding_cpu"] = dict(error=repr(e)[:300])
                 log(f"[overload_shedding_cpu] FAILED: {e!r}")
+            try:
+                detail["preempt_spill_vs_discard_cpu"] = (
+                    measure_preempt_spill_vs_discard(
+                        m2, p2, "preempt_spill_vs_discard_cpu"
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["preempt_spill_vs_discard_cpu"] = dict(
+                    error=repr(e)[:300]
+                )
+                log(f"[preempt_spill_vs_discard_cpu] FAILED: {e!r}")
+            try:
+                detail["replica_drain_cpu"] = measure_replica_drain(
+                    m2, p2, "replica_drain_cpu"
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["replica_drain_cpu"] = dict(error=repr(e)[:300])
+                log(f"[replica_drain_cpu] FAILED: {e!r}")
             # the 0.28B fallback model, not tiny2: the A/B needs decode
             # blocks whose device time is non-trivial next to the host work,
             # or there is nothing for the async loop to overlap

@@ -38,6 +38,9 @@ POOL_HINTS = ("free", "pool", "pages", "slots")
 KNOWN_FAULT_SITES = {
     "scheduler.tick", "scheduler.harvest", "replica.dispatch",
     "multihost.exchange", "server.sse_write",
+    # KV migration (kv_transfer.py): block export at preemption/drain,
+    # block import at resume, and the replica drain entry point
+    "cache.export", "cache.import", "replica.drain",
 }
 # basename -> the inject() site that file must keep calling
 REQUIRED_FAULT_SITES = {
@@ -45,6 +48,7 @@ REQUIRED_FAULT_SITES = {
     "replicas.py": "replica.dispatch",
     "multihost.py": "multihost.exchange",
     "openai_api.py": "server.sse_write",
+    "kv_transfer.py": "cache.export",
 }
 
 

@@ -35,6 +35,16 @@
   called by name, so the call-closure walk never enters them); a guarded
   fallback whose dense tile is transient carries an inline
   ``# mst: allow(MST105): …``.
+- **MST106 sync-spill-in-tick** — a synchronous full-block pull
+  (``jax.device_get`` / ``np.asarray`` / ``.to_host()``) of an exported KV
+  page block (the result of ``export_block``/``export_pool_pages``) inside
+  a tick-hot function. A spilled block is the largest single transfer the
+  scheduler ever touches (a request's whole page chain); pulling it inline
+  stalls every live slot's decode for the full device→host copy. The spill
+  path must only DISPATCH the gather on the tick thread and leave the
+  blocking copy to the spill tier's flusher thread (see
+  ``kv_transfer.KVSpillTier``). An MST102 suppression on the same call does
+  NOT cover this rule — a full-block pull needs its own justification.
 """
 
 from __future__ import annotations
@@ -82,6 +92,10 @@ HOT_PATH_FUNCS = {
 
 SYNC_CALLS = {"jax.device_get", "np.asarray", "np.array", "numpy.asarray",
               "numpy.array"}
+
+# calls whose result is an exported KV page block (or its raw page pytrees):
+# the payload MST106 forbids pulling synchronously on the tick thread
+SPILL_PRODUCER_PREFIXES = ("export_block", "export_pool_pages")
 
 # decode-hot roots checked by MST105 (beyond '# mst: decode-hot'
 # annotations): every packed decode matmul funnels through these
@@ -287,6 +301,69 @@ def _check_double_harvest(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+def _is_spill_producer(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    return bool(name) and name.split(".")[-1].startswith(
+        SPILL_PRODUCER_PREFIXES
+    )
+
+
+def _check_sync_spill(mod: ModuleInfo) -> list[Finding]:
+    """MST106: a synchronous pull of an exported KV page block inside a
+    tick-hot function. Matches a ``SYNC_CALLS`` call (or ``.to_host()``)
+    whose argument/receiver subtree is a spill-producer call or a name
+    assigned from one earlier in the same function — the spill discipline
+    is dispatch-the-gather-on-tick, copy-on-flusher (kv_transfer)."""
+    findings = []
+    for fn in _hot_functions(mod):
+        block_names: set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _is_spill_producer(node.value)):
+                for t in node.targets:
+                    tname = dotted_name(t)
+                    if tname:
+                        block_names.add(tname.split(".")[-1])
+                    elif isinstance(t, ast.Tuple):
+                        for elt in t.elts:
+                            ename = dotted_name(elt)
+                            if ename:
+                                block_names.add(ename.split(".")[-1])
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in SYNC_CALLS:
+                subjects = list(node.args)
+                what = f"{name}()"
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "to_host" and not node.args):
+                subjects = [node.func.value]
+                what = ".to_host()"
+            else:
+                continue
+            touches_block = any(
+                (isinstance(sub, ast.Call) and _is_spill_producer(sub))
+                or (isinstance(sub, ast.Name) and sub.id in block_names)
+                for subject in subjects
+                for sub in ast.walk(subject)
+            )
+            if touches_block:
+                findings.append(Finding(
+                    "MST106", mod.display_path, node.lineno, node.col_offset,
+                    f"synchronous spill copy in hot path {fn.name}(): "
+                    f"{what} pulls a full exported KV page block, stalling "
+                    "every live slot's decode — dispatch the gather here "
+                    "and leave the device→host copy to the spill tier's "
+                    "flusher thread",
+                    context=qualname_for_line(mod.tree, node.lineno),
+                ))
+    return findings
+
+
 def _check_dense_dequant(mod: ModuleInfo, table: dict) -> list[Finding]:
     """MST105: a dense dequantized-weight materialization reachable from a
     decode-hot function. Roots come from ``DECODE_HOT_FUNCS`` (by basename)
@@ -403,6 +480,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings = _check_host_effects(mod, traced)
     findings += _check_hot_syncs(mod)
     findings += _check_double_harvest(mod)
+    findings += _check_sync_spill(mod)
     findings += _check_recompile_hazards(mod)
     findings += _check_dense_dequant(mod, table)
     return findings

@@ -141,6 +141,44 @@ def reset(cache: KVCache) -> KVCache:
     return cache._replace(offset=jnp.zeros((), jnp.int32))
 
 
+def export_pool_pages(cache: KVCache, page_ids: jax.Array):
+    """Gather pool pages out of a paged cache's k/v buffers.
+
+    ``page_ids`` is an int32 vector of pool-page indices; the paged pool
+    layout puts the pool axis at position 2 of every leaf
+    ``(S, L, pool_pages+1, B, page, H, D)``, so a ``take`` along axis 2
+    lifts a request's page chain out of the pool in one gather per leaf —
+    int8 pools (``{"d", "s"}`` dicts) come through ``jax.tree`` with their
+    scales attached, which is what makes the exported block a faithful
+    copy of the quantized codes rather than a lossy dequant/requant trip.
+
+    Pure and jittable: callers jit it once and reuse the program per page
+    count. Returns ``(k_pages, v_pages)`` pytrees shaped like the pool
+    leaves with the pool axis narrowed to ``len(page_ids)``."""
+    take = lambda leaf: jnp.take(leaf, page_ids, axis=2)  # noqa: E731
+    return jax.tree.map(take, cache.k), jax.tree.map(take, cache.v)
+
+
+def import_pool_pages(
+    cache: KVCache, k_pages, v_pages, page_ids: jax.Array
+) -> KVCache:
+    """Scatter previously exported page payloads into pool pages
+    ``page_ids`` of a paged cache — the inverse of
+    :func:`export_pool_pages`. The payload leaves may be host (numpy)
+    arrays from a spilled block or device arrays from a live one; dtypes
+    are cast to the pool's (a bf16→bf16 or int8→int8 identity in
+    practice — cross-mode imports are rejected before this call by
+    ``KVPageBlock.compatible_with``)."""
+
+    def put(pool, blk):
+        return pool.at[:, :, page_ids].set(jnp.asarray(blk).astype(pool.dtype))
+
+    return cache._replace(
+        k=jax.tree.map(put, cache.k, k_pages),
+        v=jax.tree.map(put, cache.v, v_pages),
+    )
+
+
 def rewind_slot_offset(cache: KVCache, slot, steps) -> KVCache:
     """Roll one slot's write offset back by ``steps`` positions (floored at
     0). ``offset`` must be the per-slot ``(M,)`` layout of the batched

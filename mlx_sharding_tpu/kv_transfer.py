@@ -1,0 +1,388 @@
+"""KV page-block migration: the transferable unit of decode state.
+
+The serving stack's two pressure valves used to be destructive: overcommit
+preemption discarded the victim's KV and re-prefilled from the folded-back
+prompt, and a replica could only leave the fleet via ``close()``, killing
+its in-flight work. This module turns both into *moves* instead of
+*deletes* by extracting the piece of state they both need to relocate —
+a request's KV pages plus the sampler state that makes its continuation
+bit-exact — into a serializable :class:`KVPageBlock`:
+
+- **Spill-don't-discard preemption** — ``ContinuousBatcher._preempt``
+  exports the victim's page chain into a :class:`KVSpillTier` (host-DRAM
+  LRU, budgeted by ``--spill-bytes``). Resume re-imports the pages into
+  freshly allocated pool pages instead of re-prefilling: preemption cost
+  becomes one page-gather + one page-scatter rather than a full prefill.
+- **Graceful replica drain** — ``ReplicaSet.drain(i)`` asks replica *i*'s
+  batcher to export every admitted request as a host-resident block and
+  end its stream with ``RequestMigratedError``; the dispatcher re-places
+  each one on a healthy replica, which imports the block (same pool
+  geometry) or re-prefills (different geometry / import failure).
+- **Crash-safe re-placement** — when a replica dies mid-stream, the
+  dispatcher rebuilds a blockless ``ResumeState`` from its own record of
+  delivered tokens; the failover replica folds the history into the
+  prompt and continues from the last emitted token.
+
+Asynchrony discipline (the PRESERVE-style overlap ``quant_gemv_pipelined``
+practices, arXiv:2501.08192): the tick-hot path only ever *dispatches* the
+device-side page gather — the device→host copy happens on the tier's
+background flusher thread via :meth:`KVPageBlock.to_host`. A synchronous
+full-block ``device_get`` in a tick-hot function is an mstcheck violation
+(MST106). Drain is the one exception: it runs quiesced, off the decode
+loop, where a blocking copy is shutdown-grade work.
+
+Failure degradation: every consumer treats a failed export/import (fault
+sites ``cache.export`` / ``cache.import``, corrupt block checksum, budget
+or pool exhaustion) as "fall back to yesterday's behavior" — fold the
+emitted history into the prompt and re-prefill. Token streams stay exact
+either way because the sampler PRNG row and repetition window
+(``resume_keys`` / ``resume_recent``) ride along in both paths.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import queue
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import numpy as np
+
+from mlx_sharding_tpu.analysis.runtime import make_lock
+from mlx_sharding_tpu.cache import export_pool_pages, import_pool_pages
+from mlx_sharding_tpu.testing.faults import inject
+
+logger = logging.getLogger(__name__)
+
+
+class BlockIntegrityError(RuntimeError):
+    """A host-materialized block failed its checksum or structural
+    validation — treat as corrupt and fall back to re-prefill."""
+
+
+def _leaves(tree) -> list:
+    return jax.tree.leaves(tree)
+
+
+@dataclass(eq=False)
+class KVPageBlock:
+    """One request's relocatable decode state: its KV page payloads (codes
+    *and* scales for int8 pools) plus everything the sampler needs to
+    continue the exact token stream on any engine with the same pool
+    geometry.
+
+    ``k_pages`` / ``v_pages`` mirror the paged pool's leaf structure with
+    the pool axis (2) narrowed to this request's page chain, in chain
+    order. They start as device arrays (the export gather is dispatched,
+    not waited on) and become numpy after :meth:`to_host`, which also
+    stamps ``checksum`` so a later :meth:`verify` catches corruption
+    before the pages are scattered into a pool.
+
+    KV-row accounting (matches the batcher's decode-write semantics): a
+    request that has emitted ``len(history)`` tokens has
+    ``prompt.size + len(history) - 1`` valid KV rows — the last emitted
+    token's KV is unwritten; its id is ``last_tok`` and it is fed as the
+    next decode step's input."""
+
+    k_pages: object
+    v_pages: object
+    n_tokens: int            # valid KV rows covered by the pages
+    page_size: int
+    prompt: np.ndarray       # original prompt ids (pre-fold)
+    history: list            # tokens emitted since admission/fold
+    produced: int            # total tokens delivered to the client
+    last_tok: int            # next decode input (== history[-1])
+    resume_keys: object      # sampler PRNG key row at export
+    resume_recent: object    # repetition-penalty recent window at export
+    checksum: Optional[str] = None
+    _host: bool = False
+    _lock: object = field(default_factory=lambda: make_lock("KVPageBlock._lock"), repr=False)
+
+    @property
+    def n_pages(self) -> int:
+        return _leaves(self.k_pages)[0].shape[2]  # mst: allow(MST201): shape is invariant across the to_host swap
+
+    @property
+    def nbytes(self) -> int:
+        """Payload size used against the spill budget (KV pages dominate;
+        the sampler rows are a few hundred bytes and are not counted)."""
+        return int(sum(
+            int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            for leaf in _leaves((self.k_pages, self.v_pages))  # mst: allow(MST201): shapes/dtypes invariant across the to_host swap
+        ))
+
+    @property
+    def is_host(self) -> bool:
+        return self._host  # mst: allow(MST201): monotonic flag; to_host is idempotent on a racy False
+
+    def to_host(self) -> "KVPageBlock":
+        """Materialize the page payloads in host DRAM and stamp the
+        checksum. Idempotent and thread-safe: the tier's flusher thread
+        and a drain both may race to flush the same block. This is the
+        only place the export's device→host copy blocks — never call it
+        from a tick-hot function (MST106)."""
+        with self._lock:
+            if self._host:
+                return self
+            k, v = jax.device_get((self.k_pages, self.v_pages))
+            self.k_pages = jax.tree.map(np.asarray, k)
+            self.v_pages = jax.tree.map(np.asarray, v)
+            if self.resume_keys is not None:
+                self.resume_keys = np.asarray(self.resume_keys)
+            if self.resume_recent is not None:
+                self.resume_recent = np.asarray(self.resume_recent)
+            self.checksum = self._fingerprint()
+            self._host = True
+        return self
+
+    def _fingerprint(self) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        h.update(f"{self.n_tokens}:{self.page_size}:{self.last_tok}".encode())
+        for leaf in _leaves((self.k_pages, self.v_pages)):
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        return h.hexdigest()
+
+    def verify(self) -> None:
+        """Structural checks always; checksum when host-materialized.
+        Raises :class:`BlockIntegrityError` on any mismatch — importers
+        catch it and fall back to re-prefill."""
+        if self.page_size < 1 or self.n_tokens < 1:
+            raise BlockIntegrityError(
+                f"degenerate block: page_size={self.page_size} "
+                f"n_tokens={self.n_tokens}"
+            )
+        if self.n_tokens > self.n_pages * self.page_size:
+            raise BlockIntegrityError(
+                f"block claims {self.n_tokens} KV rows but carries only "
+                f"{self.n_pages} pages of {self.page_size}"
+            )
+        if not self.history:
+            raise BlockIntegrityError("block without emitted history")
+        # hold the block lock so the fingerprint reads a consistent
+        # (payload, checksum) pair against a racing flusher to_host()
+        with self._lock:
+            if self._host and self.checksum is not None:
+                if self._fingerprint() != self.checksum:
+                    raise BlockIntegrityError(
+                        "KV page payload checksum mismatch (corrupt block)"
+                    )
+
+    def compatible_with(self, cache) -> Optional[str]:
+        """``None`` if this block's pages can be scattered into ``cache``'s
+        pool; else a reason string. Catches cross-mode imports (int8 block
+        into a bf16 pool and vice versa — the leaf trees differ) and any
+        per-leaf geometry mismatch outside the pool axis."""
+        with self._lock:  # consistent payload view vs a racing to_host()
+            ours = jax.tree.structure((self.k_pages, self.v_pages))
+            theirs = jax.tree.structure((cache.k, cache.v))
+            if ours != theirs:
+                return (
+                    f"KV storage mode mismatch: block {ours} vs pool {theirs}"
+                )
+            for blk, pool in zip(
+                _leaves((self.k_pages, self.v_pages)),
+                _leaves((cache.k, cache.v)),
+            ):
+                bs, ps = tuple(blk.shape), tuple(pool.shape)
+                if len(bs) != len(ps) or bs[:2] != ps[:2] or bs[3:] != ps[3:]:
+                    return (
+                        f"page geometry mismatch: block leaf {bs} vs pool {ps}"
+                    )
+                if np.dtype(blk.dtype) != np.dtype(pool.dtype):
+                    return (
+                        f"dtype mismatch: block {blk.dtype} vs pool {pool.dtype}"
+                    )
+        return None
+
+
+def export_block(
+    cache,
+    page_ids,
+    *,
+    page_size: int,
+    n_tokens: int,
+    prompt,
+    history,
+    produced: int,
+    resume_keys,
+    resume_recent,
+    gather=None,
+    put=None,
+) -> KVPageBlock:
+    """Lift a request's page chain out of a paged cache as a
+    :class:`KVPageBlock`. Dispatch-only on the device side: the returned
+    block holds device arrays until someone calls :meth:`to_host`.
+
+    ``gather`` lets the batcher pass its jitted ``export_pool_pages``;
+    ``put`` its device-placement hook. Fault site ``cache.export`` fires
+    before any device work so an injected failure leaves the cache
+    untouched."""
+    inject("cache.export", n_pages=len(page_ids), n_tokens=n_tokens)
+    ids = np.asarray(list(page_ids), np.int32)
+    if put is not None:
+        ids = put(ids)
+    fn = gather if gather is not None else export_pool_pages
+    k_pages, v_pages = fn(cache, ids)
+    history = [int(t) for t in history]
+    return KVPageBlock(
+        k_pages=k_pages,
+        v_pages=v_pages,
+        n_tokens=int(n_tokens),
+        page_size=int(page_size),
+        prompt=np.array(prompt, np.int32, copy=True),
+        history=history,
+        produced=int(produced),
+        last_tok=int(history[-1]),
+        resume_keys=resume_keys,
+        resume_recent=resume_recent,
+    )
+
+
+def import_block(cache, block: KVPageBlock, page_ids, *, scatter=None, put=None):
+    """Scatter ``block``'s page payloads into pool pages ``page_ids`` of
+    ``cache`` and return the updated cache. Validates the block first
+    (checksum + geometry); raises on any problem so the caller can release
+    the pages and fall back to re-prefill. Fault site ``cache.import``
+    models mid-import failure."""
+    inject("cache.import", n_pages=len(page_ids), n_tokens=block.n_tokens)
+    block.verify()
+    reason = block.compatible_with(cache)
+    if reason is not None:
+        raise BlockIntegrityError(reason)
+    if len(page_ids) != block.n_pages:
+        raise BlockIntegrityError(
+            f"import wants {len(page_ids)} pages for a {block.n_pages}-page block"
+        )
+    ids = np.asarray(list(page_ids), np.int32)
+    if put is not None:
+        ids = put(ids)
+    fn = scatter if scatter is not None else import_pool_pages
+    return fn(cache, block.k_pages, block.v_pages, ids)
+
+
+class KVSpillTier:
+    """Host-DRAM LRU spill tier for preempted requests' KV blocks.
+
+    ``put`` is cheap on the caller (scheduler) thread: it only links the
+    block into the LRU map and enqueues it for the background flusher
+    thread, which performs the blocking device→host copy off the tick
+    path. Eviction is strict LRU by insertion/refresh order; a block
+    larger than the whole budget is rejected outright (the caller falls
+    back to discard-and-re-prefill, exactly the pre-spill behavior).
+
+    Keys are the owning request objects (identity), so a tier entry dies
+    with its request and two requests can never collide."""
+
+    def __init__(self, budget_bytes: int, flush_async: bool = True):
+        if not isinstance(budget_bytes, int) or isinstance(budget_bytes, bool) \
+                or budget_bytes <= 0:
+            raise ValueError("spill budget must be a positive byte count")
+        self.budget_bytes = budget_bytes
+        self._blocks: "OrderedDict[object, KVPageBlock]" = OrderedDict()
+        self._bytes = 0
+        self._lock = make_lock("KVSpillTier._lock")
+        self.evictions = 0
+        self.rejects = 0
+        self.bytes_spilled_total = 0
+        self._flush_async = flush_async
+        self._flush_q: "queue.Queue" = queue.Queue()
+        self._flusher: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # ------------------------------------------------------------- flusher
+    def _ensure_flusher(self):
+        # caller holds self._lock
+        if self._flusher is None or not self._flusher.is_alive():
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="kv-spill-flusher", daemon=True
+            )
+            self._flusher.start()
+
+    def _flush_loop(self):
+        while True:
+            blk = self._flush_q.get()
+            if blk is None:
+                return
+            try:
+                blk.to_host()
+            except Exception:
+                # a failed flush leaves the block device-resident; take()
+                # still works while the arrays are alive, and verify() has
+                # no checksum to mismatch — degraded, not broken
+                logger.exception("KV spill flush failed; block stays on device")
+
+    # ------------------------------------------------------------- LRU map
+    def put(self, key, block: KVPageBlock) -> bool:
+        """Admit ``block`` under the budget, evicting LRU entries as
+        needed. Returns False (and counts a reject) when the block alone
+        exceeds the budget or the tier is closed."""
+        nb = block.nbytes
+        with self._lock:
+            if self._stopped or nb > self.budget_bytes:
+                self.rejects += 1
+                return False
+            old = self._blocks.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            while self._bytes + nb > self.budget_bytes and self._blocks:
+                _, evicted = self._blocks.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                self.evictions += 1
+            self._blocks[key] = block
+            self._bytes += nb
+            self.bytes_spilled_total += nb
+            if self._flush_async:
+                self._ensure_flusher()
+        if self._flush_async:
+            self._flush_q.put(block)
+        else:
+            block.to_host()
+        return True
+
+    def take(self, key) -> Optional[KVPageBlock]:
+        """Remove and return ``key``'s block, or None if it was evicted."""
+        with self._lock:
+            blk = self._blocks.pop(key, None)
+            if blk is not None:
+                self._bytes -= blk.nbytes
+            return blk
+
+    def peek(self, key) -> Optional[KVPageBlock]:
+        with self._lock:
+            return self._blocks.get(key)
+
+    def contains(self, key) -> bool:
+        with self._lock:
+            return key in self._blocks
+
+    def drop(self, key) -> None:
+        self.take(key)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._blocks.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "bytes_in_use": self._bytes,
+                "blocks": len(self._blocks),
+                "evictions": self.evictions,
+                "rejects": self.rejects,
+                "bytes_spilled_total": self.bytes_spilled_total,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            self._stopped = True
+            flusher = self._flusher
+        self._flush_q.put(None)
+        if flusher is not None and flusher.is_alive():
+            flusher.join(timeout=5)
+        self.clear()

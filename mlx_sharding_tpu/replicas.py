@@ -10,21 +10,32 @@ has no equivalent (one gRPC chain serves one request at a time,
 ref: shard/openai_api.py:543-563).
 
 Each replica holds its own copy of the weights (device_put onto its own
-mesh by PipelineEngine) and its own KV state; requests never migrate, so
-per-request streams are exactly what the replica alone would produce.
+mesh by PipelineEngine) and its own KV state. Requests route once and
+normally stay put; when a stream must leave its replica anyway — graceful
+drain or a mid-stream crash — it migrates as a ``ResumeState`` (see
+``kv_transfer``): the replica (or the dispatcher's own delivered-token
+record) captures prompt + emitted history + sampler rows + optionally the
+host-materialized KV page block, and the dispatcher re-places the request
+on a healthy replica, resuming from the last token the client saw.
 
 Resilience: the dispatcher is also the failure domain boundary. A replica
 that keeps failing dispatches is circuit-broken out of routing (consecutive
 failures ≥ ``breaker_threshold`` opens the breaker for ``probe_interval``
 seconds; after that ONE live request is let through as a half-open probe —
 success closes the breaker, failure re-opens it). Requests that fail before
-their first token retry on another replica; started streams never migrate
-(their KV lives on the failed replica). While at least one replica lives the
+their first token retry on another replica. Started streams migrate only
+when a token-exact continuation is possible: the target must advertise
+``supports_resume`` and every delivered token must have been trackable —
+otherwise the failure surfaces to the client as before. ``drain(i)``
+retires a replica without dropping work: it stops routing to *i*, asks its
+batcher to ``migrate_out()`` every admitted request, waits for in-flight
+dispatches to unwind, then closes it. While at least one replica lives the
 set keeps serving and ``health()`` reports degraded, not dead.
 """
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Optional
 
@@ -32,9 +43,16 @@ from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.resilience import (
     QueueFullError,
     ReplicasUnavailableError,
+    RequestMigratedError,
     RequestTimeoutError,
+    ResumeState,
 )
 from mlx_sharding_tpu.testing.faults import inject
+
+
+class _ResumeUnsupported(Exception):
+    """Internal: the picked replica can't continue a migrated stream
+    (no ``supports_resume``). Not a failure — just the wrong target."""
 
 
 class ReplicaSet:
@@ -49,7 +67,7 @@ class ReplicaSet:
     concurrent = True  # the server must not serialize requests around us
 
     def __init__(self, replicas: list, *, breaker_threshold: int = 3,
-                 probe_interval: float = 5.0):
+                 probe_interval: float = 5.0, resume_streams: bool = True):
         if not replicas:
             raise ValueError("ReplicaSet needs at least one replica")
         if breaker_threshold < 1:
@@ -59,12 +77,24 @@ class ReplicaSet:
         self.replicas = list(replicas)
         self.breaker_threshold = breaker_threshold
         self.probe_interval = probe_interval
+        # crash-safe re-placement: when a replica dies mid-stream, rebuild
+        # the request from the dispatcher's delivered-token record and
+        # resume it on a healthy replica (False restores the old raise)
+        self.resume_streams = bool(resume_streams)
         n = len(self.replicas)
         self._inflight = [0] * n
         self.served = [0] * n  # lifetime dispatch counts (retries included)
         self.failures = [0] * n  # lifetime dispatch failures
         self.breaker_opens = [0] * n  # closed→open transitions
         self._fails_consec = [0] * n
+        # drain lifecycle (all under _lock): draining = migrate_out in
+        # progress, no new dispatches, in-flight streams unwinding;
+        # retired = permanently out of routing (drain completed)
+        self._draining = [False] * n     # routing quarantine (sticky on failure)
+        self._drain_active = [False] * n  # a drain() call is currently running
+        self._retired = [False] * n
+        self.drains = 0            # completed drain() calls
+        self.migrated_streams = 0  # resumed attempts that delivered a token
         # monotonic stamp until which the breaker holds the replica out of
         # routing; 0 = closed. Past the stamp the replica is HALF-OPEN: one
         # request may probe it (_probing guards against a probe stampede).
@@ -98,7 +128,7 @@ class ReplicaSet:
             now = time.monotonic()
             closed, half_open = [], []
             for j in range(len(self.replicas)):
-                if j in exclude:
+                if j in exclude or self._draining[j] or self._retired[j]:
                     continue
                 state = self._breaker_state(j, now)
                 if state == "closed":
@@ -151,9 +181,25 @@ class ReplicaSet:
                 self._open_until[i] = now + self.probe_interval
                 self.breaker_opens[i] += 1
 
+    @staticmethod
+    def _note_token(emitted: list, item) -> bool:
+        """Record a delivered token for crash-resume accounting. Items are
+        ``(token, logprobs)`` pairs from the engines (or bare tokens from
+        simple generators); False means the token wasn't an integer and the
+        stream can no longer be resumed exactly."""
+        tok = item[0] if isinstance(item, (tuple, list)) else item
+        try:
+            emitted.append(int(tok))
+            return True
+        except (TypeError, ValueError):
+            return False
+
     def generate_step(self, prompt_tokens, **kw):
         excluded: set[int] = set()
         last_exc: Optional[BaseException] = None
+        resume: Optional[ResumeState] = None  # carried across attempts
+        emitted: list = []  # every token delivered to the client so far
+        trackable = True    # ints only; else crash-resume is refused
         while True:
             try:
                 i, probe = self._pick(excluded)
@@ -164,20 +210,37 @@ class ReplicaSet:
                 raise
             started = False
             try:
+                rep = self.replicas[i]
+                fwd = kw
+                if resume is not None:
+                    if not getattr(rep, "supports_resume", False):
+                        # a resumed stream needs the _resume protocol; a
+                        # plain engine would re-run from scratch and
+                        # double-emit — try the other replicas instead
+                        raise _ResumeUnsupported()
+                    fwd = dict(kw, _resume=resume)
                 inject("replica.dispatch", replica=i)
                 serial = self._serial_locks[i]
                 if serial is not None:
                     with serial:
-                        for item in self.replicas[i].generate_step(
-                            prompt_tokens, **kw
-                        ):
-                            started = True
+                        for item in rep.generate_step(prompt_tokens, **fwd):
+                            if not started:
+                                started = True
+                                if resume is not None:
+                                    with self._lock:
+                                        self.migrated_streams += 1
+                            if trackable:
+                                trackable = self._note_token(emitted, item)
                             yield item
                 else:
-                    for item in self.replicas[i].generate_step(
-                        prompt_tokens, **kw
-                    ):
-                        started = True
+                    for item in rep.generate_step(prompt_tokens, **fwd):
+                        if not started:
+                            started = True
+                            if resume is not None:
+                                with self._lock:
+                                    self.migrated_streams += 1
+                        if trackable:
+                            trackable = self._note_token(emitted, item)
                         yield item
                 self._record_success(i)
                 return
@@ -191,10 +254,21 @@ class ReplicaSet:
                 if started:
                     self._record_success(i)
                 raise
+            except _ResumeUnsupported:
+                excluded.add(i)  # keep last_exc: it names the real failure
             except ValueError:
                 raise  # bad request — the replica is healthy
+            except RequestMigratedError as exc:
+                # graceful drain: the replica ended the stream with the
+                # complete ResumeState (KV block or prompt+history). Not a
+                # failure — no breaker strike; re-place and continue the
+                # client's stream where it left off
+                resume = exc.state
+                excluded.add(i)
+                last_exc = exc
             except QueueFullError as exc:
-                # saturation, not sickness: no breaker penalty, but try the
+                # saturation (or ReplicaDrainingError, its drain-time
+                # subtype), not sickness: no breaker penalty, but try the
                 # other replicas before giving the client a 429
                 excluded.add(i)
                 last_exc = exc
@@ -210,11 +284,104 @@ class ReplicaSet:
             except Exception as exc:  # noqa: BLE001 — any replica-side crash
                 self._record_failure(i)
                 if started:
-                    raise  # tokens were delivered; streams never migrate
+                    if not (self.resume_streams and trackable):
+                        raise  # tokens delivered, no exact resume possible
+                    # crash-safe re-placement: rebuild the request from the
+                    # dispatcher's own delivered-token record. Greedy
+                    # streams resume token-exact; sampled streams reseed
+                    # (the PRNG rows died with the replica) — distribution-
+                    # correct, not bit-exact (see README)
+                    resume = ResumeState(
+                        prompt=prompt_tokens,
+                        history=list(emitted),
+                        produced=len(emitted),
+                    )
                 excluded.add(i)
                 last_exc = exc
             finally:
                 self._done(i, probe)
+
+    # -------------------------------------------------------------- drain
+    def drain(self, i: int, deadline: float = 30.0) -> dict:
+        """Gracefully retire replica ``i``: stop routing to it, migrate its
+        admitted requests off (each stream ends with a
+        ``RequestMigratedError`` whose ``ResumeState`` this dispatcher
+        re-places on a healthy replica — the client never notices), wait
+        for in-flight dispatches to unwind, then close and retire it.
+
+        Failure semantics: if the migration step itself fails (injected
+        ``replica.drain`` fault, wedged batcher), the replica stays
+        QUARANTINED — ``draining`` keeps new work away while the still-
+        flowing streams finish — and the error surfaces so the operator can
+        retry. The replica is never closed while un-migrated streams could
+        be truncated; if in-flight dispatches don't unwind by ``deadline``
+        it is retired without closing (``closed: False`` in the result) and
+        the leak is logged."""
+        n = len(self.replicas)
+        if not isinstance(i, int) or isinstance(i, bool) or not 0 <= i < n:
+            raise ValueError(f"replica index must be in [0, {n}); got {i!r}")
+        with self._lock:
+            if self._retired[i]:
+                return {"replica": i, "migrated": 0, "closed": True,
+                        "already_retired": True}
+            if self._drain_active[i]:
+                raise ValueError(f"replica {i} is already draining")
+            others = [
+                j for j in range(n)
+                if j != i and not self._retired[j] and not self._draining[j]
+            ]
+            if not others:
+                raise ValueError(
+                    "cannot drain the last live replica — the migrated "
+                    "requests would have nowhere to resume"
+                )
+            self._drain_active[i] = True
+            self._draining[i] = True
+        r = self.replicas[i]
+        try:
+            inject("replica.drain", replica=i)
+            migrated = (
+                r.migrate_out(deadline=deadline)
+                if hasattr(r, "migrate_out") else 0
+            )
+        except Exception:
+            # leave the replica quarantined (draining=True: no new routes,
+            # in-flight streams keep flowing) and surface the failure —
+            # the operator calls drain() again to retry; nothing was dropped
+            logging.getLogger(__name__).exception(
+                "drain of replica %d failed mid-migration; replica "
+                "quarantined, retry drain()", i,
+            )
+            # mst: allow(MST202): slot i is owned by this call while _drain_active[i] is set
+            with self._lock:
+                self._drain_active[i] = False
+            raise
+        deadline_at = time.monotonic() + deadline
+        while time.monotonic() < deadline_at:
+            with self._lock:
+                if self._inflight[i] == 0:
+                    break
+            time.sleep(0.01)
+        with self._lock:
+            leaked = self._inflight[i]
+        closed = False
+        if leaked == 0:
+            if hasattr(r, "close"):
+                r.close()
+            closed = True
+        else:
+            logging.getLogger(__name__).warning(
+                "replica %d retired with %d dispatches still unwinding — "
+                "left unclosed to avoid truncating their streams",
+                i, leaked,
+            )
+        # mst: allow(MST202): slot i is owned by this call while _drain_active[i] is set
+        with self._lock:
+            self._retired[i] = True
+            self._draining[i] = False
+            self._drain_active[i] = False
+            self.drains += 1
+        return {"replica": i, "migrated": migrated, "closed": closed}
 
     # ------------------------------------------------------- observability
     def stats(self):
@@ -242,9 +409,14 @@ class ReplicaSet:
         return tuple(sum(col) for col in zip(*totals))
 
     def resilience_stats(self) -> dict:
-        """Deadline/shedding counters summed across replica batchers."""
+        """Deadline/shedding/migration counters summed across replica
+        batchers, plus the dispatcher's own drain/re-placement counts."""
         agg = {"timeouts": 0, "shed_queue_full": 0, "shed_deadline": 0,
                "max_queue": None, "scheduler_thread_live": True}
+        summed = ("preemptions", "spills", "spill_hits", "spill_fallbacks",
+                  "migrations_out", "migrations_in")
+        for k in summed:
+            agg[k] = 0
         for r in self.replicas:
             if not hasattr(r, "resilience_stats"):
                 continue
@@ -252,16 +424,46 @@ class ReplicaSet:
             agg["timeouts"] += s["timeouts"]
             agg["shed_queue_full"] += s["shed_queue_full"]
             agg["shed_deadline"] += s["shed_deadline"]
+            for k in summed:
+                agg[k] += s.get(k, 0)
             if s["max_queue"] is not None:
                 agg["max_queue"] = (agg["max_queue"] or 0) + s["max_queue"]
             agg["scheduler_thread_live"] = (
                 agg["scheduler_thread_live"] and s["scheduler_thread_live"]
             )
+        with self._lock:
+            agg["drains"] = self.drains
+            agg["migrated_streams"] = self.migrated_streams
+        return agg
+
+    def spill_stats(self) -> Optional[dict]:
+        """KV spill/migration counters summed across replica batchers (the
+        ``mst_kv_*`` gauge source when serving through a ReplicaSet), plus
+        the dispatcher's crash/drain re-placement count. None when no
+        replica has a paged pool."""
+        per = [
+            r.spill_stats() for r in self.replicas
+            if hasattr(r, "spill_stats")
+        ]
+        per = [s for s in per if s is not None]
+        if not per:
+            return None
+        agg: dict = {"enabled": any(s.get("enabled") for s in per)}
+        for k in ("spills", "spill_hits", "spill_fallbacks",
+                  "migrations_out", "migrations_in", "reprefill_tokens",
+                  "preemptions", "budget_bytes", "bytes_in_use", "blocks",
+                  "evictions", "rejects"):
+            agg[k] = sum(s.get(k, 0) for s in per)
+        with self._lock:
+            agg["migrated_streams"] = self.migrated_streams
+            agg["drains"] = self.drains
         return agg
 
     def health(self) -> dict:
-        """Partial-capacity health: degraded (still serving) while at least
-        one replica lives, dead only when none do."""
+        """Partial-capacity health: ``draining`` while a drain is in
+        progress, degraded (still serving) while at least one replica
+        lives, dead only when none do. Retired replicas left the fleet on
+        purpose — they don't count against ``ok``."""
         with self._lock:
             now = time.monotonic()
             states = [
@@ -269,24 +471,37 @@ class ReplicaSet:
             ]
             consec = list(self._fails_consec)
             fails = list(self.failures)
+            draining = list(self._draining)
+            retired = list(self._retired)
         per, live = [], 0
         for j, r in enumerate(self.replicas):
             entry = {"replica": j, "breaker": states[j],
                      "consecutive_failures": consec[j], "failures": fails[j]}
+            if retired[j]:
+                entry["state"] = "retired"
+            elif draining[j]:
+                entry["state"] = "draining"
             sub = r.health() if hasattr(r, "health") else None
             alive = states[j] != "open"
             if sub is not None:
                 entry["engine"] = sub["status"]
                 alive = alive and sub["serving"]
-            if alive:
+            if alive and not retired[j] and not draining[j]:
                 live += 1
             per.append(entry)
         n = len(self.replicas)
+        expected = n - sum(retired)
+        status = (
+            "draining" if any(draining)
+            else ("ok" if live == expected else "degraded")
+        )
         return {
-            "status": "ok" if live == n else "degraded",
+            "status": status,
             "serving": live >= 1,
             "replicas_total": n,
             "replicas_live": live,
+            "replicas_draining": sum(draining),
+            "replicas_retired": sum(retired),
             "replicas": per,
         }
 

@@ -15,6 +15,13 @@ it without cost:
   the submit queue is at ``--max-queue``; HTTP 429 + ``Retry-After``.
 - :class:`ReplicasUnavailableError` — every replica is circuit-broken;
   HTTP 503.
+- :class:`ReplicaDrainingError` — the replica is being retired and is not
+  accepting new requests; a ``QueueFullError`` subtype so the dispatcher
+  retries elsewhere and a lone replica maps to HTTP 429 + ``Retry-After``.
+- :class:`RequestMigratedError` — a draining replica ended this stream so
+  it can continue elsewhere; carries a :class:`ResumeState` the dispatcher
+  re-places on a healthy replica. Never reaches a client unless there is
+  no migration target.
 
 Deadline semantics (enforced by ``ContinuousBatcher``):
 
@@ -78,6 +85,53 @@ class QueueFullError(RuntimeError):
 class ReplicasUnavailableError(RuntimeError):
     """Every replica is circuit-broken (or excluded by failed retries) —
     there is nowhere to route the request. Maps to HTTP 503."""
+
+
+class ReplicaDrainingError(QueueFullError):
+    """The replica is draining (``ReplicaSet.drain`` / ``migrate_out``) and
+    rejects new work. Subtype of :class:`QueueFullError` so the dispatcher's
+    saturation handling applies unchanged: retry on another replica, no
+    breaker strike, 429 + ``Retry-After`` if nothing else is available."""
+
+    def __init__(self, retry_after_s: float = 1.0):
+        self.depth = 0
+        self.max_queue = 0
+        self.retry_after_s = retry_after_s
+        RuntimeError.__init__(
+            self, "replica is draining and not accepting new requests"
+        )
+
+
+@dataclass
+class ResumeState:
+    """Everything needed to continue a partially-generated request on a
+    different engine, captured when its stream is migrated off a replica.
+
+    Kept dependency-free: ``prompt`` and the sampler fields hold whatever
+    array-likes the producing engine recorded (numpy on the host side);
+    ``block`` is an optional host-materialized ``kv_transfer.KVPageBlock``
+    whose pages the target can import instead of re-prefilling. When
+    ``block`` is ``None`` the target folds ``history`` back into the prompt
+    and re-prefills — slower, but token-exact (``resume_keys`` /
+    ``resume_recent`` carry the sampler PRNG chain and repetition window
+    across the fold when the source captured them)."""
+
+    prompt: object                 # original prompt token ids (pre-fold)
+    history: list                  # tokens emitted since the last fold
+    produced: int = 0              # tokens already delivered to the client
+    block: object = None           # optional KVPageBlock (host-resident)
+    resume_keys: object = None     # per-request sampler PRNG key row
+    resume_recent: object = None   # repetition-penalty recent-token window
+
+
+class RequestMigratedError(RuntimeError):
+    """A replica ended this stream mid-flight so it can resume elsewhere
+    (graceful drain). Carries the :class:`ResumeState`; the dispatcher
+    re-places it and the client never observes the hop."""
+
+    def __init__(self, state: ResumeState, reason: str = "replica draining"):
+        self.state = state
+        super().__init__(f"request migrated: {reason}")
 
 
 @dataclass

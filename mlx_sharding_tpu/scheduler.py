@@ -61,12 +61,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from mlx_sharding_tpu.analysis.runtime import make_lock
-from mlx_sharding_tpu.cache import KVCache, rewind_slot_offset
+from mlx_sharding_tpu.cache import (
+    KVCache,
+    export_pool_pages,
+    import_pool_pages,
+    rewind_slot_offset,
+)
 from mlx_sharding_tpu.generate import block_lp_outputs, block_token_logprobs
+from mlx_sharding_tpu.kv_transfer import KVSpillTier, export_block, import_block
 from mlx_sharding_tpu.resilience import (
     Deadlines,
     QueueFullError,
+    ReplicaDrainingError,
+    RequestMigratedError,
     RequestTimeoutError,
+    ResumeState,
 )
 from mlx_sharding_tpu.testing.faults import inject
 from mlx_sharding_tpu.sample import (
@@ -77,7 +86,7 @@ from mlx_sharding_tpu.sample import (
 )
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: requests key the spill tier
 class _Request:
     prompt: np.ndarray  # (T,) int32
     sp: SamplerParams
@@ -118,6 +127,11 @@ class _Request:
     history: list = field(default_factory=list)
     resume_keys: Optional[np.ndarray] = None
     resume_recent: Optional[np.ndarray] = None
+    # KV migration state: ``spilled`` marks a KVPageBlock waiting in the
+    # batcher's spill tier (preemption), ``_block`` carries a block handed
+    # in directly (cross-replica migration via generate_step(_resume=…))
+    spilled: bool = False
+    _block: Optional[object] = None
 
 
 @dataclass
@@ -145,11 +159,15 @@ class ContinuousBatcher:
     # enforces them scheduler-side; the server checks this attr before
     # forwarding deadline kwargs (plain Generator/PipelineEngine lack them)
     supports_deadlines = True
+    # generate_step accepts _resume=ResumeState — the dispatcher only
+    # re-places migrated/crashed streams onto engines that advertise this
+    supports_resume = True
 
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
                  policy: str = "fifo", prefix_cache: bool = False,
                  overcommit: bool = False, draft_engine=None, spec_k: int = 4,
-                 max_queue: Optional[int] = None, async_sched: str = "auto"):
+                 max_queue: Optional[int] = None, async_sched: str = "auto",
+                 spill_bytes: Optional[int] = None):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
         if max_queue is not None and (not isinstance(max_queue, int) or max_queue < 1):
@@ -196,12 +214,41 @@ class ContinuousBatcher:
                 "overcommit admission requires a paged engine (pool_pages)"
             )
         if overcommit and jax.process_count() > 1:
-            # preemption stashes device sampler rows host-side (device_get)
-            # and rewrites table/active rows outside the mirrored multihost
-            # op stream — worker ranks would desync into a collective hang
+            # The sampler-state stash itself is no longer the blocker (it
+            # rides a KVPageBlock now, a pure device-side gather every rank
+            # could mirror). What remains genuinely unsupported: preemption
+            # and block re-import are HOST-side scheduling decisions that
+            # rewrite page-table/active rows and pop the rank-local free
+            # list outside the mirrored multihost op stream — worker ranks
+            # can't observe the controller's choice of victim/pages, so
+            # their mirrored jitted programs would consume diverged inputs
+            # and desync into a collective hang.
             raise ValueError(
-                "overcommit admission is not supported in multi-host serving"
+                "overcommit admission is not supported in multi-host "
+                "serving: preemption/resume rewrites page tables and free "
+                "lists host-side, outside the op stream worker ranks "
+                "mirror; run overcommit on single-host replicas (e.g. "
+                "behind --replicas) instead"
             )
+        if spill_bytes is not None:
+            if isinstance(spill_bytes, bool) or not isinstance(spill_bytes, int) \
+                    or spill_bytes <= 0:
+                raise ValueError(
+                    f"spill_bytes must be a positive byte count, got "
+                    f"{spill_bytes!r}"
+                )
+            if not getattr(engine, "paged", False):
+                raise ValueError(
+                    "KV spill (--spill-bytes) requires a paged engine "
+                    "(pool_pages): spilling moves pool pages"
+                )
+            if draft_engine is not None:
+                # the draft's dense KV has no page chain to export; a spilled
+                # target block would resume against a stale draft cache
+                raise ValueError(
+                    "KV spill is incompatible with a draft engine — "
+                    "speculative slots re-prefill on preemption"
+                )
         if async_sched not in ("on", "off", "auto"):
             raise ValueError(
                 f"async_sched must be 'on', 'off' or 'auto', got {async_sched!r}"
@@ -308,6 +355,27 @@ class ContinuousBatcher:
         self.overcommit = bool(overcommit)
         self.preemptions = 0
         self._admit_counter = 0
+        # KV migration (kv_transfer.py): spill-don't-discard preemption and
+        # request migration. The tier holds preempted requests' page blocks
+        # in host DRAM under an LRU budget; export is a dispatched device
+        # gather (the blocking device→host copy runs on the tier's flusher
+        # thread, never the tick path — MST106), import is one page scatter
+        # instead of a re-prefill. All counters below are written under
+        # _admission_lock (racy reads are gauge-grade, like preemptions).
+        self.spill_bytes = spill_bytes
+        self.spill = KVSpillTier(spill_bytes) if spill_bytes else None
+        self.spills = 0            # blocks exported to the tier at preempt
+        self.spill_hits = 0        # resumes served by a block import
+        self.spill_fallbacks = 0   # export/import/budget failures → re-prefill
+        self.migrations_out = 0    # requests exported by migrate_out (drain)
+        self.migrations_in = 0     # resumed requests accepted via _resume
+        self.reprefill_tokens = 0  # tokens re-prefilled after discard paths
+        self._export_pages = jax.jit(export_pool_pages) if self.paged else None
+        self._import_pages = jax.jit(import_pool_pages) if self.paged else None
+        # drain flag: migrate_out() sets it (under _start_lock, like _stop);
+        # the scheduler thread notices at the next tick, quiesces, and ends
+        # every stream with a RequestMigratedError carrying its ResumeState
+        self._migrate_requested = False
         # speculative decoding across slots: per tick, the draft proposes K
         # tokens for every active slot and the target verifies all of them
         # in one T=K forward; each slot emits its accepted prefix + one
@@ -440,22 +508,68 @@ class ContinuousBatcher:
         request_timeout: Optional[float] = None,  # submit → last token budget
         ttft_timeout: Optional[float] = None,     # submit → first token budget
         stall_timeout: Optional[float] = None,    # inter-token watchdog
+        _resume: Optional[ResumeState] = None,    # dispatcher-internal
     ):
         # Eager validation/admission, lazy consumption: every rejection
         # (bad params, queue full) raises on the CALLING thread before any
         # request state exists — the server can answer 400/429 before it has
         # committed to a streaming response. Only the token loop is deferred.
+        with self._start_lock:
+            draining = self._migrate_requested
+        if draining:
+            # draining/retired: reject up front so the dispatcher re-places
+            # on a healthy replica (QueueFullError subtype → retry, no strike)
+            raise ReplicaDrainingError()
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
-        if prompt.size + max_tokens > self.engine.max_seq:
+        # Re-placement of a partially generated stream (replica drain /
+        # crash failover): continue from the migrated state instead of
+        # starting over. Preferred path imports the shipped KVPageBlock;
+        # without one (or when this engine can't host it) the emitted
+        # history folds into the prompt and re-prefills — slower but
+        # token-exact, since the sampler PRNG row and repetition window
+        # travel in the state when the source captured them.
+        produced0 = 0
+        hist: list = []
+        block = None
+        resume_keys = resume_recent = None
+        if _resume is not None:
+            produced0 = int(_resume.produced)
+            if produced0 >= max_tokens:
+                raise ValueError(
+                    f"resumed request already produced {produced0} of "
+                    f"{max_tokens} tokens"
+                )
+            hist = [int(t) for t in (_resume.history or [])]
+            if len(hist) > produced0:
+                # history is "tokens emitted since the last fold" — always a
+                # suffix of what the client saw, so it can be SHORTER than
+                # produced (the rest already folded into the prompt) but
+                # never longer: that would re-emit tokens the accounting
+                # says were never delivered
+                raise ValueError(
+                    f"resume state inconsistent: produced={produced0} but "
+                    f"history carries {len(hist)} tokens"
+                )
+            block = _resume.block
+            if block is not None and (not self.paged or self.draft is not None):
+                block = None  # no pool to import into; fall back to fold
+            if block is None and hist:
+                resume_keys = _resume.resume_keys
+                resume_recent = _resume.resume_recent
+                prompt = np.concatenate([prompt, np.asarray(hist, np.int32)])
+                hist = []
+        budget = max_tokens - produced0
+        total = (block.n_tokens if block is not None else prompt.size) + budget
+        if total > self.engine.max_seq:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_tokens ({max_tokens}) exceeds "
                 f"KV capacity {self.engine.max_seq}"
             )
-        if self.paged and self._pages_needed(prompt.size, max_tokens) > self.engine.pool_pages:
+        if self.paged and -(-total // self.engine.page_size) > self.engine.pool_pages:
             raise ValueError(
-                f"request needs {self._pages_needed(prompt.size, max_tokens)} "
+                f"request needs {-(-total // self.engine.page_size)} "
                 f"pages, pool has {self.engine.pool_pages} — it could never "
                 "be admitted"
             )
@@ -496,6 +610,16 @@ class ContinuousBatcher:
             repetition_penalty=repetition_penalty,
             logit_bias=logit_bias,
         )
+        if _resume is not None:
+            req.produced = produced0
+            req.history = hist
+            req._block = block
+            if resume_keys is not None:
+                req.resume_keys = np.asarray(resume_keys)
+            if resume_recent is not None:
+                req.resume_recent = np.asarray(resume_recent)
+            with self._admission_lock:
+                self.migrations_in += 1
         self._ensure_running()
         if self.max_queue is not None:
             with self._admission_lock:
@@ -601,14 +725,48 @@ class ContinuousBatcher:
                 "shed_deadline": self.shed_deadline,
                 "max_queue": self.max_queue,
                 "scheduler_thread_live": live,
+                "preemptions": self.preemptions,
+                "spills": self.spills,
+                "spill_hits": self.spill_hits,
+                "spill_fallbacks": self.spill_fallbacks,
+                "migrations_out": self.migrations_out,
+                "migrations_in": self.migrations_in,
             }
+
+    def spill_stats(self) -> Optional[dict]:
+        """KV spill/migration counters + tier occupancy for /metrics
+        (``mst_kv_spill_*`` / ``mst_kv_migration_*``); None on dense
+        engines, which have no page pool to export blocks from. The tier's
+        own stats are read before taking the admission lock so the two
+        locks never nest."""
+        if not self.paged:
+            return None
+        spill = self.spill  # mst: allow(MST201): bound once in __init__, never reassigned
+        tier = spill.stats() if spill is not None else {}
+        with self._admission_lock:
+            out = {
+                "enabled": spill is not None,
+                "spills": self.spills,
+                "spill_hits": self.spill_hits,
+                "spill_fallbacks": self.spill_fallbacks,
+                "migrations_out": self.migrations_out,
+                "migrations_in": self.migrations_in,
+                "reprefill_tokens": self.reprefill_tokens,
+                "preemptions": self.preemptions,
+            }
+        out["budget_bytes"] = tier.get("budget_bytes", 0)
+        out["bytes_in_use"] = tier.get("bytes_in_use", 0)
+        out["blocks"] = tier.get("blocks", 0)
+        out["evictions"] = tier.get("evictions", 0)
+        out["rejects"] = tier.get("rejects", 0)
+        return out
 
     def health(self) -> dict:
         """Serving health for the /health endpoint: ``status`` in
         ok/degraded/draining, ``serving`` decides 200 vs 503."""
         with self._start_lock:
             live = self._live_locked()
-            draining = self._stop
+            draining = self._stop or self._migrate_requested
         if not live:
             # a wedged thread (even one noticed during close) beats draining:
             # the operator needs to see the leak, not a polite shutdown
@@ -820,6 +978,9 @@ class ContinuousBatcher:
                     "is wedged; the thread is abandoned (daemon) and /health "
                     "now reports degraded", timeout,
                 )
+        spill = self.spill  # mst: allow(MST201): bound once in __init__, never reassigned
+        if spill is not None:
+            spill.close()
 
     # ------------------------------------------------------------ internals
     def _ensure_running(self):
@@ -864,6 +1025,9 @@ class ContinuousBatcher:
         reused_tokens = 0
         req.admit_seq = self._admit_counter
         self._admit_counter += 1
+        block = self._take_block(req)
+        if block is not None and self._import_block(req, slot, slot_arr, block):
+            return
         if self.paged:
             n = self._need_pages(req)
             chain = req._chain if req._chain is not None else self._prefix_lookup(req)
@@ -896,6 +1060,21 @@ class ContinuousBatcher:
                 self._put(jnp.asarray(reused_tokens, jnp.int32)),
             )
         )
+        self._write_sampler_row(req, slot_arr)
+        if self.draft is not None:
+            # the draft mirrors the slot from position 0 (no page sharing)
+            self.dcache = self.dcache._replace(
+                offset=self._row_set(
+                    self.dcache.offset, slot_arr,
+                    self._put(jnp.asarray(0, jnp.int32)),
+                )
+            )
+        self._slots[slot] = req
+        req.slot = slot
+        # prefill starts past the reused prefix — its KV is already mapped
+        req.prefill_pos = reused_tokens
+
+    def _write_sampler_row(self, req: _Request, slot_arr):
         # pad the request's sampler params to the batched width host-side,
         # then write its row inside jit (set_sampler_slot is eager)
         width = self.sp.bias_indices.shape[1]
@@ -911,18 +1090,106 @@ class ContinuousBatcher:
             self.rep_sizes, slot_arr,
             self._put(jnp.asarray(req.rep_context, jnp.int32)),
         )
-        if self.draft is not None:
-            # the draft mirrors the slot from position 0 (no page sharing)
-            self.dcache = self.dcache._replace(
-                offset=self._row_set(
-                    self.dcache.offset, slot_arr,
-                    self._put(jnp.asarray(0, jnp.int32)),
+
+    def _take_block(self, req: _Request) -> Optional[object]:
+        """Resolve the request's pending KVPageBlock, if any: one handed in
+        by the dispatcher (cross-replica migration) or one parked in the
+        spill tier at preemption. A tier entry that was LRU-evicted since
+        the preemption degrades here to the discard path — fold and
+        re-prefill, still token-exact via the stashed sampler rows."""
+        if req._block is not None:
+            block, req._block = req._block, None
+            return block
+        if not req.spilled:
+            return None
+        req.spilled = False
+        block = self.spill.take(req) if self.spill is not None else None
+        if block is None:
+            self._fold_history(req)
+            with self._admission_lock:
+                self.spill_fallbacks += 1
+        return block
+
+    def _import_block(self, req: _Request, slot: int, slot_arr, block) -> bool:
+        """Admission via page import: allocate the request's pages and
+        scatter the block's payload into them instead of re-prefilling,
+        then restore the sampler state the block carries — offset, PRNG
+        row, repetition window, and the pending last token — so the next
+        decode step emits exactly what the uninterrupted run would have.
+        Any failure (fault-injected ``cache.import``, corrupt block, pool
+        exhausted mid-import, geometry mismatch) releases what was claimed
+        and returns False: the caller falls back to normal re-prefill
+        admission, which can never double-emit because nothing was queued
+        to the consumer here."""
+        if not self.paged or self.draft is not None:
+            self._fold_history(req)
+            return False
+        page = self.engine.page_size
+        pages: list = []
+        try:
+            if block.page_size != page:
+                raise ValueError(
+                    f"block page_size {block.page_size} != pool page {page}"
                 )
+            data_pages = block.n_pages
+            need = max(self._need_pages(req, block=block), data_pages)
+            self._evict_for(need)
+            if len(self._free_pages) < need:
+                raise RuntimeError(
+                    f"target pool exhausted mid-import: need {need} pages, "
+                    f"{len(self._free_pages)} free"
+                )
+            pages = [self._free_pages.pop() for _ in range(need)]
+            for p in pages:
+                self._page_ref[p] = 1
+            self.cache = import_block(
+                self.cache, block, pages[:data_pages],
+                scatter=self._import_pages, put=self._put,
             )
+        except Exception as e:
+            logging.getLogger(__name__).debug(
+                "KV block import failed (falling back to re-prefill): %s", e
+            )
+            if pages:
+                self._pages_of[slot] = pages
+                self._release_pages(slot)
+            self._fold_history(req)
+            with self._admission_lock:
+                self.spill_fallbacks += 1
+            return False
+        self._pages_of[slot] = pages
+        self._write_table_row(slot, pages)
+        # offset = valid KV rows; the next decode step writes row n_tokens
+        self.cache = self.cache._replace(
+            offset=self._row_set(
+                self.cache.offset, slot_arr,
+                self._put(jnp.asarray(block.n_tokens, jnp.int32)),
+            )
+        )
+        self._write_sampler_row(req, slot_arr)
+        self.recent = self._row_set(
+            self.recent, slot_arr, self._put(jnp.asarray(block.resume_recent))
+        )
+        self.keys = self._row_set(
+            self.keys, slot_arr, self._put(jnp.asarray(block.resume_keys))
+        )
+        self.last_tok = self._set_last(
+            self.last_tok, slot_arr,
+            self._put(jnp.asarray(block.last_tok, jnp.int32)),
+        )
+        self.active = self._row_set(
+            self.active, slot_arr, self._put(jnp.asarray(True))
+        )
+        req.resume_keys = None
+        req.resume_recent = None
+        req.history = [int(t) for t in block.history]
         self._slots[slot] = req
         req.slot = slot
-        # prefill starts past the reused prefix — its KV is already mapped
-        req.prefill_pos = reused_tokens
+        req.prefill_pos = req.prompt.size
+        req.draft_pos = req.prompt.size
+        with self._admission_lock:
+            self.spill_hits += 1
+        return True
 
     @staticmethod
     def _chunk_at(prompt: np.ndarray, pos: int, c: int):
@@ -1036,8 +1303,11 @@ class ContinuousBatcher:
 
     def _emit(self, req: _Request, token: int, logprobs):
         req.produced += 1
-        if self.overcommit:
-            req.history.append(int(token))
+        # history is the tokens emitted since the last prompt fold — the
+        # overcommit preempt/resume bookkeeping, and (always, since drain
+        # can migrate any request) the payload a ResumeState ships so the
+        # target replica can continue this exact stream
+        req.history.append(int(token))
         # decode blocks emit TokenLogprobs summaries (or None); the first
         # token of a request still carries a lazy (1, V) device row from its
         # prefill sample — the server handles both forms
@@ -1129,15 +1399,73 @@ class ContinuousBatcher:
             )
         return self._decode_block_progs[want_lp]
 
+    def _fold_history(self, req: _Request):
+        """Legacy discard-preemption bookkeeping: fold the emitted tokens
+        into the prompt so resume re-prefills them (the recompute strategy —
+        the KV is gone). Clears any stale migration state; counts the
+        re-prefill work for the spill-vs-discard bench story."""
+        req.spilled = False
+        req._block = None
+        if req.history:
+            with self._admission_lock:
+                self.reprefill_tokens += req.prompt.size + len(req.history)
+            req.prompt = np.concatenate(
+                [req.prompt, np.asarray(req.history, np.int32)]
+            )
+            req.history = []
+            req._pkeys = None  # prompt changed: content keys are stale
+
+    def _spill_block(self, req: _Request) -> bool:
+        """Export ``req``'s KV page chain into the spill tier. Device-side
+        this only DISPATCHES a page gather (the jitted export program); the
+        blocking device→host copy happens on the tier's flusher thread, so
+        the tick never stalls on the transfer (MST106). Returns False —
+        caller falls back to discard — on any failure: tier disabled, over
+        budget, accounting drift, or an injected ``cache.export`` fault."""
+        if self.spill is None or not req.history:
+            return False
+        slot = req.slot
+        page = self.engine.page_size
+        # valid KV rows: the last emitted token's KV is unwritten (its id
+        # is last_tok / history[-1], fed as the next decode input)
+        n_tokens = req.prompt.size + max(0, len(req.history) - 1)
+        n_pages = -(-max(1, n_tokens) // page)
+        pages = self._pages_of.get(slot, [])[:n_pages]
+        ok = False
+        if len(pages) == n_pages:
+            try:
+                block = export_block(
+                    self.cache, pages, page_size=page, n_tokens=n_tokens,
+                    prompt=req.prompt, history=req.history,
+                    produced=req.produced, resume_keys=req.resume_keys,
+                    resume_recent=req.resume_recent,
+                    gather=self._export_pages, put=self._put,
+                )
+                ok = self.spill.put(req, block)
+            except Exception as e:
+                logging.getLogger(__name__).debug(
+                    "KV spill export failed for slot %d: %s", slot, e
+                )
+        req.spilled = ok
+        with self._admission_lock:
+            if ok:
+                self.spills += 1
+            else:
+                self.spill_fallbacks += 1
+        return ok
+
     def _preempt(self, req: _Request):
         """Evict an admitted request back to the head of the waiting line,
-        releasing its pages. Mid-decode, its emitted tokens fold into its
-        prompt (resume re-prefills them — the recompute strategy: the KV
-        pages are gone) and the device-side sampler state is stashed so the
-        next sampled token continues the exact PRNG/repetition chain.
+        releasing its pages. Mid-decode, its page chain is exported to the
+        spill tier when one is configured (resume re-imports it — one page
+        scatter instead of a re-prefill); otherwise, or on export failure,
+        its emitted tokens fold into its prompt and resume re-prefills them.
+        Either way the device-side sampler state is stashed so the next
+        sampled token continues the exact PRNG/repetition chain.
         Mid-prefill there is nothing to stash; the prefill restarts."""
         slot = req.slot
-        self.preemptions += 1
+        with self._admission_lock:
+            self.preemptions += 1
         if self._prefill_done(req):
             # one transfer for both sampler rows; runs only quiesced (no
             # in-flight block) in async mode, so this sync is off the
@@ -1145,12 +1473,8 @@ class ContinuousBatcher:
             keys_h, recent_h = jax.device_get((self.keys, self.recent))
             req.resume_keys = np.asarray(keys_h[slot])
             req.resume_recent = np.asarray(recent_h[slot])
-            if req.history:
-                req.prompt = np.concatenate(
-                    [req.prompt, np.asarray(req.history, np.int32)]
-                )
-                req.history = []
-                req._pkeys = None  # prompt changed: content keys are stale
+            if not self._spill_block(req):
+                self._fold_history(req)
         req._chain = None
         req._last_logits = None
         req.prefill_pos = 0
@@ -1165,6 +1489,146 @@ class ContinuousBatcher:
         # head of the waiting line: preemption goes newest-first, so
         # repeated inserts at 0 restore admission order among the victims
         self._waiting.insert(0, req)
+
+    def migrate_out(self, deadline: float = 30.0) -> int:
+        """Gracefully evacuate every request (replica drain): the scheduler
+        thread quiesces at its next tick and ends each stream with a
+        ``RequestMigratedError`` carrying a :class:`ResumeState` — a
+        host-materialized ``KVPageBlock`` when the page export succeeds,
+        otherwise prompt+history for a token-exact re-prefill elsewhere.
+        New submissions are rejected with ``ReplicaDrainingError`` from the
+        moment this is called; the flag is permanent (retirement), so the
+        caller should ``close()`` afterwards. Returns the number of
+        requests migrated before ``deadline`` expired; stragglers (e.g. a
+        wedged tick) keep migrating if the thread ever revives."""
+        with self._admission_lock:
+            base = self.migrations_out
+        with self._start_lock:
+            self._migrate_requested = True
+            t = self._thread
+        if t is None or not t.is_alive():
+            # never started (no requests yet) or already stopped: nothing
+            # admitted to migrate; the flag alone retires the batcher
+            return 0
+        # mst: allow(MST201): wake sentinel; Queue locks internally
+        self._submit.put(None)  # wake the idle wait
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline:
+            if not t.is_alive():
+                break
+            with self._admission_lock:
+                queued = self._submit.qsize() + len(self._waiting)
+            if queued == 0 and not any(r is not None for r in self._slots):
+                break
+            time.sleep(0.01)
+        with self._admission_lock:
+            return self.migrations_out - base
+
+    def _migrate_all_out(self):
+        """Scheduler-thread half of :meth:`migrate_out`. Runs quiesced (no
+        in-flight block), so the one sampler-state ``device_get`` and the
+        per-slot block exports are off the steady-state decode path — this
+        is a teardown, not a tick, which is why the host copies here are
+        synchronous rather than routed through the spill tier's flusher."""
+        admitted = [
+            (slot, req) for slot, req in enumerate(self._slots)
+            if req is not None
+        ]
+        keys_h = recent_h = None
+        if any(self._prefill_done(r) for _, r in admitted):
+            # one transfer for every slot's sampler rows (PRNG chain +
+            # repetition window) — what makes the resumed stream exact
+            keys_h, recent_h = jax.device_get((self.keys, self.recent))
+        for slot, req in admitted:
+            self._slots[slot] = None
+            req.slot = -1
+            if req.cancelled:
+                self._release_pages(slot)
+                self._drop_spill(req)
+                req.out.put(None)
+                continue
+            state = self._export_resume_state(req, slot, keys_h, recent_h)
+            self._release_pages(slot)
+            req.out.put(RequestMigratedError(state))
+            with self._admission_lock:
+                self.migrations_out += 1
+        if admitted:
+            self.active = self._zeros_like(self.active)
+        self._drain_submissions()
+        for req in self._waiting:
+            if req.cancelled:
+                self._drop_spill(req)
+                req.out.put(None)
+                continue
+            state = self._export_resume_state(req, -1, None, None)
+            req.out.put(RequestMigratedError(state))
+            with self._admission_lock:
+                self.migrations_out += 1
+        self._waiting.clear()
+
+    def _export_resume_state(self, req: _Request, slot: int,
+                             keys_h, recent_h) -> ResumeState:
+        """Build a request's portable :class:`ResumeState`. Admitted
+        mid-decode requests get their page chain exported and host-
+        materialized; a waiting request that was spill-preempted hands over
+        its tier block. Any export failure (injected ``cache.export``
+        fault, accounting drift, integrity error) degrades to a blockless
+        state — the target folds history into the prompt and re-prefills,
+        token-exact because the sampler rows still travel."""
+        if slot >= 0 and self._prefill_done(req) and keys_h is not None:
+            req.resume_keys = np.asarray(keys_h[slot])
+            req.resume_recent = np.asarray(recent_h[slot])
+        block = req._block  # un-imported block from a previous migration
+        req._block = None
+        if block is None and req.spilled:
+            req.spilled = False
+            block = self.spill.take(req) if self.spill is not None else None
+        if (block is None and slot >= 0 and self.paged
+                and self.draft is None and self._prefill_done(req)
+                and req.history):
+            page = self.engine.page_size
+            n_tokens = req.prompt.size + max(0, len(req.history) - 1)
+            n_pages = -(-max(1, n_tokens) // page)
+            pages = self._pages_of.get(slot, [])[:n_pages]
+            if len(pages) == n_pages:
+                try:
+                    block = export_block(
+                        self.cache, pages, page_size=page, n_tokens=n_tokens,
+                        prompt=req.prompt, history=req.history,
+                        produced=req.produced, resume_keys=req.resume_keys,
+                        resume_recent=req.resume_recent,
+                        gather=self._export_pages, put=self._put,
+                    )
+                except Exception as e:
+                    block = None
+                    with self._admission_lock:
+                        self.spill_fallbacks += 1
+                    logging.getLogger(__name__).debug(
+                        "drain export failed for slot %d: %s", slot, e
+                    )
+        if block is not None:
+            try:
+                block.to_host()  # the block must outlive this engine
+            except Exception as e:
+                block = None
+                with self._admission_lock:
+                    self.spill_fallbacks += 1
+                logging.getLogger(__name__).debug(
+                    "drain host copy failed for slot %d: %s", slot, e
+                )
+        return ResumeState(
+            prompt=np.asarray(req.prompt, np.int32),
+            history=[int(t) for t in req.history],
+            produced=req.produced,
+            block=block,
+            resume_keys=req.resume_keys,
+            resume_recent=req.resume_recent,
+        )
+
+    def _drop_spill(self, req: _Request):
+        req.spilled = False
+        if self.spill is not None:
+            self.spill.drop(req)
 
     def _grow_for_decode(self):
         """Over-commit page growth: before a decode block runs, every
@@ -1308,17 +1772,31 @@ class ContinuousBatcher:
         # broadcast the tick before the mirrored dispatch+harvest
         self._harvest(self._dispatch_block())
 
-    def _need_pages(self, req: _Request) -> int:
+    def _need_pages(self, req: _Request, block=None) -> int:
         """Pages to map at admission. Reserve mode (default) claims the whole
         prompt+max_tokens need up front; over-commit claims only the CURRENT
         need — prompt plus one decode block (capped by what's left to emit) —
-        and grows per block in _grow_for_decode."""
+        and grows per block in _grow_for_decode. A request resuming via a
+        KVPageBlock (``block``, or its entry still parked in the spill tier)
+        sizes from the block's KV rows instead of the prompt: at least the
+        block's own pages, plus decode headroom in the same mode."""
+        remaining = max(1, req.max_tokens - req.produced)
+        if block is None:
+            block = req._block
+        if block is None and req.spilled and self.spill is not None:
+            block = self.spill.peek(req)
+        if block is not None:
+            ahead = min(self._grow_ahead, remaining) if self.overcommit \
+                else remaining
+            return max(
+                block.n_pages,
+                -(-(block.n_tokens + ahead) // self.engine.page_size),
+            )
         if self.overcommit:
-            remaining = max(1, req.max_tokens - req.produced)
             return self._pages_needed(
                 req.prompt.size, min(self._grow_ahead, remaining)
             )
-        return self._pages_needed(req.prompt.size, req.max_tokens)
+        return self._pages_needed(req.prompt.size, remaining)
 
     def _spec_ok(self) -> bool:
         """A tick can take the speculative round iff no decoding slot wants
@@ -1333,7 +1811,10 @@ class ContinuousBatcher:
                 continue
             if req.want_logprobs:
                 return False
-            since = len(req.history) if self.overcommit else req.produced
+            # history counts tokens since the last prompt fold, so
+            # prompt + history is the slot's true KV frontier even for a
+            # resumed request whose ``produced`` spans an earlier replica
+            since = len(req.history)
             if req.prompt.size + max(0, since - 1) + K > ms:
                 return False
         return True
@@ -1393,7 +1874,22 @@ class ContinuousBatcher:
     def _fits(self, req: _Request) -> bool:
         if not self.paged:
             return True
+        if req.spilled and (self.spill is None or not self.spill.contains(req)):
+            # the tier evicted this block under budget pressure since the
+            # preemption: resolve to the discard path NOW so the page math
+            # below sizes the folded prompt, not a phantom block. (No race
+            # with _take_block: evictions only happen on this thread's own
+            # puts, never concurrently.)
+            req.spilled = False
+            self._fold_history(req)
+            with self._admission_lock:
+                self.spill_fallbacks += 1
         need = self._need_pages(req)
+        if req._block is not None or req.spilled:
+            # block import allocates its whole need fresh (no page sharing
+            # with the prefix index), so the chain doesn't discount it
+            req._chain = None
+            return need <= len(self._free_pages) + self._evictable_pages()
         chain = self._prefix_lookup(req)
         # the chain's own pages must not double as eviction fodder: they're
         # about to be mapped, so only OTHER cached pages can be reclaimed
@@ -1433,6 +1929,7 @@ class ContinuousBatcher:
         # otherwise shadow a cancelled request behind it forever
         for req in [r for r in self._waiting if r.cancelled]:
             self._waiting.remove(req)
+            self._drop_spill(req)  # its tier block frees with the stream
             req.out.put(None)
         while None in self._slots and self._waiting:
             pick = None
@@ -1513,7 +2010,15 @@ class ContinuousBatcher:
         with it. Admission prefill, growth that could preempt, and the
         idle path quiesce the pipeline first (one-block drain), then the
         double-buffering resumes on the next tick."""
-        inject("scheduler.tick")  # fault harness: wedge/delay/fail a tick
+        inject("scheduler.tick", engine=id(self))  # fault harness: wedge/delay/fail a tick (match engine= to target one batcher)
+        if self._migrate_requested:
+            # drain: finish the in-flight block, then end every stream with
+            # its ResumeState; the idle wait keeps the loop from spinning
+            # while the dispatcher re-places the migrated requests
+            self._quiesce()
+            self._migrate_all_out()
+            self._drain_submissions(block=True)
+            return
         self._reap_cancelled()
         self._drain_submissions()
         if (self._waiting and None in self._slots) or any(
@@ -1563,7 +2068,12 @@ class ContinuousBatcher:
         latency for long prompts trades against decode jitter bounded at
         one chunk per block. With nothing decoding, all admitting requests
         advance at full rate."""
-        inject("scheduler.tick")  # fault harness: wedge/delay/fail a tick
+        inject("scheduler.tick", engine=id(self))  # fault harness: wedge/delay/fail a tick (match engine= to target one batcher)
+        if self._migrate_requested:
+            self._quiesce()  # no-op in sync mode (nothing in flight)
+            self._migrate_all_out()
+            self._drain_submissions(block=True)
+            return
         self._reap_cancelled()
         self._drain_submissions()
         self._admit_waiting()
@@ -1608,6 +2118,10 @@ class ContinuousBatcher:
             self._page_ref.clear()
             self._prefix_index.clear()
             self._free_pages = list(range(self.engine.pool_pages - 1, -1, -1))
+        if self.spill is not None:
+            # spilled blocks reference requests whose streams just died;
+            # host DRAM back to the budget
+            self.spill.clear()
         for req in self._waiting:
             req.out.put(exc)
         self._waiting.clear()

@@ -121,6 +121,7 @@ class ModelProvider:
         kv_dtype: Optional[str] = None,
         admission_policy: str = "fifo",
         overcommit: bool = False,
+        spill_bytes: Optional[int] = None,
         draft_model: Optional[str] = None,
         spec_k: int = 4,
         prompt_cache: bool = False,
@@ -162,6 +163,10 @@ class ModelProvider:
         self.kv_dtype = kv_dtype
         self.admission_policy = admission_policy
         self.overcommit = overcommit
+        # host-DRAM spill tier for preempted requests' KV page blocks
+        # (kv_transfer.KVSpillTier): resume re-imports instead of
+        # re-prefilling; None = legacy discard preemption
+        self.spill_bytes = spill_bytes
         self.default_model = default_model
         self.start_layer = start_layer
         self.end_layer = end_layer
@@ -327,6 +332,7 @@ class ModelProvider:
                                 policy=self.admission_policy,
                                 prefix_cache=self.prefix_cache_enabled,
                                 overcommit=self.overcommit,
+                                spill_bytes=self.spill_bytes,
                                 draft_engine=draft_eng,
                                 spec_k=self.spec_k,
                                 max_queue=self.max_queue,
@@ -548,7 +554,7 @@ class APIHandler(BaseHTTPRequestHandler):
             # next request line)
         except OSError:
             return self._error(400, "unreadable request body")
-        if route not in handlers:
+        if route not in handlers and route != "/admin/drain":
             return self._error(404, f"unknown route {route}")
         if self.api_key:
             # the reference UI sends Authorization: Bearer <key>
@@ -572,6 +578,10 @@ class APIHandler(BaseHTTPRequestHandler):
             body = json.loads(raw or b"{}")
         except json.JSONDecodeError:
             return self._error(400, "invalid JSON body")
+        if route == "/admin/drain":
+            # operator surface, not a generation request: no sampler params
+            # to validate and no model hot-swap — but it IS key-gated above
+            return self._handle_drain(body)
         try:
             params = self._validate_params(body)
         except ValueError as e:
@@ -615,6 +625,37 @@ class APIHandler(BaseHTTPRequestHandler):
                 self._error(500, f"{type(e).__name__}: {e}")
             except Exception:
                 pass
+
+    def _handle_drain(self, body: dict):
+        """POST /admin/drain ``{"replica": i, "deadline": s}`` — gracefully
+        retire one replica. Its admitted requests migrate to the remaining
+        replicas (their clients' streams continue seamlessly) and /health
+        reports ``draining`` for the duration. 400 without --replicas
+        serving; a mid-migration failure leaves the replica quarantined
+        (500, retryable) with nothing dropped."""
+        gen = self.provider.generator
+        drain = getattr(gen, "drain", None)
+        if drain is None:
+            return self._error(400, "drain requires --replicas serving "
+                                    "(a ReplicaSet generator)")
+        if "replica" not in body:
+            return self._error(400, "missing 'replica' index")
+        try:
+            replica = int(body["replica"])
+            deadline = float(body.get("deadline", 30.0))
+            if deadline <= 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            return self._error(400, "'replica' must be an integer and "
+                                    "'deadline' a positive number of seconds")
+        try:
+            result = drain(replica, deadline=deadline)
+        except ValueError as e:
+            return self._error(400, str(e))
+        except Exception as e:
+            logger.exception("replica drain failed")
+            return self._error(500, f"{type(e).__name__}: {e}")
+        return self._json(200, result)
 
     # ---------------------------------------------------------- validation
     def _validate_params(self, body: dict) -> dict:
@@ -1131,6 +1172,13 @@ def main(argv=None):
                              "on pool exhaustion (token-exact resume) — "
                              "higher slot occupancy than reserving every "
                              "request's full prompt+max_tokens need")
+    parser.add_argument("--spill-bytes", type=int, default=None,
+                        help="with --overcommit: host-DRAM budget (bytes) "
+                             "for spilled KV page blocks. Preemption exports "
+                             "the victim's pages to host memory and resume "
+                             "re-imports them — one page scatter instead of "
+                             "a full re-prefill; LRU-evicted past the "
+                             "budget, falling back to re-prefill")
     parser.add_argument("--draft-model", default=None,
                         help="speculative decoding: a small draft model "
                              "proposes --spec-k tokens per round (greedy "
@@ -1289,10 +1337,27 @@ def main(argv=None):
     if args.overcommit and not args.paged_pool:
         parser.error("--overcommit requires --paged-pool")
     if args.overcommit and args.coordinator and (args.num_processes or 1) > 1:
-        # preemption stashes device sampler rows host-side (device_get) and
-        # rewrites table rows outside the mirrored multihost op stream;
-        # workers would desync — reserve admission only across hosts
-        parser.error("--overcommit is not supported in multi-host serving")
+        # the sampler-state stash is no longer the blocker (it travels in
+        # KVPageBlock / ResumeState now); what remains is that preemption
+        # and resume rewrite page tables and free lists host-side, outside
+        # the op stream the worker ranks mirror — their page accounting
+        # would silently diverge from rank 0's
+        parser.error(
+            "--overcommit is not supported in multi-host serving: "
+            "preemption/resume rewrites page tables and free lists "
+            "host-side, outside the op stream worker ranks mirror; run "
+            "overcommit on single-host replicas (e.g. behind --replicas) "
+            "instead"
+        )
+    if args.spill_bytes is not None:
+        if args.spill_bytes < 1:
+            parser.error("--spill-bytes must be a positive byte count")
+        if not args.overcommit:
+            parser.error("--spill-bytes requires --overcommit: the spill "
+                         "tier holds preempted requests' KV page blocks")
+        if args.draft_model:
+            parser.error("--spill-bytes is incompatible with --draft-model "
+                         "(speculative slots re-prefill on preemption)")
     if args.max_queue is not None:
         if args.max_queue < 1:
             parser.error("--max-queue must be a positive integer")
@@ -1329,6 +1394,7 @@ def main(argv=None):
         kv_dtype=args.kv_dtype,
         admission_policy=args.admission_policy,
         overcommit=args.overcommit,
+        spill_bytes=args.spill_bytes,
         draft_model=args.draft_model, spec_k=args.spec_k,
         prompt_cache=args.prompt_cache, replicas=args.replicas,
         max_queue=args.max_queue,

@@ -11,7 +11,8 @@ delay, a gate (block until released), or an exception.
 Sites wired into the serving stack:
 
 - ``scheduler.tick``      — top of every ContinuousBatcher scheduler tick
-  (arm a gate/delay here to wedge the engine mid-generation)
+  (arm a gate/delay here to wedge the engine mid-generation); ctx
+  ``engine=id(batcher)`` (match it to target one batcher among several)
 - ``scheduler.harvest``   — the harvest boundary of a dispatched decode
   block, just before THE tick sync (kill the in-flight block here to test
   that the async pipeline sheds cleanly: no wedged slots, pages returned)
@@ -21,6 +22,13 @@ Sites wired into the serving stack:
   :class:`DropExchange` to simulate a peer that never arrives)
 - ``server.sse_write``    — every SSE chunk write in the HTTP layer (raise
   ``BrokenPipeError`` to kill a stream mid-generation)
+- ``cache.export``        — top of every KV page-block export (preemption
+  spill / drain migration; raise here to force the blockless fallback)
+- ``cache.import``        — top of every KV page-block import at resume
+  (raise here to force a re-prefill instead of a block re-import)
+- ``replica.drain``       — entry of ``ReplicaSet.drain(i)``, after the
+  replica is marked draining; ctx ``replica=<i>`` (kill a drain
+  mid-migration to test the quarantine-and-retry path)
 
 Programmatic use (the fault-injection test suite)::
 

@@ -149,6 +149,44 @@ class ServingMetrics:
                         "# TYPE mst_preemptions_total counter",
                         f"mst_preemptions_total {b.preemptions}",
                     ]
+                spill = getattr(b, "spill_stats", lambda: None)()
+                if spill is not None:
+                    # KV migration story: how often memory pressure / drain
+                    # moved page blocks instead of discarding them, and how
+                    # much host DRAM the spill tier is holding
+                    lines += [
+                        "# TYPE mst_kv_spill_enabled gauge",
+                        f"mst_kv_spill_enabled {int(bool(spill['enabled']))}",
+                        "# TYPE mst_kv_spill_total counter",
+                        f"mst_kv_spill_total {spill['spills']}",
+                        "# TYPE mst_kv_spill_hits_total counter",
+                        f"mst_kv_spill_hits_total {spill['spill_hits']}",
+                        "# TYPE mst_kv_spill_fallbacks_total counter",
+                        f"mst_kv_spill_fallbacks_total "
+                        f"{spill['spill_fallbacks']}",
+                        "# TYPE mst_kv_spill_evictions_total counter",
+                        f"mst_kv_spill_evictions_total {spill['evictions']}",
+                        "# TYPE mst_kv_spill_bytes gauge",
+                        f"mst_kv_spill_bytes {spill['bytes_in_use']}",
+                        "# TYPE mst_kv_spill_budget_bytes gauge",
+                        f"mst_kv_spill_budget_bytes {spill['budget_bytes']}",
+                        "# TYPE mst_kv_migration_out_total counter",
+                        f"mst_kv_migration_out_total "
+                        f"{spill['migrations_out']}",
+                        "# TYPE mst_kv_migration_in_total counter",
+                        f"mst_kv_migration_in_total {spill['migrations_in']}",
+                        "# TYPE mst_kv_reprefill_tokens_total counter",
+                        f"mst_kv_reprefill_tokens_total "
+                        f"{spill['reprefill_tokens']}",
+                    ]
+                    if "migrated_streams" in spill:
+                        # ReplicaSet-level: streams re-placed across
+                        # replicas after a drain or mid-stream crash
+                        lines += [
+                            "# TYPE mst_kv_migration_streams_total counter",
+                            f"mst_kv_migration_streams_total "
+                            f"{spill['migrated_streams']}",
+                        ]
                 kv = getattr(b, "kv_read_stats", lambda: None)()
                 if kv is not None:
                     path, last_tick, total_bytes = kv

@@ -1,0 +1,12 @@
+"""MST106: an exported KV page block pulled synchronously inside a
+tick-hot function — the device→host copy belongs on the spill tier's
+flusher thread, not the tick."""
+import jax
+
+
+# mst: hot-path
+def preempt_in_tick(cache, pages, tier):
+    blk = export_block(cache, pages)
+    # mst: allow(MST102): the sync under test here is MST106's block pull
+    host = jax.device_get(blk)
+    tier.put(host)
