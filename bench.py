@@ -736,6 +736,179 @@ def measure_replica_drain(model, params, label: str) -> dict:
         rs.close()
 
 
+def measure_fleet_elasticity(model, params, label: str) -> dict:
+    """Elastic-fleet evidence (ISSUE 7). Phase 1: skewed load (one replica
+    carries a long background stream) over a 2-replica fleet — p99 queue
+    wait (TTFT) under blind round-robin placement vs the ReplicaSet's
+    score routing. Phase 2: a request storm while the autoscaler runs with
+    an injected spawn failure (degrades to the static fleet), a killed
+    dispatch on replica 0 (the request re-places), a real scale-up onto a
+    spare device, and a scale-down drain once the storm ends. The contract
+    throughout: zero dropped streams, autoscale events recorded."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.fleet import FleetAutoscaler
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.replicas import ReplicaSet
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+    from mlx_sharding_tpu.testing import faults
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return dict(label=label, skipped="needs 2 devices")
+
+    def build(i):
+        # wrap so the spawned 3rd replica still lands somewhere on a
+        # 2-device host (sharing a device is fine: this phase measures
+        # control-plane behaviour, not per-replica throughput)
+        i = i % len(devices)
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1, devices=devices[i : i + 1]),
+            microbatches=2, max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16,
+            prefill_chunk=128, pool_pages=8, page_size=128,
+        )
+        return ContinuousBatcher(eng, decode_block=8)
+
+    vocab = model.config.vocab_size
+    prompt = [
+        int(x) for x in
+        np.random.default_rng(11).integers(1, vocab - 64, 16)
+    ]
+
+    def run_jobs(dispatch, n):
+        """n concurrent short streams; returns (ttfts, errors)."""
+        ttfts, errs = [], []
+        lock = threading.Lock()
+
+        def one(k):
+            t0 = time.perf_counter()
+            try:
+                first = True
+                for _ in dispatch(k):
+                    if first:
+                        first = False
+                        with lock:
+                            ttfts.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                with lock:
+                    errs.append(repr(e)[:200])
+
+        threads = [threading.Thread(target=one, args=(k,)) for k in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        return ttfts, errs
+
+    def p99(xs):
+        return round(float(np.percentile(xs, 99)), 3) if xs else None
+
+    reps = [build(0), build(1)]
+    rs = ReplicaSet(reps)
+    result = dict(label=label)
+    try:
+        for r in reps:  # compile both replicas' programs off the clock
+            for _ in r.generate_step(prompt, max_tokens=4):
+                pass
+
+        # ---- phase 1: skewed load, round-robin vs score routing --------
+        def skewed(dispatch):
+            bg_done = threading.Event()
+
+            def background():
+                for _ in reps[0].generate_step(prompt, max_tokens=96):
+                    pass
+                bg_done.set()
+
+            bg = threading.Thread(target=background)
+            bg.start()
+            out = run_jobs(dispatch, n=10)
+            bg.join(timeout=180)
+            return out
+
+        rr_ttfts, rr_errs = skewed(
+            lambda k: reps[k % 2].generate_step(prompt, max_tokens=8)
+        )
+        routed_ttfts, routed_errs = skewed(
+            lambda k: rs.generate_step(prompt, max_tokens=8)
+        )
+        result["routing"] = dict(
+            round_robin_p99_wait_s=p99(rr_ttfts),
+            score_routed_p99_wait_s=p99(routed_ttfts),
+            affinity_hits=rs.route_affinity_hits,
+            dropped_streams=len(rr_errs) + len(routed_errs),
+        )
+
+        # ---- phase 2: storm + spawn failure + kill + scale-down --------
+        spawn_calls = {"n": 0}
+
+        def factory():
+            spawn_calls["n"] += 1
+            return build(2)
+
+        # min_replicas=2: a mid-storm dispatch kill needs a live peer to
+        # re-place onto; scale_down_sustain_s > 0 keeps momentary lulls
+        # between job waves from draining the fleet out from under the storm
+        ctrl = FleetAutoscaler(
+            rs, factory, min_replicas=2, max_replicas=3,
+            scale_up_pressure=0.5, scale_up_sustain_s=0.0,
+            scale_down_pressure=0.05, scale_down_sustain_s=0.3,
+            cooldown_s=0.0, drain_deadline_s=30.0,
+        )
+        faults.arm("replica.spawn", exc=RuntimeError, times=1)
+        faults.arm("replica.dispatch", exc=RuntimeError, times=1,
+                   match={"replica": 0})
+        storm = {"ttfts": [], "errs": []}
+        done = threading.Event()
+
+        def run_storm():
+            t, e = run_jobs(
+                lambda k: rs.generate_step(prompt, max_tokens=8), n=8
+            )
+            storm["ttfts"], storm["errs"] = t, e
+            done.set()
+
+        th = threading.Thread(target=run_storm)
+        th.start()
+        while not done.is_set():
+            ctrl.tick()
+            done.wait(0.05)
+        th.join(timeout=180)
+        for _ in range(8):  # idle ticks past the sustain window: the
+            ctrl.tick()     # scale-down side of the loop drains 3 -> 2
+            time.sleep(0.1)
+        ev = rs.fleet_stats()["autoscale_events"]
+        result["elasticity"] = dict(
+            spawn_failures=ev.get("spawn_failed", 0),
+            spawns=ev.get("spawn", 0),
+            drains=ev.get("drain", 0),
+            events=dict(ev),
+            fleet_size=rs.fleet_stats()["size"],
+            p99_wait_s=p99(storm["ttfts"]),
+            dropped_streams=len(storm["errs"]),
+            errors=storm["errs"],
+        )
+        result["zero_dropped_streams"] = (
+            result["routing"]["dropped_streams"] == 0
+            and not storm["errs"]
+        )
+        log(f"[{label}] rr_p99={result['routing']['round_robin_p99_wait_s']}s "
+            f"routed_p99={result['routing']['score_routed_p99_wait_s']}s | "
+            f"spawn_failed={result['elasticity']['spawn_failures']} "
+            f"spawned={result['elasticity']['spawns']} "
+            f"drained={result['elasticity']['drains']} "
+            f"dropped={result['elasticity']['dropped_streams']}")
+        return result
+    finally:
+        faults.disarm()
+        rs.close()
+
+
 def measure_paged_ragged_vs_gather(model, params, label: str) -> dict:
     """The ragged paged-attention A/B (ISSUE 1 tentpole): mixed-length
     continuous batching decode through the same page pool on both paths.
@@ -1367,6 +1540,13 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 detail["replica_drain_cpu"] = dict(error=repr(e)[:300])
                 log(f"[replica_drain_cpu] FAILED: {e!r}")
+            try:
+                detail["fleet_elasticity_cpu"] = measure_fleet_elasticity(
+                    m2, p2, "fleet_elasticity_cpu"
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["fleet_elasticity_cpu"] = dict(error=repr(e)[:300])
+                log(f"[fleet_elasticity_cpu] FAILED: {e!r}")
             # the 0.28B fallback model, not tiny2: the A/B needs decode
             # blocks whose device time is non-trivial next to the host work,
             # or there is nothing for the async loop to overlap
@@ -1566,6 +1746,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["kv_int8_vs_bf16"] = dict(error=repr(e)[:300])
             log(f"[kv_int8_vs_bf16] FAILED: {e!r}")
+        gc.collect()
+        try:
+            detail["fleet_elasticity"] = measure_fleet_elasticity(
+                model, params, "fleet_elasticity"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["fleet_elasticity"] = dict(error=repr(e)[:300])
+            log(f"[fleet_elasticity] FAILED: {e!r}")
 
         # HEADLINE (BASELINE.json primary config): DeepSeek-Coder-V2-Lite at
         # its real architecture and scale — 27 layers, 64-expert MoE + 2

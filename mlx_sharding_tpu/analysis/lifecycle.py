@@ -41,6 +41,9 @@ KNOWN_FAULT_SITES = {
     # KV migration (kv_transfer.py): block export at preemption/drain,
     # block import at resume, and the replica drain entry point
     "cache.export", "cache.import", "replica.drain",
+    # elastic fleet (fleet.py): autoscaler control tick and the
+    # ReplicaFactory spawn call — both must degrade to the static fleet
+    "autoscaler.tick", "replica.spawn",
 }
 # basename -> the inject() site that file must keep calling
 REQUIRED_FAULT_SITES = {
@@ -48,6 +51,7 @@ REQUIRED_FAULT_SITES = {
     "replicas.py": "replica.dispatch",
     "multihost.py": "multihost.exchange",
     "openai_api.py": "server.sse_write",
+    "fleet.py": "autoscaler.tick",
     "kv_transfer.py": "cache.export",
 }
 

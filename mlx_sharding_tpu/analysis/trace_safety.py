@@ -45,6 +45,14 @@
   blocking copy to the spill tier's flusher thread (see
   ``kv_transfer.KVSpillTier``). An MST102 suppression on the same call does
   NOT cover this rule — a full-block pull needs its own justification.
+- **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
+  timeout arithmetic (an expression whose identifiers mention deadline /
+  timeout / expiry / until / budget / ttft / retry_after / lease). The wall
+  clock steps and slews under NTP; a deadline computed from it can fire
+  years early or never. Every serving deadline — request_timeout, TTFT,
+  breaker half-open ETA, autoscaler cooldown, lease expiry — must be a
+  ``time.monotonic()`` difference. Timestamps for humans (log lines, the
+  OpenAI ``created`` field) are fine: they carry no deadline identifiers.
 """
 
 from __future__ import annotations
@@ -474,6 +482,57 @@ def _check_recompile_hazards(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+# MST107: the wall clock spellings that must never feed a deadline, and the
+# identifier fragments that mark an expression as deadline/timeout math
+WALL_CLOCK_CALLS = {"time.time", "_time.time"}
+DEADLINE_HINTS = (
+    "deadline", "timeout", "expires", "expiry", "expire", "until",
+    "budget", "retry_after", "ttft", "lease",
+)
+
+
+def _check_wall_clock_deadlines(mod: ModuleInfo) -> list[Finding]:
+    # context = the smallest statement (or branch condition) around the
+    # call; if any identifier in it smells like a deadline, the wall clock
+    # is feeding timeout arithmetic
+    contexts: list[ast.AST] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                             ast.Return, ast.Expr, ast.Assert, ast.Raise)):
+            contexts.append(node)
+        elif isinstance(node, (ast.While, ast.If)):
+            contexts.append(node.test)
+    findings = []
+    seen: set[tuple[int, int]] = set()
+    for ctx in contexts:
+        calls = [n for n in ast.walk(ctx)
+                 if isinstance(n, ast.Call)
+                 and dotted_name(n.func) in WALL_CLOCK_CALLS]
+        if not calls:
+            continue
+        idents: set[str] = set()
+        for n in ast.walk(ctx):
+            if isinstance(n, ast.Name):
+                idents.add(n.id.lower())
+            elif isinstance(n, ast.Attribute):
+                idents.add(n.attr.lower())
+        idents -= {"time", "_time"}  # the call itself is not evidence
+        if not any(h in ident for ident in idents for h in DEADLINE_HINTS):
+            continue
+        for call in calls:
+            key = (call.lineno, call.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "MST107", mod.display_path, call.lineno, call.col_offset,
+                "time.time() feeding deadline/timeout arithmetic — the "
+                "wall clock steps/slews under NTP, so the deadline can "
+                "fire early or never; use time.monotonic()",
+                context=qualname_for_line(mod.tree, call.lineno)))
+    return findings
+
+
 def check_module(mod: ModuleInfo) -> list[Finding]:
     table = _collect_functions(mod.tree)
     traced = _traced_closure(_traced_roots(mod.tree, table), table)
@@ -483,4 +542,5 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_sync_spill(mod)
     findings += _check_recompile_hazards(mod)
     findings += _check_dense_dequant(mod, table)
+    findings += _check_wall_clock_deadlines(mod)
     return findings

@@ -3,11 +3,29 @@
 The mesh axis story (parallel/mesh.py) gives dp to the training step; this
 module gives it to SERVING — `--replicas R` builds R fully independent
 engines (each a PipelineEngine [+ ContinuousBatcher] on its own slice of
-``jax.devices()``) and routes each request to the least-loaded replica.
+``jax.devices()``) and routes each request to the best-scored replica.
 Replication multiplies aggregate throughput by R at identical per-request
 latency, the standard inference-serving dp recipe; the reference's topology
 has no equivalent (one gRPC chain serves one request at a time,
 ref: shard/openai_api.py:543-563).
+
+Routing score: a replica's load is ``inflight + queue_depth`` (the queue
+depth comes from its batcher's own admission stats). Two placement signals
+may override pure least-loaded, both behind a load-imbalance escape hatch
+(``route_imbalance``): session stickiness (``_session`` request key → the
+replica that served the session last, keeping its KV/prompt-cache warm) and
+prefix-cache affinity (chained page digests of the prompt → the replica
+whose prompt cache holds the longest prefix, so the 4.57× warm-TTFT win
+survives multi-replica placement). Requests with a tight TTFT budget drop
+the escape hatch to zero — no deadline-headroom, no affinity detour.
+
+Elasticity: the fleet can grow at runtime — ``add_replica()`` appends a
+freshly spawned replica (indices are stable; retired slots keep their
+position) and ``drain()`` retires one with zero dropped streams. The
+decision loop that calls them under queue pressure lives in ``fleet.py``
+(FleetAutoscaler + BrownoutController); this module only provides the
+mechanisms plus the ``autoscale_events`` / ``replica_stats()`` /
+``fleet_stats()`` surfaces that /metrics and /health report.
 
 Each replica holds its own copy of the weights (device_put onto its own
 mesh by PipelineEngine) and its own KV state. Requests route once and
@@ -35,8 +53,10 @@ set keeps serving and ``health()`` reports degraded, not dead.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import time
+from collections import OrderedDict
 from typing import Optional
 
 from mlx_sharding_tpu.analysis.runtime import make_lock
@@ -58,16 +78,22 @@ class _ResumeUnsupported(Exception):
 class ReplicaSet:
     """``generate_step`` dispatcher over independent replica generators.
 
-    Routing: least in-flight requests, ties to the lowest index — a
-    deterministic, state-light policy (no cross-replica queues; a replica's
-    own ContinuousBatcher provides intra-replica queueing when built with
-    ``--concurrent``). Circuit-broken replicas are skipped; a half-open
-    replica receives at most one probe request at a time."""
+    Routing: lowest ``inflight + queue_depth`` score, ties to the lowest
+    index — deterministic and state-light (no cross-replica queues; a
+    replica's own ContinuousBatcher provides intra-replica queueing when
+    built with ``--concurrent``). Session stickiness and prefix-cache
+    affinity may override the score within ``route_imbalance`` load units,
+    except for tight-TTFT requests (see module docstring). Circuit-broken
+    replicas are skipped; a half-open replica receives at most one probe
+    request at a time."""
 
     concurrent = True  # the server must not serialize requests around us
+    supports_sessions = True  # the server may forward a _session key
 
     def __init__(self, replicas: list, *, breaker_threshold: int = 3,
-                 probe_interval: float = 5.0, resume_streams: bool = True):
+                 probe_interval: float = 5.0, resume_streams: bool = True,
+                 route_imbalance: int = 4, affinity_page: int = 128,
+                 tight_ttft_s: float = 10.0):
         if not replicas:
             raise ValueError("ReplicaSet needs at least one replica")
         if breaker_threshold < 1:
@@ -108,14 +134,40 @@ class ReplicaSet:
             else make_lock("ReplicaSet._serial_locks[*]")
             for r in self.replicas
         ]
+        # ---------------------------------------- load-aware routing state
+        if route_imbalance < 0:
+            raise ValueError("route_imbalance must be >= 0")
+        if affinity_page < 1:
+            raise ValueError("affinity_page must be >= 1")
+        self.route_imbalance = route_imbalance
+        self.affinity_page = affinity_page
+        self.tight_ttft_s = tight_ttft_s
+        # chained prompt-chunk digest -> replica index that last served it
+        # (mirrors the batcher's prefix-cache page chaining, so a hit here
+        # means that replica's prompt cache plausibly holds the prefix)
+        self._affinity: OrderedDict = OrderedDict()
+        self._affinity_cap = 8192
+        # session key -> replica index that served the session last
+        self._sticky: OrderedDict = OrderedDict()
+        self._sticky_cap = 4096
+        self.route_affinity_hits = 0
+        self.route_sticky_hits = 0
+        # ------------------------------------------------- elastic fleet
+        # autoscale event counters, written by the fleet controller via
+        # record_autoscale_event (kind -> count; /metrics renders them)
+        self.autoscale_events: dict = {}
+        # FleetAutoscaler / BrownoutController attach themselves here so
+        # health() can surface them and close() can stop the loop
+        self.brownout = None
+        self._controller = None
 
     @property
     def supports_deadlines(self) -> bool:
         """Deadline kwargs can be forwarded only when every replica
         understands them (mixed sets would crash on the plain engines)."""
-        return all(
-            getattr(r, "supports_deadlines", False) for r in self.replicas
-        )
+        with self._lock:
+            reps = list(self.replicas)
+        return all(getattr(r, "supports_deadlines", False) for r in reps)
 
     # ------------------------------------------------------------- routing
     def _breaker_state(self, j: int, now: float) -> str:
@@ -123,10 +175,100 @@ class ReplicaSet:
             return "closed"
         return "half_open" if now >= self._open_until[j] else "open"
 
-    def _pick(self, exclude=()) -> tuple[int, bool]:
+    def _affinity_chunks(self, prompt) -> list:
+        """Chained digests over fixed ``affinity_page``-token chunks of the
+        prompt, mirroring the prefix-cache page chaining: matching the
+        first k digests means sharing a k-page prefix. Non-int prompts (or
+        prompts shorter than one page) contribute no affinity signal."""
+        try:
+            toks = [int(t) for t in list(prompt)[: self.affinity_page * 32]]
+        except (TypeError, ValueError):
+            return []
+        page = self.affinity_page
+        n = len(toks) // page
+        keys, h = [], b""
+        for c in range(n):
+            m = hashlib.blake2b(h, digest_size=16)
+            m.update(",".join(map(str, toks[c * page:(c + 1) * page])).encode())
+            h = m.digest()
+            keys.append(h)
+        return keys
+
+    def _queue_depths(self) -> list:
+        """Per-replica queue-depth snapshot for routing, gathered OUTSIDE
+        ``_lock``: a replica's stats() takes its own admission lock, and we
+        must not order ours ahead of it. Racy by a tick — gauge-grade is
+        all a routing hint needs."""
+        with self._lock:
+            reps = list(self.replicas)
+            retired = list(self._retired)
+        out = []
+        for j, r in enumerate(reps):
+            q = 0
+            if not retired[j] and hasattr(r, "stats"):
+                try:
+                    _, _, q = r.stats()
+                except Exception:  # noqa: BLE001 — a sick replica scores 0
+                    q = 0
+            out.append(q)
+        return out
+
+    def _route(self, closed: list, depths: list, chunks: list,
+               session, tight: bool) -> int:
+        """Pick from the closed-breaker candidates (``_lock`` held).
+        Stickiness, then affinity, may override least-loaded — but only
+        within ``route_imbalance`` load units of the best candidate, and
+        never for tight-TTFT requests (their deadline headroom can't absorb
+        a deeper queue)."""
+        def load(j):
+            return self._inflight[j] + (depths[j] if j < len(depths) else 0)
+
+        base = min(load(j) for j in closed)
+        tol = 0 if tight else self.route_imbalance
+        if session is not None:
+            s = self._sticky.get(session)
+            if s in closed and load(s) - base <= tol:
+                self.route_sticky_hits += 1
+                return s
+        if chunks:
+            best, best_n = None, 0
+            for j in closed:
+                if load(j) - base > tol:
+                    continue
+                n = 0
+                for k in chunks:
+                    if self._affinity.get(k) != j:
+                        break
+                    n += 1
+                if n > best_n:
+                    best, best_n = j, n
+            if best is not None:
+                self.route_affinity_hits += 1
+                return best
+        return min(closed, key=lambda j: (load(j), j))
+
+    def _remember_route(self, i: int, chunks: list, session):
+        """Record the placement (``_lock`` held) so the NEXT request with
+        this session/prefix lands on the same warm replica."""
+        if session is not None:
+            self._sticky[session] = i
+            self._sticky.move_to_end(session)
+            while len(self._sticky) > self._sticky_cap:
+                self._sticky.popitem(last=False)
+        for k in chunks:
+            self._affinity[k] = i
+            self._affinity.move_to_end(k)
+        while len(self._affinity) > self._affinity_cap:
+            self._affinity.popitem(last=False)
+
+    def _pick(self, exclude=(), *, prompt=None, session=None,
+              tight: bool = False) -> tuple[int, bool]:
+        chunks = self._affinity_chunks(prompt) if prompt is not None else []
+        depths = self._queue_depths()
         with self._lock:
             now = time.monotonic()
             closed, half_open = [], []
+            retry_eta = None  # earliest half-open retry among open breakers
             for j in range(len(self.replicas)):
                 if j in exclude or self._draining[j] or self._retired[j]:
                     continue
@@ -135,6 +277,12 @@ class ReplicaSet:
                     closed.append(j)
                 elif state == "half_open" and not self._probing[j]:
                     half_open.append(j)
+                elif state == "half_open":
+                    # a probe is in flight — its verdict lands imminently
+                    retry_eta = 0.0 if retry_eta is None else retry_eta
+                else:
+                    eta = self._open_until[j] - now
+                    retry_eta = eta if retry_eta is None else min(retry_eta, eta)
             probe = False
             if half_open:
                 # recovery beats load balance: route this request as the
@@ -143,11 +291,13 @@ class ReplicaSet:
                 self._probing[i] = True
                 probe = True
             elif closed:
-                i = min(closed, key=lambda j: self._inflight[j])
+                i = self._route(closed, depths, chunks, session, tight)
+                self._remember_route(i, chunks, session)
             else:
                 raise ReplicasUnavailableError(
                     "no replica available: every replica is circuit-broken "
-                    "or already failed this request"
+                    "or already failed this request",
+                    retry_after_s=retry_eta,
                 )
             self._inflight[i] += 1
             self.served[i] += 1
@@ -195,6 +345,15 @@ class ReplicaSet:
             return False
 
     def generate_step(self, prompt_tokens, **kw):
+        # routing hints: session key (popped — replicas don't see it) and
+        # deadline headroom (a tight TTFT budget disables warm-placement
+        # detours — the request can't afford a deeper queue)
+        session = kw.pop("_session", None)
+        ttft = kw.get("ttft_timeout")
+        tight = (
+            isinstance(ttft, (int, float)) and not isinstance(ttft, bool)
+            and ttft < self.tight_ttft_s
+        )
         excluded: set[int] = set()
         last_exc: Optional[BaseException] = None
         resume: Optional[ResumeState] = None  # carried across attempts
@@ -202,7 +361,10 @@ class ReplicaSet:
         trackable = True    # ints only; else crash-resume is refused
         while True:
             try:
-                i, probe = self._pick(excluded)
+                i, probe = self._pick(
+                    excluded, prompt=prompt_tokens, session=session,
+                    tight=tight,
+                )
             except ReplicasUnavailableError:
                 if last_exc is not None:
                     # mst: allow(MST302): _pick raised — no ticket was taken
@@ -210,7 +372,9 @@ class ReplicaSet:
                 raise
             started = False
             try:
-                rep = self.replicas[i]
+                with self._lock:
+                    rep = self.replicas[i]
+                    serial = self._serial_locks[i]
                 fwd = kw
                 if resume is not None:
                     if not getattr(rep, "supports_resume", False):
@@ -220,7 +384,6 @@ class ReplicaSet:
                         raise _ResumeUnsupported()
                     fwd = dict(kw, _resume=resume)
                 inject("replica.dispatch", replica=i)
-                serial = self._serial_locks[i]
                 if serial is not None:
                     with serial:
                         for item in rep.generate_step(prompt_tokens, **fwd):
@@ -317,10 +480,14 @@ class ReplicaSet:
         be truncated; if in-flight dispatches don't unwind by ``deadline``
         it is retired without closing (``closed: False`` in the result) and
         the leak is logged."""
-        n = len(self.replicas)
-        if not isinstance(i, int) or isinstance(i, bool) or not 0 <= i < n:
-            raise ValueError(f"replica index must be in [0, {n}); got {i!r}")
+        if not isinstance(i, int) or isinstance(i, bool):
+            raise ValueError(f"replica index must be an int; got {i!r}")
         with self._lock:
+            n = len(self.replicas)
+            if not 0 <= i < n:
+                raise ValueError(
+                    f"replica index must be in [0, {n}); got {i!r}"
+                )
             if self._retired[i]:
                 return {"replica": i, "migrated": 0, "closed": True,
                         "already_retired": True}
@@ -337,7 +504,7 @@ class ReplicaSet:
                 )
             self._drain_active[i] = True
             self._draining[i] = True
-        r = self.replicas[i]
+            r = self.replicas[i]
         try:
             inject("replica.drain", replica=i)
             migrated = (
@@ -383,6 +550,53 @@ class ReplicaSet:
             self.drains += 1
         return {"replica": i, "migrated": migrated, "closed": closed}
 
+    # ------------------------------------------------------ elastic fleet
+    def add_replica(self, replica) -> int:
+        """Append a freshly spawned replica to the fleet (the autoscaler's
+        scale-up mechanism). Indices are stable — retired slots keep their
+        position — so the new replica takes the next index, which is
+        returned. The replica is routable immediately."""
+        with self._lock:
+            self.replicas.append(replica)
+            self._serial_locks.append(
+                None if getattr(replica, "concurrent", False)
+                else make_lock("ReplicaSet._serial_locks[*]")
+            )
+            self._inflight.append(0)
+            self.served.append(0)
+            self.failures.append(0)
+            self.breaker_opens.append(0)
+            self._fails_consec.append(0)
+            self._draining.append(False)
+            self._drain_active.append(False)
+            self._retired.append(False)
+            self._open_until.append(0.0)
+            self._probing.append(False)
+            return len(self.replicas) - 1
+
+    def record_autoscale_event(self, kind: str):
+        """Count a fleet-controller event (spawn/drain/*_failed/...) for
+        the ``mst_autoscale_events_total`` metric."""
+        with self._lock:
+            self.autoscale_events[kind] = self.autoscale_events.get(kind, 0) + 1
+
+    def attach_controller(self, controller):
+        """Bind the FleetAutoscaler so close() stops its loop and health()
+        reports its state. Called by the controller's own __init__."""
+        self._controller = controller
+        self.brownout = getattr(controller, "brownout", None)
+
+    def set_pressure(self, level: int):
+        """Forward the brownout ladder level to every live replica that
+        understands it (ContinuousBatcher.set_pressure)."""
+        with self._lock:
+            reps = [
+                r for j, r in enumerate(self.replicas) if not self._retired[j]
+            ]
+        for r in reps:
+            if hasattr(r, "set_pressure"):
+                r.set_pressure(level)
+
     # ------------------------------------------------------- observability
     def stats(self):
         """Aggregate (slots, active, queued) across replicas for /metrics.
@@ -390,8 +604,9 @@ class ReplicaSet:
         is in flight."""
         with self._lock:
             inflight = list(self._inflight)
+            reps = list(self.replicas)
         slots = active = queued = 0
-        for i, r in enumerate(self.replicas):
+        for i, r in enumerate(reps):
             if hasattr(r, "stats"):  # replica stats outside our lock: the
                 s, a, q = r.stats()  # batcher takes its own admission lock
                 slots, active, queued = slots + s, active + a, queued + q
@@ -401,8 +616,58 @@ class ReplicaSet:
                 queued += max(inflight[i] - 1, 0)
         return slots, active, queued
 
+    def replica_stats(self) -> list:
+        """Per-replica routing/breaker snapshot for /metrics: inflight,
+        queue depth, breaker state (numeric: 0 closed / 1 half-open /
+        2 open), drain lifecycle. Queue depths come from each replica's own
+        stats() OUTSIDE our lock (see _queue_depths)."""
+        with self._lock:
+            now = time.monotonic()
+            reps = list(self.replicas)
+            snap = []
+            for j in range(len(reps)):
+                state = self._breaker_state(j, now)
+                snap.append({
+                    "replica": j,
+                    "inflight": self._inflight[j],
+                    "breaker": state,
+                    "breaker_state":
+                        {"closed": 0, "half_open": 1, "open": 2}[state],
+                    "draining": self._draining[j],
+                    "retired": self._retired[j],
+                })
+        for j, r in enumerate(reps):
+            q = 0
+            if not snap[j]["retired"] and hasattr(r, "stats"):
+                try:
+                    _, _, q = r.stats()
+                except Exception:  # noqa: BLE001 — gauge, not a contract
+                    q = 0
+            snap[j]["queue_depth"] = q
+        return snap
+
+    def fleet_stats(self) -> dict:
+        """Fleet-level gauges: live size, retirements, autoscale event
+        counts, and routing-cache occupancy/hits."""
+        with self._lock:
+            total = len(self.replicas)
+            live = total - sum(self._retired)
+            return {
+                "size": live,
+                "total": total,
+                "retired": sum(self._retired),
+                "draining": sum(self._draining),
+                "autoscale_events": dict(self.autoscale_events),
+                "sticky_sessions": len(self._sticky),
+                "affinity_entries": len(self._affinity),
+                "affinity_hits": self.route_affinity_hits,
+                "sticky_hits": self.route_sticky_hits,
+            }
+
     def page_stats(self):
-        totals = [r.page_stats() for r in self.replicas if hasattr(r, "page_stats")]
+        with self._lock:
+            reps = list(self.replicas)
+        totals = [r.page_stats() for r in reps if hasattr(r, "page_stats")]
         totals = [t for t in totals if t is not None]
         if not totals:
             return None
@@ -417,7 +682,9 @@ class ReplicaSet:
                   "migrations_out", "migrations_in")
         for k in summed:
             agg[k] = 0
-        for r in self.replicas:
+        with self._lock:
+            reps = list(self.replicas)
+        for r in reps:
             if not hasattr(r, "resilience_stats"):
                 continue
             s = r.resilience_stats()
@@ -441,8 +708,10 @@ class ReplicaSet:
         ``mst_kv_*`` gauge source when serving through a ReplicaSet), plus
         the dispatcher's crash/drain re-placement count. None when no
         replica has a paged pool."""
+        with self._lock:
+            reps = list(self.replicas)
         per = [
-            r.spill_stats() for r in self.replicas
+            r.spill_stats() for r in reps
             if hasattr(r, "spill_stats")
         ]
         per = [s for s in per if s is not None]
@@ -466,15 +735,16 @@ class ReplicaSet:
         purpose — they don't count against ``ok``."""
         with self._lock:
             now = time.monotonic()
+            reps = list(self.replicas)
             states = [
-                self._breaker_state(j, now) for j in range(len(self.replicas))
+                self._breaker_state(j, now) for j in range(len(reps))
             ]
             consec = list(self._fails_consec)
             fails = list(self.failures)
             draining = list(self._draining)
             retired = list(self._retired)
         per, live = [], 0
-        for j, r in enumerate(self.replicas):
+        for j, r in enumerate(reps):
             entry = {"replica": j, "breaker": states[j],
                      "consecutive_failures": consec[j], "failures": fails[j]}
             if retired[j]:
@@ -489,13 +759,13 @@ class ReplicaSet:
             if alive and not retired[j] and not draining[j]:
                 live += 1
             per.append(entry)
-        n = len(self.replicas)
+        n = len(reps)
         expected = n - sum(retired)
         status = (
             "draining" if any(draining)
             else ("ok" if live == expected else "degraded")
         )
-        return {
+        out = {
             "status": status,
             "serving": live >= 1,
             "replicas_total": n,
@@ -504,8 +774,20 @@ class ReplicaSet:
             "replicas_retired": sum(retired),
             "replicas": per,
         }
+        # elastic-fleet surfaces (attached by fleet.FleetAutoscaler)
+        ctrl, bro = self._controller, self.brownout
+        if ctrl is not None:
+            out["autoscaler"] = ctrl.state()
+        if bro is not None:
+            out["brownout"] = bro.state()
+        return out
 
     def close(self):
-        for r in self.replicas:
+        ctrl = self._controller
+        if ctrl is not None:
+            ctrl.stop()
+        with self._lock:
+            reps = list(self.replicas)
+        for r in reps:
             if hasattr(r, "close"):
                 r.close()

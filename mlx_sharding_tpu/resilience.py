@@ -84,7 +84,15 @@ class QueueFullError(RuntimeError):
 
 class ReplicasUnavailableError(RuntimeError):
     """Every replica is circuit-broken (or excluded by failed retries) —
-    there is nowhere to route the request. Maps to HTTP 503."""
+    there is nowhere to route the request. Maps to HTTP 503; when the
+    dispatcher knows the earliest half-open retry ETA (the soonest any
+    breaker re-admits a probe), ``retry_after_s`` carries it so the server
+    can emit ``Retry-After`` instead of leaving the client to guess."""
+
+    def __init__(self, message: str = "no replica available",
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class ReplicaDrainingError(QueueFullError):

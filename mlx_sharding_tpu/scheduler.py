@@ -52,7 +52,7 @@ import logging
 import queue
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -143,6 +143,40 @@ class _InflightBlock:
     live: list                       # [(slot, req)] snapshot at dispatch
     want_lp: bool
     prev_tok: Optional[object] = None  # block's first input (draft replay)
+
+
+# Retry-After clamps for 429 sheds: the estimate comes from the OBSERVED
+# completion rate (below), not a fixed constant, bounded so a mis-sampled
+# rate can neither tell clients "come back now" nor park them for minutes.
+RETRY_AFTER_FLOOR_S = 1.0
+RETRY_AFTER_CEIL_S = 30.0
+RETRY_AFTER_WINDOW_S = 30.0
+
+
+def estimate_retry_after(
+    backlog: int,
+    finish_times,
+    now: float,
+    *,
+    window_s: float = RETRY_AFTER_WINDOW_S,
+    floor_s: float = RETRY_AFTER_FLOOR_S,
+    ceil_s: float = RETRY_AFTER_CEIL_S,
+) -> float:
+    """When should a shed client retry? ``backlog`` is how many requests
+    must finish before the queue has room again; ``finish_times`` are
+    monotonic completion stamps (any iterable, typically the batcher's
+    bounded deque). The drain rate is completions-in-window / window-span;
+    the estimate is ``backlog / rate``, clamped to [floor_s, ceil_s].
+
+    Zero-drain edge: with no completion inside the window the queue is not
+    draining at all — the honest answer is the ceiling, not the floor (a
+    constant 1s would tell every shed client to hammer a wedged server)."""
+    recent = [t for t in finish_times if now - t <= window_s]
+    if not recent:
+        return ceil_s
+    span = max(now - min(recent), 1e-3)
+    rate = len(recent) / span
+    return min(ceil_s, max(floor_s, backlog / rate))
 
 
 class ContinuousBatcher:
@@ -301,6 +335,14 @@ class ContinuousBatcher:
         self.timeouts = 0        # consumer-side deadline expiries
         self.shed_queue_full = 0  # rejected at admission (429)
         self.shed_deadline = 0   # shed while queued: TTFT budget already gone
+        # monotonic completion stamps (bounded) feeding the drain-rate
+        # Retry-After estimate on 429s; appended under _admission_lock
+        self._finish_times: deque = deque(maxlen=256)
+        # brownout ladder level from the fleet controller (fleet.py), set
+        # via set_pressure(): >=2 pauses speculation, >=3 halves the
+        # effective admission bound. Hot-path reads are racy by design
+        # (gauge-grade) — the level changes at autoscaler-tick cadence.
+        self._pressure = 0
         # close() flips this when the scheduler thread fails to join —
         # /health reports degraded and the thread-live gauge drops to 0
         self.thread_wedged = False
@@ -624,9 +666,21 @@ class ContinuousBatcher:
         if self.max_queue is not None:
             with self._admission_lock:
                 depth = self._submit.qsize() + len(self._waiting)
-                if depth >= self.max_queue:
+                bound = self.max_queue
+                if self._pressure >= 3:
+                    # brownout level 3: tightened admission — shed at half
+                    # the configured bound so queue-wait stays bounded
+                    # while the fleet is saturated
+                    bound = max(1, bound // 2)
+                if depth >= bound:
                     self.shed_queue_full += 1
-                    raise QueueFullError(depth, self.max_queue)
+                    raise QueueFullError(
+                        depth, bound,
+                        retry_after_s=estimate_retry_after(
+                            max(1, depth - bound + 1),
+                            self._finish_times, time.monotonic(),
+                        ),
+                    )
                 self._submit.put(req)
         else:
             # mst: allow(MST201): no admission bound to keep atomic with
@@ -715,12 +769,20 @@ class ContinuousBatcher:
         with self._start_lock:
             return self._live_locked()
 
+    def set_pressure(self, level: int):
+        """Brownout ladder input from the fleet controller (fleet.py):
+        level >= 2 pauses speculation, level >= 3 halves the effective
+        admission bound. Idempotent; levels outside [0, 3] are clamped."""
+        with self._admission_lock:
+            self._pressure = max(0, min(3, int(level)))
+
     def resilience_stats(self) -> dict:
         """Deadline/shedding counters + queue bound for /metrics."""
         live = self.scheduler_thread_live()  # own lock; taken before ours
         with self._admission_lock:
             return {
                 "timeouts": self.timeouts,
+                "brownout_level": self._pressure,
                 "shed_queue_full": self.shed_queue_full,
                 "shed_deadline": self.shed_deadline,
                 "max_queue": self.max_queue,
@@ -1350,6 +1412,10 @@ class ContinuousBatcher:
                     )
             self._slots[req.slot] = None
             req.slot = -1
+        # completion stamp for the drain-rate Retry-After estimate; cancelled
+        # reaps count too — they free queue capacity all the same
+        with self._admission_lock:
+            self._finish_times.append(time.monotonic())
         req.out.put(None)
 
     def _reap_cancelled(self):
@@ -1805,6 +1871,11 @@ class ContinuousBatcher:
         positions speculatively, and past max_seq the dynamic-slice clamp
         would corrupt valid rows. Ticks that fail the check run a plain
         decode block (all slots still advance, just unspeculated)."""
+        if self._pressure >= 2:
+            # brownout level 2+: draft compute is ballast under overload —
+            # spend the flops on guaranteed tokens (racy gauge-grade read;
+            # the fallback tick path handles the draft-KV replay)
+            return False
         K, ms = self.spec_k, self.engine.max_seq
         for req in self._slots:
             if req is None or not self._prefill_done(req):

@@ -128,6 +128,12 @@ class ModelProvider:
         replicas: int = 1,
         max_queue: Optional[int] = None,
         async_sched: str = "auto",
+        autoscale: bool = False,
+        autoscale_min: Optional[int] = None,
+        autoscale_max: Optional[int] = None,
+        autoscale_interval: float = 2.0,
+        autoscale_cooldown: float = 15.0,
+        brownout: bool = True,
     ):
         # admission control: per-batcher bound on queued requests; a full
         # queue rejects with QueueFullError (HTTP 429 + Retry-After)
@@ -137,8 +143,17 @@ class ModelProvider:
         # single-host decode, off when speculating/multi-host)
         self.async_sched = async_sched
         # data-parallel serving: R independent engine replicas, each on its
-        # own slice of jax.devices(), least-loaded request routing
+        # own slice of jax.devices(), score-based request routing
         self.replicas = max(1, replicas)
+        # elastic fleet (fleet.py): autoscaler loop spawning/draining
+        # replicas under queue pressure, brownout degradation ladder
+        self.autoscale = bool(autoscale)
+        self.autoscale_min = autoscale_min
+        self.autoscale_max = autoscale_max
+        self.autoscale_interval = autoscale_interval
+        self.autoscale_cooldown = autoscale_cooldown
+        self.brownout = bool(brownout)
+        self.fleet = None  # FleetAutoscaler once a ReplicaSet is loaded
         # speculative decoding (single-chip generator path only)
         self.draft_model = draft_model
         self.spec_k = spec_k
@@ -347,6 +362,41 @@ class ModelProvider:
                             build_engine(devices[i * per : (i + 1) * per])
                             for i in range(self.replicas)
                         ])
+                        if self.autoscale:
+                            from mlx_sharding_tpu.fleet import FleetAutoscaler
+
+                            # ReplicaFactory: each spawn takes the next
+                            # unused device slice. Slices are never reused
+                            # after a drain (retired indices are stable), so
+                            # a fleet that has consumed every slice fails
+                            # the spawn — which the autoscaler degrades to
+                            # the static fleet, by design.
+                            spawn_state = {"next": self.replicas}
+
+                            def replica_factory():
+                                i = spawn_state["next"]
+                                lo, hi = i * per, (i + 1) * per
+                                if hi > len(devices):
+                                    raise RuntimeError(
+                                        f"no free device slice for replica "
+                                        f"{i}: need devices [{lo}, {hi}), "
+                                        f"have {len(devices)}"
+                                    )
+                                spawn_state["next"] = i + 1
+                                return build_engine(devices[lo:hi])
+
+                            hw_max = len(devices) // per
+                            self.fleet = FleetAutoscaler(
+                                generator, replica_factory,
+                                min_replicas=self.autoscale_min or 1,
+                                max_replicas=min(
+                                    self.autoscale_max or hw_max, hw_max
+                                ),
+                                interval_s=self.autoscale_interval,
+                                cooldown_s=self.autoscale_cooldown,
+                                enable_brownout=self.brownout,
+                            )
+                            self.fleet.start()
                     else:
                         generator = build_engine(devices[:per])
                     if self.multihost:
@@ -411,6 +461,11 @@ class ModelProvider:
         self.tokenizer = tokenizer
         if old is not None and hasattr(old, "close"):
             old.close()  # stop a replaced batcher's scheduler thread
+            # a fleet controller bound to the replaced generator died with
+            # it (rs.close() stopped the loop) — drop the stale handle
+            fleet = getattr(self, "fleet", None)
+            if fleet is not None and getattr(fleet, "rs", None) is old:
+                self.fleet = None
 
 
 class APIHandler(BaseHTTPRequestHandler):
@@ -444,8 +499,12 @@ class APIHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
-        for k, v in (extra_headers or {}).items():
-            self.send_header(k, v)
+        # per-request headers accumulated during handling (brownout level,
+        # caps) ride along on whatever response finally goes out
+        headers = dict(getattr(self, "_resp_headers", None) or {})
+        headers.update(extra_headers or {})
+        for k, v in headers.items():
+            self.send_header(k, str(v))
         self._cors()
         self.end_headers()
         self.wfile.write(body)
@@ -534,8 +593,11 @@ class APIHandler(BaseHTTPRequestHandler):
     # pin a handler thread with a huge/negative Content-Length
     MAX_BODY = 8 << 20
 
+    ADMIN_ROUTES = ("/admin/drain", "/admin/autoscaler")
+
     def do_POST(self):
         route = self.path.split("?")[0]
+        self._resp_headers: dict = {}  # reset per request (handler reuse)
         handlers = {
             "/v1/completions": self._handle_text_completion,
             "/v1/chat/completions": self._handle_chat_completion,
@@ -554,7 +616,7 @@ class APIHandler(BaseHTTPRequestHandler):
             # next request line)
         except OSError:
             return self._error(400, "unreadable request body")
-        if route not in handlers and route != "/admin/drain":
+        if route not in handlers and route not in self.ADMIN_ROUTES:
             return self._error(404, f"unknown route {route}")
         if self.api_key:
             # the reference UI sends Authorization: Bearer <key>
@@ -582,6 +644,8 @@ class APIHandler(BaseHTTPRequestHandler):
             # operator surface, not a generation request: no sampler params
             # to validate and no model hot-swap — but it IS key-gated above
             return self._handle_drain(body)
+        if route == "/admin/autoscaler":
+            return self._handle_autoscaler(body)
         try:
             params = self._validate_params(body)
         except ValueError as e:
@@ -610,8 +674,17 @@ class APIHandler(BaseHTTPRequestHandler):
             except Exception:
                 pass
         except ReplicasUnavailableError as e:
+            # every replica circuit-broken: the error carries the earliest
+            # half-open probe ETA, so tell the client when a retry could
+            # actually be admitted instead of inviting an instant hammer
+            ra = getattr(e, "retry_after_s", None)
+            hdrs = (
+                {"Retry-After": str(max(1, round(ra)))}
+                if isinstance(ra, (int, float)) and not isinstance(ra, bool)
+                else None
+            )
             try:
-                self._error(503, str(e))
+                self._error(503, str(e), extra_headers=hdrs)
             except Exception:
                 pass
         except ValueError as e:  # bad request discovered late (e.g. KV capacity)
@@ -656,6 +729,32 @@ class APIHandler(BaseHTTPRequestHandler):
             logger.exception("replica drain failed")
             return self._error(500, f"{type(e).__name__}: {e}")
         return self._json(200, result)
+
+    def _handle_autoscaler(self, body: dict):
+        """POST /admin/autoscaler ``{"enabled": bool}`` — start/stop the
+        fleet autoscaler loop (omit ``enabled`` to just inspect it).
+        Returns the controller's counters plus the brownout ladder state.
+        400 when the server wasn't launched with --autoscale."""
+        fleet = getattr(self.provider, "fleet", None)
+        if fleet is None:
+            return self._error(400, "autoscaler requires --autoscale "
+                                    "(and --replicas > 1) serving")
+        enabled = body.get("enabled")
+        if enabled is not None and not isinstance(enabled, bool):
+            return self._error(400, "'enabled' must be a boolean")
+        try:
+            if enabled is True:
+                fleet.start()
+            elif enabled is False:
+                fleet.stop()
+        except Exception as e:
+            logger.exception("autoscaler control failed")
+            return self._error(500, f"{type(e).__name__}: {e}")
+        out = dict(fleet.state())
+        bro = getattr(fleet, "brownout", None)
+        if bro is not None:
+            out["brownout"] = bro.state()
+        return self._json(200, out)
 
     # ---------------------------------------------------------- validation
     def _validate_params(self, body: dict) -> dict:
@@ -779,6 +878,32 @@ class APIHandler(BaseHTTPRequestHandler):
             # streaming discards logprobs (ref shard/openai_api.py:454-455),
             # so only the non-streaming path asks the engine to compute them
             gen_kwargs["want_logprobs"] = True
+
+        # Brownout: under sustained overload the ladder trades per-request
+        # cost for admission — cap max_tokens before shedding anything. The
+        # applied level is surfaced in a response header so load generators
+        # and clients can observe degradation without parsing /health.
+        fleet = getattr(self.provider, "fleet", None)
+        bro = getattr(fleet, "brownout", None) if fleet is not None else None
+        if bro is not None:
+            bstate = bro.state()
+            level = bstate.get("level", 0)
+            if level > 0:
+                self._resp_headers["X-MST-Brownout-Level"] = level
+                cap = bstate.get("max_tokens_cap")
+                if cap is not None and gen_kwargs["max_tokens"] > cap:
+                    gen_kwargs["max_tokens"] = cap
+                    self._resp_headers["X-MST-Max-Tokens-Capped"] = cap
+
+        # Session stickiness: an explicit session_id (or OpenAI's `user`
+        # field) lets the fleet router keep a conversation on the replica
+        # that holds its prefix cache.
+        sess = body.get("session_id") or body.get("user")
+        if (
+            isinstance(sess, str) and sess
+            and getattr(generator, "supports_sessions", False)
+        ):
+            gen_kwargs["_session"] = sess
 
         # Deadlines: per-request override beats the server-wide flag. A
         # scheduler-backed generator enforces them itself (bounded out-queue
@@ -929,6 +1054,8 @@ class APIHandler(BaseHTTPRequestHandler):
         # SSE has no Content-Length; end-of-stream is signalled by closing
         # the connection after [DONE].
         self.send_header("Connection", "close")
+        for k, v in (getattr(self, "_resp_headers", None) or {}).items():
+            self.send_header(k, str(v))
         self._cors()
         self.end_headers()
 
@@ -1191,6 +1318,31 @@ def main(argv=None):
                              "replicas, each on its own devices (stages x tp "
                              "x ep each), least-loaded request routing — "
                              "aggregate throughput scales with N")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="with --replicas: run the elastic fleet "
+                             "controller — spawn extra replicas onto unused "
+                             "device slices under sustained queue pressure, "
+                             "drain idle ones back down; spawn/drain "
+                             "failures degrade to the static fleet (never a "
+                             "dropped stream). Control at runtime via POST "
+                             "/admin/autoscaler")
+    parser.add_argument("--autoscale-min", type=int, default=None,
+                        help="autoscaler floor: never drain below this many "
+                             "replicas (default: 1)")
+    parser.add_argument("--autoscale-max", type=int, default=None,
+                        help="autoscaler ceiling (default: every replica the "
+                             "device count can hold)")
+    parser.add_argument("--autoscale-interval", type=float, default=2.0,
+                        help="seconds between autoscaler control ticks")
+    parser.add_argument("--autoscale-cooldown", type=float, default=15.0,
+                        help="seconds after any scale event (or failed "
+                             "attempt) before the next one")
+    parser.add_argument("--brownout", choices=("on", "off"), default="on",
+                        help="overload brownout ladder: under sustained "
+                             "pressure cap max_tokens, pause speculation and "
+                             "tighten admission BEFORE shedding with 429; "
+                             "level surfaced in /health and the "
+                             "X-MST-Brownout-Level response header")
     parser.add_argument("--prompt-cache", action="store_true",
                         help="reuse KV for shared prompt prefixes (chat turns "
                              "re-send their whole history: TTFT becomes "
@@ -1358,6 +1510,23 @@ def main(argv=None):
         if args.draft_model:
             parser.error("--spill-bytes is incompatible with --draft-model "
                          "(speculative slots re-prefill on preemption)")
+    if args.autoscale and args.replicas <= 1:
+        parser.error("--autoscale requires --replicas N (N > 1): only a "
+                     "ReplicaSet fleet can grow or shrink")
+    if not args.autoscale and (
+        args.autoscale_min is not None or args.autoscale_max is not None
+    ):
+        parser.error("--autoscale-min/--autoscale-max require --autoscale")
+    if args.autoscale_min is not None and args.autoscale_min < 1:
+        parser.error("--autoscale-min must be a positive integer")
+    if (
+        args.autoscale_min is not None and args.autoscale_max is not None
+        and args.autoscale_max < args.autoscale_min
+    ):
+        parser.error("--autoscale-max must be >= --autoscale-min")
+    if args.autoscale_interval <= 0 or args.autoscale_cooldown < 0:
+        parser.error("--autoscale-interval must be > 0 and "
+                     "--autoscale-cooldown >= 0")
     if args.max_queue is not None:
         if args.max_queue < 1:
             parser.error("--max-queue must be a positive integer")
@@ -1399,6 +1568,12 @@ def main(argv=None):
         prompt_cache=args.prompt_cache, replicas=args.replicas,
         max_queue=args.max_queue,
         async_sched=args.async_sched,
+        autoscale=args.autoscale,
+        autoscale_min=args.autoscale_min,
+        autoscale_max=args.autoscale_max,
+        autoscale_interval=args.autoscale_interval,
+        autoscale_cooldown=args.autoscale_cooldown,
+        brownout=args.brownout == "on",
     )
     if multihost:
         import jax

@@ -29,6 +29,12 @@ Sites wired into the serving stack:
 - ``replica.drain``       — entry of ``ReplicaSet.drain(i)``, after the
   replica is marked draining; ctx ``replica=<i>`` (kill a drain
   mid-migration to test the quarantine-and-retry path)
+- ``autoscaler.tick``     — top of every FleetAutoscaler control tick
+  (raise/delay here to prove a sick controller leaves the static fleet
+  serving and never drops a stream)
+- ``replica.spawn``       — before the autoscaler's ReplicaFactory builds
+  a new replica (raise here to test scale-up failure degrading to the
+  current fleet)
 
 Programmatic use (the fault-injection test suite)::
 

@@ -267,6 +267,55 @@ class ServingMetrics:
                             f'mst_replica_failures_total{{replica="{rep["replica"]}"}} '
                             f"{rep['failures']}",
                         ]
+                # per-replica routing load + fleet elasticity (replicas.py /
+                # fleet.py); breaker_state: 0 closed, 1 half-open, 2 open
+                per_rep = getattr(b, "replica_stats", lambda: None)()
+                if per_rep is not None:
+                    lines.append("# TYPE mst_replica_inflight gauge")
+                    for rep in per_rep:
+                        lines.append(
+                            f'mst_replica_inflight{{replica="{rep["replica"]}"}} '
+                            f"{rep['inflight']}"
+                        )
+                    lines.append("# TYPE mst_replica_queue_depth gauge")
+                    for rep in per_rep:
+                        lines.append(
+                            f'mst_replica_queue_depth{{replica="{rep["replica"]}"}} '
+                            f"{rep['queue_depth']}"
+                        )
+                    lines.append("# TYPE mst_replica_breaker_state gauge")
+                    for rep in per_rep:
+                        lines.append(
+                            f'mst_replica_breaker_state{{replica="{rep["replica"]}"}} '
+                            f"{rep['breaker_state']}"
+                        )
+                fleet = getattr(b, "fleet_stats", lambda: None)()
+                if fleet is not None:
+                    lines += [
+                        "# TYPE mst_fleet_size gauge",
+                        f"mst_fleet_size {fleet['size']}",
+                        "# TYPE mst_autoscale_events_total counter",
+                    ]
+                    for kind in sorted(fleet.get("autoscale_events", {})):
+                        lines.append(
+                            f'mst_autoscale_events_total{{kind="{kind}"}} '
+                            f"{fleet['autoscale_events'][kind]}"
+                        )
+                    if "sticky_hits" in fleet:
+                        lines += [
+                            "# TYPE mst_route_sticky_hits_total counter",
+                            f"mst_route_sticky_hits_total "
+                            f"{fleet['sticky_hits']}",
+                            "# TYPE mst_route_affinity_hits_total counter",
+                            f"mst_route_affinity_hits_total "
+                            f"{fleet['affinity_hits']}",
+                        ]
+                bro = getattr(b, "brownout", None)
+                if bro is not None:
+                    lines += [
+                        "# TYPE mst_brownout_level gauge",
+                        f"mst_brownout_level {bro.level()}",
+                    ]
                 prefix = getattr(b, "prefix_stats", lambda: None)()
                 if prefix is not None:
                     queries, hits, reused, evictions, cached = prefix

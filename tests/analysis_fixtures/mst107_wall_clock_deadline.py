@@ -1,0 +1,7 @@
+"""MST107: wall-clock time.time() in deadline arithmetic — NTP steps/slew
+make the deadline fire early or never; deadlines must be monotonic."""
+import time
+
+
+def remaining_budget(deadline: float) -> float:
+    return deadline - time.time()

@@ -91,6 +91,7 @@ def test_ep_unsupported_arch_raises():
         )
 
 
+@pytest.mark.slow  # ~16s arch-matrix combo; EP parity itself is pinned above
 def test_deepseek_fused_engine_with_ep():
     """DeepSeek grouped stacks: only the moe group's routed experts shard
     over ep (nested ep_layer_axes); shared experts/router/attention

@@ -1,0 +1,545 @@
+"""Elastic fleet controller (fleet.py) + load-aware routing (replicas.py):
+score-based placement with prefix affinity and session stickiness, the
+fake-clock autoscaler decision loop (hysteresis, cooldown, min/max clamps,
+spawn/drain failure quarantine), the brownout ladder, Retry-After
+estimation, and the server surfaces that expose all of it."""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.fleet import BrownoutController, FleetAutoscaler
+from mlx_sharding_tpu.replicas import ReplicaSet
+from mlx_sharding_tpu.resilience import ReplicasUnavailableError
+from mlx_sharding_tpu.scheduler import estimate_retry_after
+from mlx_sharding_tpu.testing import faults
+from mlx_sharding_tpu.utils.observability import ServingMetrics
+
+
+class FakeClock:
+    """Injectable monotonic clock: hysteresis/cooldown without sleeping."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+class _Stub:
+    concurrent = True
+
+    def __init__(self, tokens=(1, 2, 3)):
+        self.tokens = list(tokens)
+        self.closed = False
+
+    def generate_step(self, prompt_tokens, **kw):
+        yield from [(t, None) for t in self.tokens]
+
+    def close(self):
+        self.closed = True
+
+
+class _LoadStub(_Stub):
+    """Stub whose (slots, active, queued) is set by the test — the
+    autoscaler's pressure signal under full control."""
+
+    def __init__(self):
+        super().__init__()
+        self.load = (1, 0, 0)
+
+    def stats(self):
+        return self.load
+
+
+# ----------------------------------------------------------------- routing
+def test_affinity_beats_least_loaded_within_tolerance():
+    rs = ReplicaSet([_Stub(), _Stub()], affinity_page=4)
+    prompt = list(range(8))  # two affinity pages
+    i, _ = rs._pick((), prompt=prompt)
+    rs._done(i)
+    assert i == 0  # ties break to the lowest index (round-robin baseline)
+    assert rs.route_affinity_hits == 0  # nothing warm yet
+    # replica 0 now busier — but within route_imbalance the warm prefix
+    # wins over strict least-loaded (this is the affinity > round-robin
+    # property: a naive alternation would bounce the prefix to replica 1)
+    with rs._lock:
+        rs._inflight[0] = 2
+    i, _ = rs._pick((), prompt=prompt)
+    rs._done(i)
+    assert i == 0 and rs.route_affinity_hits == 1
+    # beyond the tolerance the escape hatch takes over: load wins
+    with rs._lock:
+        rs._inflight[0] = rs.route_imbalance + 3
+    i, _ = rs._pick((), prompt=prompt)
+    rs._done(i)
+    assert i == 1
+
+
+def test_short_prompts_contribute_no_affinity_signal():
+    rs = ReplicaSet([_Stub(), _Stub()])  # affinity_page=128 default
+    assert rs._affinity_chunks([1, 2, 3]) == []
+    assert rs._affinity_chunks("not tokens") == []
+
+
+def test_session_stickiness_survives_drain():
+    rs = ReplicaSet([_Stub(), _Stub()])
+    i, _ = rs._pick((), session="alice")
+    rs._done(i)
+    assert i == 0
+    # the session sticks even when the other replica is slightly less loaded
+    with rs._lock:
+        rs._inflight[0] = 2
+    j, _ = rs._pick((), session="alice")
+    rs._done(j)
+    assert j == 0 and rs.route_sticky_hits == 1
+    with rs._lock:
+        rs._inflight[0] = 0
+    # drain the sticky replica: the session re-maps, the request never errors
+    rs.drain(0, deadline=1.0)
+    k, _ = rs._pick((), session="alice")
+    rs._done(k)
+    assert k == 1
+    rs.close()
+
+
+def test_tight_ttft_disables_warm_detours():
+    rs = ReplicaSet([_Stub(), _Stub()], affinity_page=4)
+    prompt = list(range(8))
+    i, _ = rs._pick((), prompt=prompt)
+    rs._done(i)
+    assert i == 0
+    with rs._lock:
+        rs._inflight[0] = 2
+    # a tight deadline collapses the tolerance: least-loaded wins over warm
+    j, _ = rs._pick((), prompt=prompt, tight=True)
+    rs._done(j)
+    assert j == 1
+
+
+def test_queue_depth_counts_toward_load():
+    class Deep(_Stub):
+        def stats(self):
+            return (4, 0, 9)
+
+    rs = ReplicaSet([Deep(), _Stub()])
+    i, _ = rs._pick(())
+    rs._done(i)
+    assert i == 1  # inflight parity, but replica 0's queue is 9 deep
+
+
+def test_all_breakers_open_raises_with_retry_eta():
+    class Boom:
+        concurrent = True
+
+        def generate_step(self, prompt_tokens, **kw):
+            raise RuntimeError("dead")
+            yield  # pragma: no cover — makes this a generator
+
+    rs = ReplicaSet([Boom(), Boom()], breaker_threshold=1, probe_interval=5.0)
+    # one request strikes out both replicas (it retries across the fleet),
+    # opening both breakers; the concrete failure wins over the generic 503
+    with pytest.raises(RuntimeError):
+        list(rs.generate_step([1, 2, 3]))
+    # next request: everything open → 503 carrying the earliest probe ETA
+    with pytest.raises(ReplicasUnavailableError) as ei:
+        list(rs.generate_step([1, 2, 3]))
+    eta = ei.value.retry_after_s
+    assert eta is not None and 0 < eta <= 5.0
+
+
+# --------------------------------------------------------------- brownout
+def test_brownout_escalates_immediately_steps_down_one_rung_per_dwell():
+    clk = FakeClock()
+    b = BrownoutController(dwell_s=5.0, clock=clk)
+    assert b.observe(0.5) == 0
+    assert b.observe(2.5) == 3  # straight to the top rung
+    assert b.state() == {
+        "level": 3, "max_tokens_cap": 96,
+        "speculation_disabled": True, "admission_tightened": True,
+    }
+    # pressure collapses — but de-escalation needs the dwell, one rung each
+    assert b.observe(0.1) == 3
+    clk.advance(5.0)
+    assert b.observe(0.1) == 2
+    clk.advance(5.0)
+    assert b.observe(0.1) == 1
+    assert b.max_tokens_cap() == 512
+    clk.advance(5.0)
+    assert b.observe(0.1) == 0
+    assert b.max_tokens_cap() is None
+
+
+def test_brownout_dwell_resets_when_pressure_returns():
+    clk = FakeClock()
+    b = BrownoutController(dwell_s=5.0, clock=clk)
+    b.observe(1.0)  # level 1
+    b.observe(0.1)  # below exit — dwell starts
+    clk.advance(4.0)
+    b.observe(1.0)  # pressure back above exit: dwell anchor resets
+    clk.advance(4.0)
+    assert b.observe(0.1) == 1  # only 0s below — no de-escalation yet
+
+
+def test_brownout_validation():
+    with pytest.raises(ValueError):
+        BrownoutController(enter=(1.0, 0.9, 2.0))
+    with pytest.raises(ValueError):
+        BrownoutController(exit=(0.9, 1.3, 2.1))  # exit >= enter
+    with pytest.raises(ValueError):
+        FleetAutoscaler(object(), min_replicas=0)
+
+
+# -------------------------------------------------------------- autoscaler
+def _fleet(clk, factory=None, n=2, **kw):
+    reps = [_LoadStub() for _ in range(n)]
+    rs = ReplicaSet(reps)
+    ctrl = FleetAutoscaler(rs, factory, clock=clk, **kw)
+    return rs, reps, ctrl
+
+
+def test_scale_up_hysteresis_cooldown_and_max_clamp():
+    clk = FakeClock()
+    spawned = []
+
+    def factory():
+        r = _LoadStub()
+        spawned.append(r)
+        return r
+
+    rs, reps, ctrl = _fleet(
+        clk, factory, max_replicas=3,
+        scale_up_sustain_s=5.0, cooldown_s=20.0,
+    )
+    for r in reps:
+        r.load = (1, 1, 2)  # pressure 3.0
+    assert ctrl.tick()["action"] is None  # sustain window just anchored
+    clk.advance(5.0)
+    assert ctrl.tick()["action"] == "spawn"
+    assert len(spawned) == 1 and rs.fleet_stats()["size"] == 3
+    assert rs.fleet_stats()["autoscale_events"]["spawn"] == 1
+    # cooldown: pressure still high, no immediate second spawn
+    clk.advance(5.0)
+    assert ctrl.tick()["action"] is None
+    # and past the cooldown the max clamp holds the fleet at 3
+    clk.advance(30.0)
+    assert ctrl.tick()["action"] is None
+    assert len(spawned) == 1
+
+
+def test_scale_down_drains_least_loaded_and_respects_min():
+    clk = FakeClock()
+    rs, reps, ctrl = _fleet(
+        clk, None, n=3, min_replicas=2,
+        scale_down_sustain_s=10.0, cooldown_s=0.0, drain_deadline_s=0.2,
+    )
+    assert ctrl.tick()["action"] is None  # idle — sustain anchored
+    clk.advance(10.0)
+    assert ctrl.tick()["action"] == "drain"
+    # all-idle tie drains the HIGHEST index (newest spawn, coldest cache)
+    assert reps[2].closed and rs.fleet_stats()["size"] == 2
+    # min clamp: never below the floor, however long the idle lasts
+    clk.advance(60.0)
+    assert ctrl.tick()["action"] is None
+    assert rs.fleet_stats()["size"] == 2
+
+
+def test_spawn_failure_degrades_to_static_fleet_then_recovers():
+    clk = FakeClock()
+    spawned = []
+
+    def factory():
+        r = _LoadStub()
+        spawned.append(r)
+        return r
+
+    rs, reps, ctrl = _fleet(
+        clk, factory, max_replicas=3,
+        scale_up_sustain_s=5.0, cooldown_s=20.0,
+    )
+    for r in reps:
+        r.load = (1, 1, 2)
+    faults.arm("replica.spawn", exc=RuntimeError, times=1)
+    try:
+        ctrl.tick()
+        clk.advance(5.0)
+        assert ctrl.tick()["action"] == "spawn_failed"
+        st = ctrl.state()
+        assert st["spawn_failures"] == 1 and st["degraded"]
+        assert spawned == [] and rs.fleet_stats()["size"] == 2
+        assert rs.fleet_stats()["autoscale_events"]["spawn_failed"] == 1
+        # the static fleet keeps serving — streams intact
+        assert [t for t, _ in rs.generate_step([1, 2, 3])] == [1, 2, 3]
+        # after the cooldown quarantine the retry succeeds
+        clk.advance(25.0)
+        ctrl.tick()  # re-anchors the sustain window
+        clk.advance(5.0)
+        assert ctrl.tick()["action"] == "spawn"
+        assert len(spawned) == 1 and not ctrl.state()["degraded"]
+    finally:
+        faults.disarm()
+
+
+def test_drain_failure_quarantines_and_keeps_serving():
+    clk = FakeClock()
+    rs, reps, ctrl = _fleet(
+        clk, None, n=3, min_replicas=1,
+        scale_down_sustain_s=10.0, cooldown_s=30.0, drain_deadline_s=0.2,
+    )
+    faults.arm("replica.drain", exc=RuntimeError, times=1)
+    try:
+        ctrl.tick()
+        clk.advance(10.0)
+        assert ctrl.tick()["action"] == "drain_failed"
+        st = ctrl.state()
+        assert st["drain_failures"] == 1 and st["degraded"]
+        assert rs.fleet_stats()["autoscale_events"]["drain_failed"] == 1
+        # the victim stays quarantined (no new routes) but is NOT retired —
+        # its in-flight streams keep flowing
+        per = rs.replica_stats()
+        assert any(p["draining"] and not p["retired"] for p in per)
+        assert [t for t, _ in rs.generate_step([1, 2, 3])] == [1, 2, 3]
+    finally:
+        faults.disarm()
+
+
+def test_tick_fault_degrades_not_raises():
+    clk = FakeClock()
+    rs, reps, ctrl = _fleet(clk, None)
+    faults.arm("autoscaler.tick", exc=RuntimeError, times=1)
+    try:
+        assert ctrl.tick() == {"error": True}
+        assert ctrl.state()["tick_errors"] == 1
+        assert rs.fleet_stats()["autoscale_events"]["tick_error"] == 1
+        # the next tick is healthy again
+        assert "pressure" in ctrl.tick()
+    finally:
+        faults.disarm()
+
+
+def test_brownout_level_propagates_to_replicas_and_health():
+    class P(_LoadStub):
+        def __init__(self):
+            super().__init__()
+            self.pressure_seen = None
+
+        def set_pressure(self, level):
+            self.pressure_seen = level
+
+    clk = FakeClock()
+    reps = [P(), P()]
+    rs = ReplicaSet(reps)
+    ctrl = FleetAutoscaler(rs, None, clock=clk)
+    for r in reps:
+        r.load = (1, 1, 2)  # pressure 3.0 ≥ enter[2]
+    assert ctrl.tick()["brownout"] == 3
+    assert all(r.pressure_seen == 3 for r in reps)
+    assert rs.fleet_stats()["autoscale_events"]["brownout_level_3"] == 1
+    health = rs.health()
+    assert health["brownout"]["level"] == 3
+    assert health["autoscaler"]["ticks"] == 1
+
+
+# ------------------------------------------------------------- retry-after
+def test_estimate_retry_after_zero_drain_is_worst_case_ceiling():
+    assert estimate_retry_after(5, [], 100.0) == 30.0
+    # stale finishes (outside the window) count as zero drain too
+    assert estimate_retry_after(5, [10.0], 100.0) == 30.0
+
+
+def test_estimate_retry_after_tracks_drain_rate_with_clamps():
+    finishes = [90.0 + i for i in range(10)]  # 1 request/s
+    assert estimate_retry_after(5, finishes, 100.0) == pytest.approx(5.0)
+    # a torrent of finishes clamps to the floor...
+    assert estimate_retry_after(1, [99.9] * 50, 100.0) == 1.0
+    # ...and a huge backlog to the ceiling
+    assert estimate_retry_after(10_000, finishes, 100.0) == 30.0
+
+
+# ------------------------------------------------------------ observability
+def test_metrics_render_fleet_gauges():
+    rs = ReplicaSet([_Stub(), _Stub()])
+    rs.record_autoscale_event("spawn")
+    rs.record_autoscale_event("spawn")
+    rs.record_autoscale_event("drain_failed")
+    rs.brownout = BrownoutController()
+    text = ServingMetrics(batcher_fn=lambda: rs).render()
+    assert 'mst_replica_inflight{replica="0"} 0' in text
+    assert 'mst_replica_queue_depth{replica="1"} 0' in text
+    assert 'mst_replica_breaker_state{replica="0"} 0' in text
+    assert "mst_fleet_size 2" in text
+    assert 'mst_autoscale_events_total{kind="spawn"} 2' in text
+    assert 'mst_autoscale_events_total{kind="drain_failed"} 1' in text
+    assert "mst_route_sticky_hits_total 0" in text
+    assert "mst_route_affinity_hits_total 0" in text
+    assert "mst_brownout_level 0" in text
+
+
+# ------------------------------------------------------------- server glue
+def _serve(provider):
+    from mlx_sharding_tpu.server.openai_api import make_server
+
+    srv = make_server(provider, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, port
+
+
+def _provider(gen):
+    from mlx_sharding_tpu.server.openai_api import ModelProvider
+    from tests.test_tokenizer_utils import ByteTokenizer
+
+    provider = ModelProvider.__new__(ModelProvider)
+    provider.default_model = "tiny"
+    provider.trust_remote_paths = False
+    provider._key = None
+    provider._load_lock = threading.Lock()
+    provider._set("tiny", gen, ByteTokenizer())
+    return provider
+
+
+def _post(port, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", "/v1/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, headers, data
+
+
+def test_server_maps_replicas_unavailable_to_503_with_retry_after():
+    class Down:
+        concurrent = True
+
+        def generate_step(self, prompt_tokens, **kw):
+            raise ReplicasUnavailableError("all open", retry_after_s=7.2)
+            yield  # pragma: no cover
+
+    srv, port = _serve(_provider(Down()))
+    try:
+        status, headers, body = _post(port, {"prompt": "hi", "max_tokens": 4})
+        assert status == 503
+        assert headers.get("Retry-After") == "7"
+        assert json.loads(body)["error"]["type"] == "service_unavailable_error"
+    finally:
+        srv.shutdown()
+
+
+def test_server_brownout_cap_header_and_session_forwarding():
+    class Gen:
+        concurrent = True
+        supports_sessions = True
+
+        def __init__(self):
+            self.kw = None
+
+        def generate_step(self, prompt_tokens, **kw):
+            self.kw = kw
+            yield from [(65, None), (66, None)]
+
+    class FakeFleet:
+        def __init__(self, brownout):
+            self.brownout = brownout
+
+    bro = BrownoutController(clock=FakeClock())
+    bro.observe(1.5)  # level 2 → cap 256
+    gen = Gen()
+    provider = _provider(gen)
+    provider.fleet = FakeFleet(bro)
+    srv, port = _serve(provider)
+    try:
+        status, headers, _ = _post(
+            port,
+            {"prompt": "hi", "max_tokens": 4000, "session_id": "alice"},
+        )
+        assert status == 200
+        assert headers.get("X-MST-Brownout-Level") == "2"
+        assert headers.get("X-MST-Max-Tokens-Capped") == "256"
+        assert gen.kw["max_tokens"] == 256
+        assert gen.kw["_session"] == "alice"
+    finally:
+        srv.shutdown()
+
+
+def test_admin_autoscaler_endpoint():
+    class FakeFleet:
+        def __init__(self):
+            self.brownout = BrownoutController(clock=FakeClock())
+            self.started = self.stopped = 0
+
+        def start(self):
+            self.started += 1
+
+        def stop(self):
+            self.stopped += 1
+
+        def state(self):
+            return {"running": bool(self.started and not self.stopped),
+                    "ticks": 0}
+
+    provider = _provider(_Stub())
+    srv, port = _serve(provider)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/admin/autoscaler", b"{}",
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        assert resp.status == 400  # no fleet controller serving
+        provider.fleet = FakeFleet()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/admin/autoscaler",
+                     json.dumps({"enabled": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert provider.fleet.started == 1
+        assert body["brownout"]["level"] == 0
+    finally:
+        srv.shutdown()
+
+
+# ----------------------------------------------------------- heavy (slow)
+@pytest.mark.slow
+def test_autoscaler_thread_loop_spawns_under_load():
+    """Real-thread elasticity sim: sustained pressure on a 2-replica fleet
+    spawns a third while streams keep flowing; stop() joins cleanly."""
+    reps = [_LoadStub(), _LoadStub()]
+    for r in reps:
+        r.load = (1, 1, 2)
+    rs = ReplicaSet(reps)
+    spawned = []
+
+    def factory():
+        r = _LoadStub()
+        spawned.append(r)
+        return r
+
+    ctrl = FleetAutoscaler(
+        rs, factory, max_replicas=3, interval_s=0.05,
+        scale_up_sustain_s=0.1, cooldown_s=10.0,
+    )
+    ctrl.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not spawned:
+            time.sleep(0.05)
+        assert len(spawned) == 1
+        assert [t for t, _ in rs.generate_step([1, 2, 3])] == [1, 2, 3]
+        assert rs.fleet_stats()["size"] == 3
+    finally:
+        ctrl.stop()
+        rs.close()

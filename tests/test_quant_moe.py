@@ -161,6 +161,7 @@ def test_deepseek_keep_quantized_matches_dense(tmp_path, cache_mode):
     assert _tokens(model_p, params_p, prompt) == _tokens(model_d, params_d, prompt)
 
 
+@pytest.mark.slow  # ~15s arch-matrix combo (packed x pipeline x EP)
 def test_deepseek_packed_fused_pipeline_and_ep(tmp_path):
     """Packed grouped stacks through the fused SPMD engine: pp2 (uneven
     dense/moe split) and pp1 x ep2 (packed expert stacks sharded on their E
@@ -186,6 +187,7 @@ def test_deepseek_packed_fused_pipeline_and_ep(tmp_path):
     assert wq.sharding.shard_shape(wq.shape)[2] == wq.shape[2] // 2
 
 
+@pytest.mark.slow  # ~12s arch-matrix combo (packed x TP)
 def test_deepseek_packed_tensor_parallel(tmp_path):
     """TP x packed for MLA + experts: kv_b/q column-parallel (whole heads),
     o row-parallel, expert stacks split their intermediate dim — gs=16 keeps
@@ -208,6 +210,7 @@ def test_deepseek_packed_tensor_parallel(tmp_path):
     assert wq.sharding.shard_shape(wq.shape)[3] == wq.shape[3] // 2
 
 
+@pytest.mark.slow  # ~11s all-engine sweep; dense-parity gates stay tier-1
 def test_mixtral_keep_quantized_all_engines(tmp_path):
     from mlx_sharding_tpu.loading import load_model
     from mlx_sharding_tpu.parallel.mesh import make_mesh

@@ -49,6 +49,7 @@ def test_repetition_penalty_sees_prompt():
     assert int(tok[0]) == 3  # 7 would win without the prompt-seeded penalty
 
 
+@pytest.mark.slow  # ~21s: statistical many-sample sweep (runs in full suite)
 def test_top_p_applies_after_temperature():
     """At high temperature the tempered distribution is flatter, so more
     tokens stay inside the nucleus than at temp≈0+."""

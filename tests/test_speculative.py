@@ -89,6 +89,7 @@ def test_exact_with_penalty_and_bias(pair):
     assert [t for t, _ in spec.generate_step(prompt, **kw)] == want
 
 
+@pytest.mark.slow  # ~14s K-sweep; single-K exactness tests stay tier-1
 def test_spec_k_values(pair):
     """Every window size produces the same stream (K=1 degenerates to
     verify-only decode)."""

@@ -58,6 +58,7 @@ def test_graft_entry_dryrun():
     __graft_entry__.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # ~17s: graft entry compile, same tier as the dry-run
 def test_graft_entry_forward():
     import __graft_entry__
 
