@@ -909,6 +909,147 @@ def measure_fleet_elasticity(model, params, label: str) -> dict:
         rs.close()
 
 
+def measure_disagg_prefill_decode(model, params, label: str) -> dict:
+    """Disaggregated prefill/decode A/B (ISSUE 8 tentpole): the same mixed
+    workload — decode-saturated slots plus long-prefill arrivals — through
+    (a) a 2-replica monolithic ReplicaSet where every replica serves both
+    phases, and (b) a DisaggCoordinator fronting a 1-replica prefill pool
+    and a 1-replica decode pool on the same two devices. Monolithic, an
+    arriving long prefill interleaves its chunks with the busy replica's
+    decode ticks, so its TTFT pays the contention; disaggregated, the
+    chunks run back-to-back on the prefill replica (which decode load
+    never touches) and the stream hands its KV block to the decode pool
+    after the first token. Records TTFT p50/p99 of the long-prefill
+    arrivals and background decode tok/s under both topologies — the TTFT
+    tail under decode saturation is the headline."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.disagg import DisaggCoordinator
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.replicas import ReplicaSet
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return dict(label=label, skipped="needs 2 devices")
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(17)
+    bg_prompts = [
+        [int(x) for x in rng.integers(1, vocab - 64, 12)] for _ in range(2)
+    ]
+    fg_prompts = [
+        [int(x) for x in rng.integers(1, vocab - 64, 192)] for _ in range(4)
+    ]
+
+    def build(i):
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1, devices=devices[i : i + 1]),
+            microbatches=2, max_seq=512, cache_dtype=jnp.bfloat16,
+            prefill_chunk=16, pool_pages=24, page_size=32,
+        )
+        return ContinuousBatcher(eng, decode_block=4)
+
+    def run(kind: str) -> dict:
+        reps = [build(0), build(1)]
+        if kind == "monolithic":
+            front = ReplicaSet(reps)
+        else:
+            front = DisaggCoordinator(
+                ReplicaSet(reps[:1], role="prefill"),
+                ReplicaSet(reps[1:], role="decode"),
+            )
+        try:
+            for r in reps:  # compile prefill + decode off the clock
+                for _ in r.generate_step(fg_prompts[0][:32], max_tokens=4):
+                    pass
+            bg_tokens = [0] * len(bg_prompts)
+            bg_started = [threading.Event() for _ in bg_prompts]
+
+            def background(i):
+                for _ in front.generate_step(bg_prompts[i], max_tokens=96):
+                    bg_tokens[i] += 1
+                    bg_started[i].set()
+
+            bgs = [
+                threading.Thread(target=background, args=(i,))
+                for i in range(len(bg_prompts))
+            ]
+            t0 = time.perf_counter()
+            for t in bgs:
+                t.start()
+            for ev in bg_started:  # decode saturation established
+                ev.wait(120)
+
+            ttfts: list = []
+            errs: list = []
+            lock = threading.Lock()
+
+            def foreground(p):
+                s = time.perf_counter()
+                try:
+                    first = None
+                    for _ in front.generate_step(p, max_tokens=8):
+                        if first is None:
+                            first = time.perf_counter() - s
+                    with lock:
+                        ttfts.append(first)
+                except Exception as e:  # noqa: BLE001 — recorded, not raised
+                    with lock:
+                        errs.append(repr(e)[:200])
+
+            fgs = [
+                threading.Thread(target=foreground, args=(p,))
+                for p in fg_prompts
+            ]
+            for t in fgs:
+                t.start()
+            for t in fgs + bgs:
+                t.join(timeout=240)
+            wall = time.perf_counter() - t0
+            out = dict(
+                ttft_p50_ms=round(
+                    float(np.percentile(ttfts, 50)) * 1e3, 1
+                ) if ttfts else None,
+                ttft_p99_ms=round(
+                    float(np.percentile(ttfts, 99)) * 1e3, 1
+                ) if ttfts else None,
+                bg_decode_tok_s=round(sum(bg_tokens) / max(wall, 1e-9), 1),
+                dropped_streams=len(errs) + sum(
+                    1 for t in fgs + bgs if t.is_alive()
+                ),
+                errors=errs,
+            )
+            if kind == "disagg":
+                h = front.handoff_stats()
+                out["handoffs"] = h["handoffs"]
+                out["handoff_ms_p50"] = (
+                    round(h["ms_p50"], 3) if h["ms_p50"] is not None else None
+                )
+                out["fallbacks"] = dict(h["fallbacks"])
+            return out
+        finally:
+            front.close()
+
+    mono = run("monolithic")
+    dis = run("disagg")
+    res = dict(label=label, monolithic=mono, disagg=dis)
+    if mono.get("ttft_p99_ms") and dis.get("ttft_p99_ms"):
+        res["ttft_p99_speedup"] = round(
+            mono["ttft_p99_ms"] / max(dis["ttft_p99_ms"], 1e-9), 2
+        )
+    log(f"[{label}] long-prefill TTFT p99 under decode saturation: "
+        f"monolithic={mono.get('ttft_p99_ms')}ms "
+        f"disagg={dis.get('ttft_p99_ms')}ms "
+        f"({res.get('ttft_p99_speedup')}x); handoffs={dis.get('handoffs')} "
+        f"dropped={mono['dropped_streams'] + dis['dropped_streams']}")
+    return res
+
+
 def measure_paged_ragged_vs_gather(model, params, label: str) -> dict:
     """The ragged paged-attention A/B (ISSUE 1 tentpole): mixed-length
     continuous batching decode through the same page pool on both paths.
@@ -1547,6 +1688,15 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 detail["fleet_elasticity_cpu"] = dict(error=repr(e)[:300])
                 log(f"[fleet_elasticity_cpu] FAILED: {e!r}")
+            try:
+                detail["disagg_prefill_decode_cpu"] = (
+                    measure_disagg_prefill_decode(
+                        m2, p2, "disagg_prefill_decode_cpu"
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["disagg_prefill_decode_cpu"] = dict(error=repr(e)[:300])
+                log(f"[disagg_prefill_decode_cpu] FAILED: {e!r}")
             # the 0.28B fallback model, not tiny2: the A/B needs decode
             # blocks whose device time is non-trivial next to the host work,
             # or there is nothing for the async loop to overlap
@@ -1754,6 +1904,15 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["fleet_elasticity"] = dict(error=repr(e)[:300])
             log(f"[fleet_elasticity] FAILED: {e!r}")
+        gc.collect()
+        try:
+            # self-skips on a single-chip host (needs one device per pool)
+            detail["disagg_prefill_decode"] = measure_disagg_prefill_decode(
+                model, params, "disagg_prefill_decode"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["disagg_prefill_decode"] = dict(error=repr(e)[:300])
+            log(f"[disagg_prefill_decode] FAILED: {e!r}")
 
         # HEADLINE (BASELINE.json primary config): DeepSeek-Coder-V2-Lite at
         # its real architecture and scale — 27 layers, 64-expert MoE + 2

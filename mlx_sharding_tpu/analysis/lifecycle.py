@@ -44,6 +44,9 @@ KNOWN_FAULT_SITES = {
     # elastic fleet (fleet.py): autoscaler control tick and the
     # ReplicaFactory spawn call — both must degrade to the static fleet
     "autoscaler.tick", "replica.spawn",
+    # disaggregated serving (disagg.py): the prefill→decode handoff
+    # control point — must degrade to serve-in-place, never drop a stream
+    "disagg.handoff",
 }
 # basename -> the inject() site that file must keep calling
 REQUIRED_FAULT_SITES = {
@@ -53,6 +56,7 @@ REQUIRED_FAULT_SITES = {
     "openai_api.py": "server.sse_write",
     "fleet.py": "autoscaler.tick",
     "kv_transfer.py": "cache.export",
+    "disagg.py": "disagg.handoff",
 }
 
 

@@ -45,6 +45,17 @@
   blocking copy to the spill tier's flusher thread (see
   ``kv_transfer.KVSpillTier``). An MST102 suppression on the same call does
   NOT cover this rule — a full-block pull needs its own justification.
+- **MST108 block-migration-in-tick** — a KV page-block migration call
+  (``export_block``/``import_block``) inside a tick-hot function. These are
+  the disaggregation/spill handoff primitives: an export gathers a
+  request's whole page chain and stamps sampler state, an import allocates
+  pages, scatters the payload and verifies the checksum — each is a
+  whole-request unit of work that belongs on the non-hot helpers
+  (``_handoff_out``, ``_import_block`` at admission) or a flusher thread,
+  never inline in the per-decode-block tick. MST106 catches the
+  synchronous *pull* of an exported block; this rule catches the migration
+  call itself, which stalls the tick even when dispatch-only (tree flatten
+  + jit argument marshalling per page chain).
 - **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
   timeout arithmetic (an expression whose identifiers mention deadline /
   timeout / expiry / until / budget / ttft / retry_after / lease). The wall
@@ -104,6 +115,10 @@ SYNC_CALLS = {"jax.device_get", "np.asarray", "np.array", "numpy.asarray",
 # calls whose result is an exported KV page block (or its raw page pytrees):
 # the payload MST106 forbids pulling synchronously on the tick thread
 SPILL_PRODUCER_PREFIXES = ("export_block", "export_pool_pages")
+
+# the block-migration primitives MST108 keeps out of tick-hot functions:
+# whole-request page-chain gathers/scatters (kv_transfer.py)
+MIGRATION_CALLS = {"export_block", "import_block"}
 
 # decode-hot roots checked by MST105 (beyond '# mst: decode-hot'
 # annotations): every packed decode matmul funnels through these
@@ -372,6 +387,36 @@ def _check_sync_spill(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+def _check_block_migration(mod: ModuleInfo) -> list[Finding]:
+    """MST108: an ``export_block``/``import_block`` call inside a tick-hot
+    function. The handoff/spill discipline parks the request on the tick
+    and runs the migration from a non-hot helper (``_handoff_out``,
+    admission-side ``_import_block``) or the spill flusher — a page-chain
+    gather/scatter inline in the tick stalls every live slot's decode.
+    An MST102/MST106 suppression on a nearby sync does NOT cover this
+    rule; an intentional inline migration carries its own
+    ``# mst: allow(MST108): …``."""
+    findings = []
+    for fn in _hot_functions(mod):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] not in MIGRATION_CALLS:
+                continue
+            findings.append(Finding(
+                "MST108", mod.display_path, node.lineno, node.col_offset,
+                f"KV block migration in hot path {fn.name}(): "
+                f"{name.split('.')[-1]}() gathers/scatters a whole page "
+                "chain per request — park the request on the tick and run "
+                "the migration from a non-hot helper or the flusher thread",
+                context=qualname_for_line(mod.tree, node.lineno),
+            ))
+    return findings
+
+
 def _check_dense_dequant(mod: ModuleInfo, table: dict) -> list[Finding]:
     """MST105: a dense dequantized-weight materialization reachable from a
     decode-hot function. Roots come from ``DECODE_HOT_FUNCS`` (by basename)
@@ -540,6 +585,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_hot_syncs(mod)
     findings += _check_double_harvest(mod)
     findings += _check_sync_spill(mod)
+    findings += _check_block_migration(mod)
     findings += _check_recompile_hazards(mod)
     findings += _check_dense_dequant(mod, table)
     findings += _check_wall_clock_deadlines(mod)

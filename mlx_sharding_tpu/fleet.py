@@ -57,6 +57,23 @@ from mlx_sharding_tpu.testing.faults import inject
 logger = logging.getLogger(__name__)
 
 
+def pool_pressure(slots: int, active: int, queued: int,
+                  shed_delta: int) -> float:
+    """Load pressure of ONE replica pool: utilization
+    ``(active + queued) / slots`` plus a shed kicker (each admission shed
+    since the last sample counts 0.25, saturating at +1 — a shedding pool
+    is over pressure 1.0 by definition, whatever the instantaneous queue
+    looks like).
+
+    Module-level so every consumer prices load identically — and so the
+    disaggregated coordinator can run one autoscaler per ROLE pool over
+    that pool's own signals. Folding prefill-bound and decode-bound queues
+    into one fleet-wide scalar was the bug: a prefill storm inflated the
+    shared pressure and spawned decode replicas that then idled (and vice
+    versa). Each pool's pressure must see only its own slots/queue/sheds."""
+    return (active + queued) / max(1, slots) + min(1.0, 0.25 * shed_delta)
+
+
 class BrownoutController:
     """Degradation ladder (see module docstring). ``observe(pressure)`` is
     the only input; the outputs are ``state()`` / the level predicates the
@@ -147,6 +164,7 @@ class FleetAutoscaler:
                  drain_deadline_s: float = 30.0,
                  brownout: Optional[BrownoutController] = None,
                  enable_brownout: bool = True,
+                 role: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
@@ -161,6 +179,11 @@ class FleetAutoscaler:
         if min(scale_up_sustain_s, scale_down_sustain_s, cooldown_s) < 0:
             raise ValueError("sustain/cooldown windows must be >= 0")
         self.rs = replica_set
+        # which pool this controller scales; inherits the replica set's
+        # role tag so one autoscaler per role pool reads (and reacts to)
+        # only that pool's pressure — see pool_pressure()
+        self.role = role if role is not None \
+            else getattr(replica_set, "role", None)
         self.factory = factory
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
@@ -240,12 +263,10 @@ class FleetAutoscaler:
             self.ticks += 1
             shed_delta = max(0, shed - self._last_shed)
             self._last_shed = shed
-            # pressure: fleet utilization, plus a kicker when admission is
-            # actively shedding (each shed since the last tick counts 0.25,
-            # saturating at +1 — a shedding fleet is over pressure 1.0 by
-            # definition, whatever the instantaneous queue looks like)
-            pressure = (active + queued) / max(1, slots) \
-                + min(1.0, 0.25 * shed_delta)
+            # THIS pool's pressure only (see pool_pressure): slots/queue/
+            # sheds all come from self.rs, so a storm on the other role's
+            # pool can't trigger spawns here
+            pressure = pool_pressure(slots, active, queued, shed_delta)
             in_cooldown = (
                 self._last_scale_at is not None
                 and now - self._last_scale_at < self.cooldown_s
@@ -309,7 +330,8 @@ class FleetAutoscaler:
             self._last_scale_at = now
             self._up_since = None
         self.rs.record_autoscale_event("spawn")
-        logger.info("autoscaler spawned replica %d", idx)
+        logger.info("autoscaler (%s) spawned replica %d",
+                    self.role or "fleet", idx)
         return "spawn"
 
     def _drain(self, now: float) -> str:
@@ -338,7 +360,8 @@ class FleetAutoscaler:
             self._last_scale_at = now
             self._down_since = None
         self.rs.record_autoscale_event("drain")
-        logger.info("autoscaler drained replica %d", victim)
+        logger.info("autoscaler (%s) drained replica %d",
+                    self.role or "fleet", victim)
         return "drain"
 
     # --------------------------------------------------------- loop/state
@@ -370,6 +393,7 @@ class FleetAutoscaler:
         with self._lock:
             return {
                 "running": self._thread is not None,
+                **({"role": self.role} if self.role is not None else {}),
                 "ticks": self.ticks,
                 "tick_errors": self.tick_errors,
                 "spawns": self.spawns,

@@ -61,6 +61,7 @@ from typing import Optional
 
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.resilience import (
+    HandoffReadyError,
     QueueFullError,
     ReplicasUnavailableError,
     RequestMigratedError,
@@ -93,9 +94,13 @@ class ReplicaSet:
     def __init__(self, replicas: list, *, breaker_threshold: int = 3,
                  probe_interval: float = 5.0, resume_streams: bool = True,
                  route_imbalance: int = 4, affinity_page: int = 128,
-                 tight_ttft_s: float = 10.0):
+                 tight_ttft_s: float = 10.0, role: Optional[str] = None):
         if not replicas:
             raise ValueError("ReplicaSet needs at least one replica")
+        # disaggregated serving: pools are role-tagged ("prefill"/"decode")
+        # so fleet gauges, health blocks and autoscale events say which
+        # pool they describe; None keeps the monolithic (unlabeled) forms
+        self.role = role
         if breaker_threshold < 1:
             raise ValueError("breaker_threshold must be >= 1")
         if probe_interval <= 0:
@@ -356,9 +361,21 @@ class ReplicaSet:
         )
         excluded: set[int] = set()
         last_exc: Optional[BaseException] = None
-        resume: Optional[ResumeState] = None  # carried across attempts
+        # caller-seeded resume (disagg handoff: the coordinator re-places a
+        # stream whose first tokens were delivered by the OTHER pool) —
+        # distinct from `replaced`, which marks in-pool drain/crash hops
+        resume: Optional[ResumeState] = kw.pop("_resume", None)
+        replaced = False
         emitted: list = []  # every token delivered to the client so far
         trackable = True    # ints only; else crash-resume is refused
+        if resume is not None:
+            # seed the delivered-token record with the tokens the client
+            # already saw, so a crash HERE rebuilds the full stream (an
+            # empty seed would resume with the handed-off prefix missing)
+            for t in list(resume.history or []):
+                if not self._note_token(emitted, t):
+                    trackable = False
+                    break
         while True:
             try:
                 i, probe = self._pick(
@@ -389,7 +406,7 @@ class ReplicaSet:
                         for item in rep.generate_step(prompt_tokens, **fwd):
                             if not started:
                                 started = True
-                                if resume is not None:
+                                if replaced:
                                     with self._lock:
                                         self.migrated_streams += 1
                             if trackable:
@@ -399,7 +416,7 @@ class ReplicaSet:
                     for item in rep.generate_step(prompt_tokens, **fwd):
                         if not started:
                             started = True
-                            if resume is not None:
+                            if replaced:
                                 with self._lock:
                                     self.migrated_streams += 1
                         if trackable:
@@ -421,12 +438,20 @@ class ReplicaSet:
                 excluded.add(i)  # keep last_exc: it names the real failure
             except ValueError:
                 raise  # bad request — the replica is healthy
+            except HandoffReadyError:
+                # disaggregated prefill: the replica completed its phase and
+                # the stream ends with the ResumeState for the decode pool.
+                # A successful exit — no breaker strike, no in-pool
+                # re-placement; the DisaggCoordinator above catches it
+                self._record_success(i)
+                raise
             except RequestMigratedError as exc:
                 # graceful drain: the replica ended the stream with the
                 # complete ResumeState (KV block or prompt+history). Not a
                 # failure — no breaker strike; re-place and continue the
                 # client's stream where it left off
                 resume = exc.state
+                replaced = True
                 excluded.add(i)
                 last_exc = exc
             except QueueFullError as exc:
@@ -459,6 +484,7 @@ class ReplicaSet:
                         history=list(emitted),
                         produced=len(emitted),
                     )
+                    replaced = True
                 excluded.add(i)
                 last_exc = exc
             finally:
@@ -629,6 +655,7 @@ class ReplicaSet:
                 state = self._breaker_state(j, now)
                 snap.append({
                     "replica": j,
+                    "role": self.role,
                     "inflight": self._inflight[j],
                     "breaker": state,
                     "breaker_state":
@@ -653,6 +680,7 @@ class ReplicaSet:
             total = len(self.replicas)
             live = total - sum(self._retired)
             return {
+                "role": self.role,
                 "size": live,
                 "total": total,
                 "retired": sum(self._retired),
@@ -679,7 +707,7 @@ class ReplicaSet:
         agg = {"timeouts": 0, "shed_queue_full": 0, "shed_deadline": 0,
                "max_queue": None, "scheduler_thread_live": True}
         summed = ("preemptions", "spills", "spill_hits", "spill_fallbacks",
-                  "migrations_out", "migrations_in")
+                  "migrations_out", "migrations_in", "handoffs_out")
         for k in summed:
             agg[k] = 0
         with self._lock:
@@ -769,6 +797,7 @@ class ReplicaSet:
             "status": status,
             "serving": live >= 1,
             "replicas_total": n,
+            **({"role": self.role} if self.role is not None else {}),
             "replicas_live": live,
             "replicas_draining": sum(draining),
             "replicas_retired": sum(retired),

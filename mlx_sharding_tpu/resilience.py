@@ -142,6 +142,19 @@ class RequestMigratedError(RuntimeError):
         super().__init__(f"request migrated: {reason}")
 
 
+class HandoffReadyError(RuntimeError):
+    """A prefill-only stream completed its phase: the first token was
+    delivered and the request's :class:`ResumeState` (KV page block +
+    sampler rows) is ready to move to a decode replica. NOT a failure —
+    the disaggregation coordinator catches it to run the handoff, and the
+    dispatcher treats it as a successful prefill-replica exit (no breaker
+    strike, no in-pool re-placement)."""
+
+    def __init__(self, state: ResumeState):
+        self.state = state
+        super().__init__("prefill complete: ready for decode handoff")
+
+
 @dataclass
 class Deadlines:
     """Absolute-monotonic per-request deadlines, computed once at submit.

@@ -71,6 +71,7 @@ from mlx_sharding_tpu.generate import block_lp_outputs, block_token_logprobs
 from mlx_sharding_tpu.kv_transfer import KVSpillTier, export_block, import_block
 from mlx_sharding_tpu.resilience import (
     Deadlines,
+    HandoffReadyError,
     QueueFullError,
     ReplicaDrainingError,
     RequestMigratedError,
@@ -132,6 +133,9 @@ class _Request:
     # in directly (cross-replica migration via generate_step(_resume=…))
     spilled: bool = False
     _block: Optional[object] = None
+    # disaggregated serving: emit the first token, then end the stream
+    # with HandoffReadyError(ResumeState) instead of entering decode
+    prefill_only: bool = False
 
 
 @dataclass
@@ -196,6 +200,10 @@ class ContinuousBatcher:
     # generate_step accepts _resume=ResumeState — the dispatcher only
     # re-places migrated/crashed streams onto engines that advertise this
     supports_resume = True
+    # generate_step accepts _prefill_only=True (disaggregated serving):
+    # the stream delivers the first token, then ends with
+    # HandoffReadyError carrying the request's ResumeState
+    supports_prefill_only = True
 
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
                  policy: str = "fifo", prefix_cache: bool = False,
@@ -411,7 +419,11 @@ class ContinuousBatcher:
         self.spill_fallbacks = 0   # export/import/budget failures → re-prefill
         self.migrations_out = 0    # requests exported by migrate_out (drain)
         self.migrations_in = 0     # resumed requests accepted via _resume
+        self.handoffs_out = 0      # prefill-only requests handed to decode
         self.reprefill_tokens = 0  # tokens re-prefilled after discard paths
+        # prefill-only requests whose first token was emitted this tick;
+        # _handoff_out exports them before the tick's decode dispatch
+        self._handoff_ready: list = []
         self._export_pages = jax.jit(export_pool_pages) if self.paged else None
         self._import_pages = jax.jit(import_pool_pages) if self.paged else None
         # drain flag: migrate_out() sets it (under _start_lock, like _stop);
@@ -551,6 +563,7 @@ class ContinuousBatcher:
         ttft_timeout: Optional[float] = None,     # submit → first token budget
         stall_timeout: Optional[float] = None,    # inter-token watchdog
         _resume: Optional[ResumeState] = None,    # dispatcher-internal
+        _prefill_only: bool = False,              # disagg-coordinator-internal
     ):
         # Eager validation/admission, lazy consumption: every rejection
         # (bad params, queue full) raises on the CALLING thread before any
@@ -597,9 +610,14 @@ class ContinuousBatcher:
             block = _resume.block
             if block is not None and (not self.paged or self.draft is not None):
                 block = None  # no pool to import into; fall back to fold
+            # Capture the stashed sampler rows even when a block rides along:
+            # if its import fails on this engine the admission path degrades
+            # to fold + re-prefill, and the re-seeded PRNG chain must be the
+            # exported one — a fresh PRNGKey(seed) would replay the stream
+            # from token zero and double-emit what the client already saw.
+            resume_keys = _resume.resume_keys
+            resume_recent = _resume.resume_recent
             if block is None and hist:
-                resume_keys = _resume.resume_keys
-                resume_recent = _resume.resume_recent
                 prompt = np.concatenate([prompt, np.asarray(hist, np.int32)])
                 hist = []
         budget = max_tokens - produced0
@@ -651,6 +669,7 @@ class ContinuousBatcher:
             top_p=top_p,
             repetition_penalty=repetition_penalty,
             logit_bias=logit_bias,
+            prefill_only=bool(_prefill_only),
         )
         if _resume is not None:
             req.produced = produced0
@@ -793,6 +812,7 @@ class ContinuousBatcher:
                 "spill_fallbacks": self.spill_fallbacks,
                 "migrations_out": self.migrations_out,
                 "migrations_in": self.migrations_in,
+                "handoffs_out": self.handoffs_out,
             }
 
     def spill_stats(self) -> Optional[dict]:
@@ -1362,6 +1382,12 @@ class ContinuousBatcher:
             self.active, slot_arr, self._put(jnp.asarray(True))
         )
         self._emit(req, int(tok), logprobs)
+        if req.prefill_only and req.slot >= 0:
+            # disaggregated handoff: the first token is the prefill
+            # replica's whole deliverable — park the request; the tick
+            # exports its block (off this hot path) before dispatching
+            # decode, so the slot never enters a decode block here
+            self._handoff_ready.append(req)
 
     def _emit(self, req: _Request, token: int, logprobs):
         req.produced += 1
@@ -1633,7 +1659,8 @@ class ContinuousBatcher:
         self._waiting.clear()
 
     def _export_resume_state(self, req: _Request, slot: int,
-                             keys_h, recent_h) -> ResumeState:
+                             keys_h, recent_h, *,
+                             host: bool = True) -> ResumeState:
         """Build a request's portable :class:`ResumeState`. Admitted
         mid-decode requests get their page chain exported and host-
         materialized; a waiting request that was spill-preempted hands over
@@ -1672,7 +1699,7 @@ class ContinuousBatcher:
                     logging.getLogger(__name__).debug(
                         "drain export failed for slot %d: %s", slot, e
                     )
-        if block is not None:
+        if block is not None and host:
             try:
                 block.to_host()  # the block must outlive this engine
             except Exception as e:
@@ -1695,6 +1722,47 @@ class ContinuousBatcher:
         req.spilled = False
         if self.spill is not None:
             self.spill.drop(req)
+
+    def _handoff_out(self):
+        """Finish this tick's prefill-only requests: export each parked
+        request's page block (dispatch-only gather) and end its stream with
+        :class:`HandoffReadyError` carrying the ResumeState. Runs from the
+        tick right after the prefill section — the pipeline is still
+        quiesced from admission, so the one sampler-row ``device_get`` here
+        is off the steady-state decode path, and the slot is released
+        before the tick's decode dispatch so a handoff request never rides
+        a decode block. The block is deliberately NOT host-materialized
+        here (``host=False``): the consumer thread — the disagg
+        coordinator's handoff step — pulls it with ``to_host()``, so the
+        device→host DMA drains while this replica's next prefills and
+        decode ticks proceed."""
+        ready, self._handoff_ready = self._handoff_ready, []
+        live = [r for r in ready if r.slot >= 0]
+        keys_h = recent_h = None
+        if any(not r.cancelled for r in live):
+            # one transfer for every parked request's sampler rows (PRNG
+            # chain + repetition window) — what keeps the resumed decode
+            # stream token-exact on the target replica
+            keys_h, recent_h = jax.device_get((self.keys, self.recent))
+        for req in live:
+            slot = req.slot
+            if req.cancelled:
+                self._finish(req)
+                continue
+            state = self._export_resume_state(
+                req, slot, keys_h, recent_h, host=False
+            )
+            self.active = self._row_set(
+                self.active, self._put(jnp.asarray(slot, jnp.int32)),
+                self._put(jnp.asarray(False)),
+            )
+            self._release_pages(slot)
+            self._slots[slot] = None
+            req.slot = -1
+            req.out.put(HandoffReadyError(state))
+            with self._admission_lock:
+                self.handoffs_out += 1
+                self._finish_times.append(time.monotonic())
 
     def _grow_for_decode(self):
         """Over-commit page growth: before a decode block runs, every
@@ -1847,6 +1915,12 @@ class ContinuousBatcher:
         sizes from the block's KV rows instead of the prompt: at least the
         block's own pages, plus decode headroom in the same mode."""
         remaining = max(1, req.max_tokens - req.produced)
+        if req.prefill_only:
+            # a prefill-only request emits exactly one token on this
+            # replica before its block hands off to the decode pool —
+            # reserving its full decode budget here would starve the
+            # prefill pool's admission for capacity it never uses
+            remaining = 1
         if block is None:
             block = req._block
         if block is None and req.spilled and self.spill is not None:
@@ -2113,6 +2187,10 @@ class ContinuousBatcher:
             else:
                 for req in prefilling:
                     self._prefill_one_chunk(req)
+        if self._handoff_ready:
+            # prefill-only completions: export + end those streams BEFORE
+            # dispatch (pipeline still quiesced from the prefill above)
+            self._handoff_out()
         if self._decoding():
             if self.paged and self.overcommit and not self._growth_fits():
                 # growth might preempt (device_get of sampler rows + page
@@ -2162,6 +2240,9 @@ class ContinuousBatcher:
             else:
                 for req in prefilling:
                     self._prefill_one_chunk(req)
+        if self._handoff_ready:
+            # prefill-only completions leave before the decode block
+            self._handoff_out()
         if self._decoding():
             if self.draft is not None and self._spec_ok():
                 self._spec_once()

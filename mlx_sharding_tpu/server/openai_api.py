@@ -134,6 +134,9 @@ class ModelProvider:
         autoscale_interval: float = 2.0,
         autoscale_cooldown: float = 15.0,
         brownout: bool = True,
+        disagg: bool = False,
+        prefill_replicas: int = 1,
+        decode_replicas: int = 1,
     ):
         # admission control: per-batcher bound on queued requests; a full
         # queue rejects with QueueFullError (HTTP 429 + Retry-After)
@@ -154,6 +157,12 @@ class ModelProvider:
         self.autoscale_cooldown = autoscale_cooldown
         self.brownout = bool(brownout)
         self.fleet = None  # FleetAutoscaler once a ReplicaSet is loaded
+        # disaggregated prefill/decode serving (disagg.py): two role-split
+        # replica pools bridged by KVPageBlock handoff; with --autoscale,
+        # self.fleet becomes a (prefill, decode) controller tuple
+        self.disagg = bool(disagg)
+        self.prefill_replicas = max(1, prefill_replicas)
+        self.decode_replicas = max(1, decode_replicas)
         # speculative decoding (single-chip generator path only)
         self.draft_model = draft_model
         self.spec_k = spec_k
@@ -287,7 +296,7 @@ class ModelProvider:
                 )
                 if (
                     stages > 1 or self.concurrent > 1 or self.tp > 1
-                    or self.ep > 1 or self.replicas > 1
+                    or self.ep > 1 or self.replicas > 1 or self.disagg
                 ):
                     import jax as _jax
 
@@ -301,10 +310,14 @@ class ModelProvider:
 
                     per = stages * self.tp * self.ep
                     devices = _jax.devices()
-                    if self.replicas * per > len(devices):
+                    want = (
+                        self.prefill_replicas + self.decode_replicas
+                        if self.disagg else self.replicas
+                    )
+                    if want * per > len(devices):
                         raise ValueError(
-                            f"{self.replicas} replicas x {per} devices each "
-                            f"needs {self.replicas * per} devices, have "
+                            f"{want} replicas x {per} devices each "
+                            f"needs {want * per} devices, have "
                             f"{len(devices)}"
                         )
 
@@ -355,7 +368,79 @@ class ModelProvider:
                             )
                         return engine
 
-                    if self.replicas > 1:
+                    if self.disagg:
+                        from mlx_sharding_tpu.disagg import DisaggCoordinator
+                        from mlx_sharding_tpu.replicas import ReplicaSet
+
+                        if self.concurrent <= 1:
+                            raise ValueError(
+                                "disagg serving requires concurrent > 1: "
+                                "only the continuous batcher can park a "
+                                "prefill-only request and resume it from a "
+                                "KV page block"
+                            )
+                        n_pf = self.prefill_replicas
+                        n_dc = self.decode_replicas
+                        prefill = ReplicaSet([
+                            build_engine(devices[i * per : (i + 1) * per])
+                            for i in range(n_pf)
+                        ], role="prefill")
+                        decode = ReplicaSet([
+                            build_engine(
+                                devices[(n_pf + i) * per
+                                        : (n_pf + i + 1) * per]
+                            )
+                            for i in range(n_dc)
+                        ], role="decode")
+                        generator = DisaggCoordinator(prefill, decode)
+                        if self.autoscale:
+                            from mlx_sharding_tpu.fleet import FleetAutoscaler
+
+                            # Two controllers, one per role pool — each
+                            # reads only its own pool's pressure
+                            # (fleet.pool_pressure), so a prefill storm
+                            # can't spawn decode replicas and vice versa.
+                            # Spawns draw device slices from a shared tail:
+                            # the pools compete for leftover hardware
+                            # first-come, and a consumed tail fails the next
+                            # spawn — which degrades to the static pool, by
+                            # design.
+                            spawn_state = {"next": n_pf + n_dc}
+                            spawn_lock = make_lock(
+                                "ModelProvider.disagg_spawn_lock"
+                            )
+
+                            def pool_factory():
+                                with spawn_lock:
+                                    i = spawn_state["next"]
+                                    lo, hi = i * per, (i + 1) * per
+                                    if hi > len(devices):
+                                        raise RuntimeError(
+                                            f"no free device slice for "
+                                            f"replica {i}: need devices "
+                                            f"[{lo}, {hi}), have "
+                                            f"{len(devices)}"
+                                        )
+                                    spawn_state["next"] = i + 1
+                                return build_engine(devices[lo:hi])
+
+                            spare = len(devices) // per - (n_pf + n_dc)
+                            self.fleet = tuple(
+                                FleetAutoscaler(
+                                    pool, pool_factory,
+                                    min_replicas=base,
+                                    max_replicas=base + max(0, spare),
+                                    interval_s=self.autoscale_interval,
+                                    cooldown_s=self.autoscale_cooldown,
+                                    enable_brownout=self.brownout,
+                                )
+                                for pool, base in (
+                                    (prefill, n_pf), (decode, n_dc)
+                                )
+                            )
+                            for ctrl in self.fleet:
+                                ctrl.start()
+                    elif self.replicas > 1:
                         from mlx_sharding_tpu.replicas import ReplicaSet
 
                         generator = ReplicaSet([
@@ -462,9 +547,16 @@ class ModelProvider:
         if old is not None and hasattr(old, "close"):
             old.close()  # stop a replaced batcher's scheduler thread
             # a fleet controller bound to the replaced generator died with
-            # it (rs.close() stopped the loop) — drop the stale handle
+            # it (rs.close() stopped the loop) — drop the stale handle;
+            # disagg stores a (prefill, decode) controller tuple whose
+            # pools hang off the replaced coordinator
             fleet = getattr(self, "fleet", None)
-            if fleet is not None and getattr(fleet, "rs", None) is old:
+            ctrls = fleet if isinstance(fleet, tuple) else (fleet,)
+            owned = {id(o) for o in (old, getattr(old, "prefill", None),
+                                     getattr(old, "decode", None))
+                     if o is not None}
+            if any(c is not None and getattr(c, "rs", None) is not None
+                   and id(c.rs) in owned for c in ctrls):
                 self.fleet = None
 
 
@@ -738,23 +830,34 @@ class APIHandler(BaseHTTPRequestHandler):
         fleet = getattr(self.provider, "fleet", None)
         if fleet is None:
             return self._error(400, "autoscaler requires --autoscale "
-                                    "(and --replicas > 1) serving")
+                                    "(and --replicas > 1 or --disagg) "
+                                    "serving")
+        # --disagg runs one controller per role pool; start/stop applies
+        # to both, and the response carries a per-pool state list
+        ctrls = fleet if isinstance(fleet, tuple) else (fleet,)
         enabled = body.get("enabled")
         if enabled is not None and not isinstance(enabled, bool):
             return self._error(400, "'enabled' must be a boolean")
         try:
-            if enabled is True:
-                fleet.start()
-            elif enabled is False:
-                fleet.stop()
+            for ctrl in ctrls:
+                if enabled is True:
+                    ctrl.start()
+                elif enabled is False:
+                    ctrl.stop()
         except Exception as e:
             logger.exception("autoscaler control failed")
             return self._error(500, f"{type(e).__name__}: {e}")
-        out = dict(fleet.state())
-        bro = getattr(fleet, "brownout", None)
-        if bro is not None:
-            out["brownout"] = bro.state()
-        return self._json(200, out)
+
+        def _state(ctrl):
+            out = dict(ctrl.state())
+            bro = getattr(ctrl, "brownout", None)
+            if bro is not None:
+                out["brownout"] = bro.state()
+            return out
+
+        if len(ctrls) == 1:
+            return self._json(200, _state(ctrls[0]))
+        return self._json(200, {"pools": [_state(c) for c in ctrls]})
 
     # ---------------------------------------------------------- validation
     def _validate_params(self, body: dict) -> dict:
@@ -884,6 +987,11 @@ class APIHandler(BaseHTTPRequestHandler):
         # applied level is surfaced in a response header so load generators
         # and clients can observe degradation without parsing /health.
         fleet = getattr(self.provider, "fleet", None)
+        if isinstance(fleet, tuple):
+            # disagg: the decode pool's ladder governs generation caps
+            # (max_tokens is decode-side cost; prefill overload sheds at
+            # that pool's own admission instead)
+            fleet = fleet[-1]
         bro = getattr(fleet, "brownout", None) if fleet is not None else None
         if bro is not None:
             bstate = bro.state()
@@ -1318,6 +1426,23 @@ def main(argv=None):
                              "replicas, each on its own devices (stages x tp "
                              "x ep each), least-loaded request routing — "
                              "aggregate throughput scales with N")
+    parser.add_argument("--disagg", action="store_true",
+                        help="disaggregated prefill/decode serving: split "
+                             "the fleet into a prefill pool and a decode "
+                             "pool. Each request prefills (and emits its "
+                             "first token) on a prefill replica, then its "
+                             "KV page block is handed to the least-loaded "
+                             "decode replica, which owns the rest of the "
+                             "stream — long prefills stop stalling decode "
+                             "steady-state. Requires --concurrent; "
+                             "--paged-pool makes the handoff a block "
+                             "import instead of a re-prefill; handoff "
+                             "failures degrade to serve-in-place (never a "
+                             "dropped stream)")
+    parser.add_argument("--prefill-replicas", type=int, default=1,
+                        help="with --disagg: replicas in the prefill pool")
+    parser.add_argument("--decode-replicas", type=int, default=1,
+                        help="with --disagg: replicas in the decode pool")
     parser.add_argument("--autoscale", action="store_true",
                         help="with --replicas: run the elastic fleet "
                              "controller — spawn extra replicas onto unused "
@@ -1510,9 +1635,35 @@ def main(argv=None):
         if args.draft_model:
             parser.error("--spill-bytes is incompatible with --draft-model "
                          "(speculative slots re-prefill on preemption)")
-    if args.autoscale and args.replicas <= 1:
-        parser.error("--autoscale requires --replicas N (N > 1): only a "
-                     "ReplicaSet fleet can grow or shrink")
+    if args.disagg:
+        if args.concurrent <= 1:
+            parser.error("--disagg requires --concurrent N (N > 1): only "
+                         "the continuous batcher can park a prefill-only "
+                         "request and resume it from a KV page block")
+        if args.replicas > 1:
+            parser.error("--disagg replaces --replicas: size the pools "
+                         "with --prefill-replicas/--decode-replicas")
+        if args.coordinator or args.engine == "chained":
+            parser.error("--disagg requires the single-host fused engine "
+                         "path (no --coordinator/--engine chained)")
+        if args.draft_model:
+            parser.error("--disagg is incompatible with --draft-model "
+                         "(speculative slots cannot resume from a "
+                         "handed-off KV block)")
+        if args.prefill_replicas < 1 or args.decode_replicas < 1:
+            parser.error("--prefill-replicas/--decode-replicas must be "
+                         "positive integers")
+        if args.autoscale and (args.autoscale_min is not None
+                               or args.autoscale_max is not None):
+            parser.error("--autoscale-min/--autoscale-max do not apply to "
+                         "--disagg: each pool's floor is its initial size "
+                         "and its ceiling is the free device slices")
+    elif args.prefill_replicas != 1 or args.decode_replicas != 1:
+        parser.error("--prefill-replicas/--decode-replicas require "
+                     "--disagg")
+    if args.autoscale and args.replicas <= 1 and not args.disagg:
+        parser.error("--autoscale requires --replicas N (N > 1) or "
+                     "--disagg: only a ReplicaSet fleet can grow or shrink")
     if not args.autoscale and (
         args.autoscale_min is not None or args.autoscale_max is not None
     ):
@@ -1574,6 +1725,9 @@ def main(argv=None):
         autoscale_interval=args.autoscale_interval,
         autoscale_cooldown=args.autoscale_cooldown,
         brownout=args.brownout == "on",
+        disagg=args.disagg,
+        prefill_replicas=args.prefill_replicas,
+        decode_replicas=args.decode_replicas,
     )
     if multihost:
         import jax

@@ -35,6 +35,10 @@ Sites wired into the serving stack:
 - ``replica.spawn``       — before the autoscaler's ReplicaFactory builds
   a new replica (raise here to test scale-up failure degrading to the
   current fleet)
+- ``disagg.handoff``      — the prefill→decode handoff control point in
+  the DisaggCoordinator, after the first token but before the block's
+  device→host copy; ctx ``n_bytes=<block payload>`` (raise here to force
+  serve-in-place: the prefill pool finishes the stream itself)
 
 Programmatic use (the fault-injection test suite)::
 

@@ -271,22 +271,32 @@ class ServingMetrics:
                 # fleet.py); breaker_state: 0 closed, 1 half-open, 2 open
                 per_rep = getattr(b, "replica_stats", lambda: None)()
                 if per_rep is not None:
+                    # disaggregated pools tag entries with a role; indices
+                    # repeat across pools, so the role label is what keeps
+                    # the gauge lines distinct (monolithic sets stay
+                    # unlabeled — role is None there)
+                    def _rl(rep):
+                        role = rep.get("role")
+                        return (
+                            f'replica="{rep["replica"]}",role="{role}"'
+                            if role else f'replica="{rep["replica"]}"'
+                        )
                     lines.append("# TYPE mst_replica_inflight gauge")
                     for rep in per_rep:
                         lines.append(
-                            f'mst_replica_inflight{{replica="{rep["replica"]}"}} '
+                            f"mst_replica_inflight{{{_rl(rep)}}} "
                             f"{rep['inflight']}"
                         )
                     lines.append("# TYPE mst_replica_queue_depth gauge")
                     for rep in per_rep:
                         lines.append(
-                            f'mst_replica_queue_depth{{replica="{rep["replica"]}"}} '
+                            f"mst_replica_queue_depth{{{_rl(rep)}}} "
                             f"{rep['queue_depth']}"
                         )
                     lines.append("# TYPE mst_replica_breaker_state gauge")
                     for rep in per_rep:
                         lines.append(
-                            f'mst_replica_breaker_state{{replica="{rep["replica"]}"}} '
+                            f"mst_replica_breaker_state{{{_rl(rep)}}} "
                             f"{rep['breaker_state']}"
                         )
                 fleet = getattr(b, "fleet_stats", lambda: None)()
@@ -294,6 +304,15 @@ class ServingMetrics:
                     lines += [
                         "# TYPE mst_fleet_size gauge",
                         f"mst_fleet_size {fleet['size']}",
+                    ]
+                    for pool in fleet.get("pools", []):
+                        # per-role pool sizes under the disagg coordinator
+                        if pool.get("role"):
+                            lines.append(
+                                f'mst_fleet_size{{role="{pool["role"]}"}} '
+                                f"{pool['size']}"
+                            )
+                    lines += [
                         "# TYPE mst_autoscale_events_total counter",
                     ]
                     for kind in sorted(fleet.get("autoscale_events", {})):
@@ -310,6 +329,29 @@ class ServingMetrics:
                             f"mst_route_affinity_hits_total "
                             f"{fleet['affinity_hits']}",
                         ]
+                hand = getattr(b, "handoff_stats", lambda: None)()
+                if hand is not None:
+                    # disaggregated serving: prefill→decode KV handoffs —
+                    # volume, shipped bytes, DMA+control latency, and how
+                    # often the degradation ladder fired (by kind)
+                    lines += [
+                        "# TYPE mst_disagg_handoff_total counter",
+                        f"mst_disagg_handoff_total {hand['handoffs']}",
+                        "# TYPE mst_disagg_handoff_bytes_total counter",
+                        f"mst_disagg_handoff_bytes_total "
+                        f"{hand['bytes_total']}",
+                        "# TYPE mst_disagg_handoff_ms summary",
+                        'mst_disagg_handoff_ms{quantile="0.5"} '
+                        f"{hand['ms_p50'] or 0.0:.3f}",
+                        'mst_disagg_handoff_ms{quantile="0.99"} '
+                        f"{hand['ms_p99'] or 0.0:.3f}",
+                        "# TYPE mst_disagg_fallbacks_total counter",
+                    ]
+                    for kind in sorted(hand.get("fallbacks", {})):
+                        lines.append(
+                            f'mst_disagg_fallbacks_total{{kind="{kind}"}} '
+                            f"{hand['fallbacks'][kind]}"
+                        )
                 bro = getattr(b, "brownout", None)
                 if bro is not None:
                     lines += [

@@ -6,7 +6,7 @@ import jax
 
 # mst: hot-path
 def preempt_in_tick(cache, pages, tier):
-    blk = export_block(cache, pages)
+    blk = export_block(cache, pages)  # mst: allow(MST108): MST106's setup
     # mst: allow(MST102): the sync under test here is MST106's block pull
     host = jax.device_get(blk)
     tier.put(host)

@@ -1,0 +1,9 @@
+"""MST108: a KV page-block migration call inside a tick-hot function —
+an export gathers a whole page chain per request; park the request on
+the tick and migrate from a non-hot helper or the flusher thread."""
+
+
+# mst: hot-path
+def handoff_in_tick(cache, pages, out):
+    blk = export_block(cache, pages)
+    out.put(blk)

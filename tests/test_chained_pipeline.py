@@ -34,6 +34,7 @@ def _stage(cfg_kw, params_full, start, end):
     return model, p
 
 
+@pytest.mark.slow  # three-stage sweep — the two-stage chain keeps the quick signal
 def test_uneven_three_stage_chain_matches_single_device():
     cfg = LlamaConfig(**TINY)
     full = LlamaModel(cfg)

@@ -44,6 +44,7 @@ def test_generate_cli(ckpt):
     assert "TTFT" in r.stderr
 
 
+@pytest.mark.slow  # subprocess CLI sweep — test_generate_cli keeps the quick signal
 def test_generate_cli_spmd_pipeline(ckpt):
     r = _run(
         ["-m", "mlx_sharding_tpu.cli.generate", "--model", ckpt,
@@ -54,6 +55,7 @@ def test_generate_cli_spmd_pipeline(ckpt):
     assert "Generation" in r.stderr
 
 
+@pytest.mark.slow  # subprocess CLI sweep — test_generate_cli keeps the quick signal
 def test_generate_cli_chained_pipeline(ckpt):
     r = _run(
         ["-m", "mlx_sharding_tpu.cli.generate", "--model", ckpt,
@@ -63,6 +65,7 @@ def test_generate_cli_chained_pipeline(ckpt):
     assert r.returncode == 0, r.stderr[-2000:]
 
 
+@pytest.mark.slow  # subprocess CLI sweep — test_generate_cli keeps the quick signal
 def test_shard_tool_cli(ckpt, tmp_path):
     r = _run(
         ["-m", "mlx_sharding_tpu.shard_tool", "--model", ckpt,

@@ -1,0 +1,384 @@
+"""Disaggregated prefill/decode serving (disagg.py): role-split replica
+pools with KVPageBlock handoff.
+
+Parity contract: every stream a client sees through the DisaggCoordinator
+is bit-identical to the same request served by one monolithic batcher of
+the same pool geometry — across greedy and seeded-stochastic sampling,
+across bf16/fp32 and int8 KV pools, and under every injected handoff
+fault. The degradation matrix (``disagg.handoff`` / ``cache.export`` /
+``cache.import`` / a pool dying mid-plan) must degrade to serve-in-place
+or a blockless resume, never a dropped stream."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.disagg import DisaggCoordinator
+from mlx_sharding_tpu.fleet import FleetAutoscaler, pool_pressure
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.parallel.mesh import make_mesh
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+from mlx_sharding_tpu.replicas import ReplicaSet
+from mlx_sharding_tpu.resilience import QueueFullError
+from mlx_sharding_tpu.scheduler import ContinuousBatcher
+from mlx_sharding_tpu.testing import faults
+from mlx_sharding_tpu.utils.observability import ServingMetrics
+from tests.helpers import hard_timeout, run_concurrent
+
+TINY = dict(vocab_size=256, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2)
+
+# greedy, seeded-stochastic, and the degenerate stream that completes
+# inside prefill (max_tokens=1 never reaches the decode pool)
+JOBS = [
+    ([3, 17, 42], dict(max_tokens=24)),
+    ([9, 4, 4, 6], dict(temperature=0.9, top_p=0.85, seed=321,
+                        repetition_penalty=1.3, repetition_context_size=8,
+                        max_tokens=20)),
+    ([7, 7, 2, 1], dict(max_tokens=1)),
+]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    return model, params
+
+
+def _mk_batcher(tiny_model, dev_idx, kv_dtype=None):
+    model, params = tiny_model
+    devices = jax.devices()
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=1, devices=devices[dev_idx:dev_idx + 1]),
+        microbatches=2, max_seq=64, cache_dtype=jnp.float32,
+        prefill_chunk=8, pool_pages=10, page_size=8, kv_dtype=kv_dtype,
+    )
+    return ContinuousBatcher(eng, decode_block=3)
+
+
+@pytest.fixture(scope="module")
+def disagg_setup(tiny_model):
+    """One prefill + one decode replica behind a coordinator, plus a
+    monolithic batcher of identical geometry as the parity reference."""
+    co = DisaggCoordinator(
+        ReplicaSet([_mk_batcher(tiny_model, 0)], role="prefill"),
+        ReplicaSet([_mk_batcher(tiny_model, 1)], role="decode"),
+    )
+    mono = _mk_batcher(tiny_model, 2)
+    yield co, mono
+    co.close()
+    mono.close()
+
+
+def _refs(gen, jobs):
+    return [[t for t, _ in gen.generate_step(p, **kw)] for p, kw in jobs]
+
+
+# ------------------------------------------------------------ tentpole
+@hard_timeout(120)
+def test_handoff_streams_bit_identical_to_monolithic(disagg_setup):
+    """Greedy, seeded-stochastic, and prefill-complete streams through the
+    split pools match the monolithic batcher token for token, and the
+    bookkeeping shows the handoffs actually happened (this is not
+    serve-in-place parity by accident)."""
+    co, mono = disagg_setup
+    before = co.handoff_stats()
+    assert _refs(co, JOBS) == _refs(mono, JOBS)
+    h = co.handoff_stats()
+    # two handoffs (the max_tokens=1 job finishes inside prefill) with a
+    # real shipped payload and a measured DMA+control latency window
+    assert h["handoffs"] - before["handoffs"] == 2
+    assert h["bytes_total"] > before["bytes_total"]
+    assert h["window"] >= 2 and h["ms_p50"] is not None
+    assert h["fallbacks"] == before["fallbacks"]
+    r = co.resilience_stats()
+    assert r["handoffs"] == h["handoffs"]
+    assert r["handoffs_out"] >= 2  # prefill pool exported the parked slots
+    assert r["migrations_in"] >= 2  # decode pool admitted via resume
+    health = co.health()
+    assert health["status"] == "ok" and health["serving"] and health["disagg"]
+    assert set(health["pools"]) == {"prefill", "decode"}
+    fs = co.fleet_stats()
+    assert [p["role"] for p in fs["pools"]] == ["prefill", "decode"]
+
+
+@hard_timeout(120)
+@pytest.mark.slow  # the slow fault sweep also runs concurrent handoffs
+def test_concurrent_handoffs_stay_exact(disagg_setup):
+    """Interleaved requests handing off while other streams tick keep
+    exact content — the handoff overlaps ongoing prefill/decode work."""
+    co, mono = disagg_setup
+    jobs = [JOBS[0], JOBS[1], JOBS[0]]
+    assert run_concurrent(co, jobs) == _refs(mono, jobs)
+
+
+# ------------------------------------------------- degradation matrix
+@hard_timeout(120)
+def test_handoff_fault_serves_in_place(disagg_setup):
+    """disagg.handoff armed: the control point fails, the prefill pool
+    finishes the stream it started — same tokens, zero dropped streams,
+    no handoff counted."""
+    co, mono = disagg_setup
+    before = co.handoff_stats()
+    faults.arm("disagg.handoff", exc=faults.FaultError, times=1)
+    got = [t for t, _ in co.generate_step(*JOBS[0][:1], **JOBS[0][1])]
+    assert got == _refs(mono, JOBS[:1])[0]
+    h = co.handoff_stats()
+    assert h["handoffs"] == before["handoffs"]
+    assert h["fallbacks"].get("handoff_fault", 0) \
+        == before["fallbacks"].get("handoff_fault", 0) + 1
+
+
+@hard_timeout(120)
+def test_export_fault_degrades_to_blockless_handoff(disagg_setup):
+    """cache.export armed on the prefill scheduler: the block never forms,
+    the handoff ships history only, and the decode replica re-prefills
+    from the fold — still token-exact."""
+    co, mono = disagg_setup
+    before = co.handoff_stats()
+    faults.arm("cache.export", exc=faults.FaultError, times=1)
+    got = [t for t, _ in co.generate_step(*JOBS[0][:1], **JOBS[0][1])]
+    assert got == _refs(mono, JOBS[:1])[0]
+    h = co.handoff_stats()
+    assert h["handoffs"] == before["handoffs"] + 1
+    assert h["bytes_total"] == before["bytes_total"]  # nothing shipped
+
+
+@hard_timeout(120)
+def test_import_fault_degrades_to_reprefill(disagg_setup):
+    """cache.import armed on the decode replica: the block import fails at
+    admission and the scheduler's own fallback re-prefills — the
+    coordinator never notices, the stream never changes."""
+    co, mono = disagg_setup
+    faults.arm("cache.import", exc=faults.FaultError, times=1)
+    got = [t for t, _ in co.generate_step(*JOBS[1][:1], **JOBS[1][1])]
+    assert got == _refs(mono, JOBS[1:2])[0]
+
+
+@hard_timeout(120)
+def test_decode_pool_down_serves_in_place(disagg_setup):
+    """The decode leg's dispatch fails (prefill's passed: after=1): the
+    coordinator falls back to the prefill pool, which resumes the stream
+    it prefilled — token-exact, decode_failed counted."""
+    co, mono = disagg_setup
+    before = co.handoff_stats()
+    faults.arm("replica.dispatch", exc=faults.FaultError, after=1, times=1)
+    got = [t for t, _ in co.generate_step(*JOBS[0][:1], **JOBS[0][1])]
+    assert got == _refs(mono, JOBS[:1])[0]
+    h = co.handoff_stats()
+    assert h["fallbacks"].get("decode_failed", 0) \
+        == before["fallbacks"].get("decode_failed", 0) + 1
+
+
+@hard_timeout(120)
+def test_prefill_pool_down_decode_serves_monolithically(disagg_setup):
+    """The prefill dispatch fails before any token: the decode pool serves
+    the whole request (prefill included) — degraded, never dropped."""
+    co, mono = disagg_setup
+    before = co.handoff_stats()
+    faults.arm("replica.dispatch", exc=faults.FaultError, times=1)
+    got = [t for t, _ in co.generate_step(*JOBS[0][:1], **JOBS[0][1])]
+    assert got == _refs(mono, JOBS[:1])[0]
+    h = co.handoff_stats()
+    assert h["fallbacks"].get("prefill_unavailable", 0) \
+        == before["fallbacks"].get("prefill_unavailable", 0) + 1
+
+
+def test_queue_full_before_tokens_is_not_remapped():
+    """Admission saturation on the prefill pool re-raises (429 +
+    Retry-After is the correct answer) — spilling the overflow onto the
+    decode pool would break the SLO isolation disaggregation exists for."""
+
+    class FullPool:
+        role = "prefill"
+        supports_prefill_only = True
+
+        def generate_step(self, prompt_tokens, **kw):
+            raise QueueFullError(4, 4)
+            yield  # pragma: no cover — make this a generator function
+
+    class IdlePool:
+        role = "decode"
+        supports_resume = True
+        served = 0
+
+        def generate_step(self, prompt_tokens, **kw):
+            self.served += 1
+            yield from [(1, None)]
+
+    decode = IdlePool()
+    co = DisaggCoordinator(FullPool(), decode)
+    with pytest.raises(QueueFullError):
+        list(co.generate_step([1, 2, 3], max_tokens=4))
+    assert decode.served == 0 and co.handoff_stats()["fallbacks"] == {}
+
+
+def test_pool_capabilities_validated_at_construction():
+    """A prefill pool that can't park prefill-only requests (or a decode
+    pool without the resume protocol) is rejected up front, not at the
+    first handoff."""
+
+    class Plain:
+        # no .replicas attr → the coordinator validates the pool object itself
+
+        def generate_step(self, prompt_tokens, **kw):
+            yield from ()
+
+    ok = type("Cap", (Plain,), {"supports_prefill_only": True,
+                                "supports_resume": True})()
+    with pytest.raises(ValueError):
+        DisaggCoordinator(Plain(), ok)
+    with pytest.raises(ValueError):
+        DisaggCoordinator(ok, Plain())
+
+
+# ------------------------------------------- per-pool autoscaling split
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _LoadStub:
+    concurrent = True
+
+    def __init__(self):
+        self.load = (1, 0, 0)
+        self.closed = False
+
+    def stats(self):
+        return self.load
+
+    def generate_step(self, prompt_tokens, **kw):
+        yield from [(t, None) for t in (1, 2, 3)]
+
+    def close(self):
+        self.closed = True
+
+
+def test_pool_pressure_is_per_pool_and_shed_capped():
+    # (active + queued) / slots, plus a capped shed-burst term — one
+    # pool's queue never leaks into the other's scalar by construction
+    assert pool_pressure(2, 1, 3, 0) == 2.0
+    assert pool_pressure(1, 0, 0, 100) == 1.0  # shed term saturates
+    assert pool_pressure(0, 0, 0, 0) == 0.0  # empty pool: no div-by-zero
+
+
+def test_prefill_storm_cannot_spawn_decode_replicas():
+    """The satellite bugfix, end to end: two role pools, two controllers,
+    a storm on the prefill pool only. The prefill controller spawns; the
+    decode controller — reading only its own pool's signals — stays put."""
+    clk = _Clock()
+    spawned = {"prefill": 0, "decode": 0}
+    pools = {}
+    ctrls = {}
+    for role in ("prefill", "decode"):
+        pools[role] = ReplicaSet([_LoadStub()], role=role)
+
+        def factory(role=role):
+            spawned[role] += 1
+            return _LoadStub()
+
+        ctrls[role] = FleetAutoscaler(
+            pools[role], factory, max_replicas=3, clock=clk,
+            scale_up_sustain_s=5.0, cooldown_s=0.0, enable_brownout=False,
+        )
+        assert ctrls[role].state()["role"] == role
+    # storm hits ONLY the prefill pool
+    pools["prefill"].replicas[0].load = (1, 1, 4)  # pressure 5.0
+    for ctrl in ctrls.values():
+        ctrl.tick()  # anchors each sustain window
+    clk.t += 5.0
+    assert ctrls["prefill"].tick()["action"] == "spawn"
+    assert ctrls["decode"].tick()["action"] is None
+    assert spawned == {"prefill": 1, "decode": 0}
+    assert pools["prefill"].fleet_stats()["size"] == 2
+    assert pools["decode"].fleet_stats()["size"] == 1
+    for pool in pools.values():
+        pool.close()
+
+
+# --------------------------------------------------------- observability
+@hard_timeout(120)
+def test_metrics_render_role_labels_and_handoff_counters(disagg_setup):
+    """/metrics through the coordinator: role-labeled fleet and replica
+    gauges plus the mst_disagg_handoff_* family; the monolithic render
+    (test_fleet) stays unlabeled — both shapes coexist scrape-side."""
+    co, _ = disagg_setup
+    # ensure at least one handoff and one counted fallback are on the books
+    faults.arm("disagg.handoff", exc=faults.FaultError, times=1)
+    list(co.generate_step(*JOBS[0][:1], **JOBS[0][1]))
+    faults.disarm()
+    list(co.generate_step(*JOBS[0][:1], **JOBS[0][1]))
+    text = ServingMetrics(batcher_fn=lambda: co).render()
+    assert 'mst_fleet_size{role="prefill"} 1' in text
+    assert 'mst_fleet_size{role="decode"} 1' in text
+    assert 'mst_replica_inflight{replica="0",role="prefill"} 0' in text
+    assert 'mst_replica_inflight{replica="0",role="decode"} 0' in text
+    assert "mst_disagg_handoff_total " in text
+    assert "mst_disagg_handoff_bytes_total " in text
+    assert 'mst_disagg_handoff_ms{quantile="0.5"}' in text
+    assert 'mst_disagg_fallbacks_total{kind="handoff_fault"} ' in text
+
+
+# ------------------------------------------------------- heavy parity
+@pytest.mark.slow
+@hard_timeout(300)
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_disagg_parity_matrix(tiny_model, kv_dtype):
+    """Acceptance matrix: fp32 AND int8 KV pools, sequential AND
+    concurrent, greedy AND seeded — every disagg stream bit-identical to
+    the monolithic batcher with the same pool dtype (the monolithic run
+    is the only valid baseline for a quantized pool; see
+    test_kv_transfer's matrix note)."""
+    mono = _mk_batcher(tiny_model, 3, kv_dtype=kv_dtype)
+    co = DisaggCoordinator(
+        ReplicaSet([_mk_batcher(tiny_model, 4, kv_dtype=kv_dtype)],
+                   role="prefill"),
+        ReplicaSet([_mk_batcher(tiny_model, 5, kv_dtype=kv_dtype)],
+                   role="decode"),
+    )
+    try:
+        refs = _refs(mono, JOBS)
+        assert _refs(co, JOBS) == refs
+        assert run_concurrent(co, JOBS) == refs
+        assert co.handoff_stats()["fallbacks"] == {}
+        assert co.handoff_stats()["handoffs"] == 4  # 2 per pass
+    finally:
+        co.close()
+        mono.close()
+
+
+@pytest.mark.slow
+@hard_timeout(300)
+def test_fault_sweep_under_concurrency_zero_dropped_streams(disagg_setup):
+    """Every handoff-path fault armed across a concurrent burst: streams
+    all complete with exact content — the degradation ladder never drops
+    one — and the fallback counters account for each armed fault."""
+    co, mono = disagg_setup
+    jobs = [JOBS[0], JOBS[1]] * 2
+    refs = _refs(mono, jobs)
+    for site, kw in [
+        ("disagg.handoff", dict(exc=faults.FaultError, times=2)),
+        ("cache.export", dict(exc=faults.FaultError, times=2)),
+        ("cache.import", dict(exc=faults.FaultError, times=1)),
+    ]:
+        before = sum(co.handoff_stats()["fallbacks"].values())
+        faults.arm(site, **kw)
+        assert run_concurrent(co, jobs) == refs
+        faults.disarm()
+        if site == "disagg.handoff":
+            after = sum(co.handoff_stats()["fallbacks"].values())
+            assert after == before + 2  # both armed firings serve in place

@@ -35,6 +35,7 @@ def test_expert_parallel_rejects_uneven():
         expert_parallel_apply(x, w, idx, wg, wg, jnp.zeros((6, 8, 8)), mesh)
 
 
+@pytest.mark.slow  # fused-engine sweep — pp1_ep2 continuous batching stays quick
 def test_mixtral_fused_engine_with_ep():
     """EP inside the MODEL FORWARD: Mixtral's expert stacks shard over the
     ep mesh axis within the fused engine (each device computes its resident

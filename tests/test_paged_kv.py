@@ -147,6 +147,7 @@ def test_oversized_request_rejected():
         batcher.close()
 
 
+@pytest.mark.slow  # waiting-line policy sweep — fifo pressure test stays quick
 def test_first_fit_overtakes_blocked_head():
     """first_fit: while a big request occupies most of the pool, a waiting
     BIG request blocks a fifo line but a later small one may be admitted

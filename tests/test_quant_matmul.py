@@ -289,6 +289,7 @@ def test_keep_quantized_native_checkpoint_rejected(tmp_path):
         load_model(str(d), dtype=jnp.float32, keep_quantized=True)
 
 
+@pytest.mark.slow  # chained variant — fused-pipeline + tp keep the quick signal
 def test_keep_quantized_chained_pipeline(tmp_path):
     """--engine chained with --keep-quantized: every stage loads packed."""
     from mlx_sharding_tpu.parallel.chained import load_chained_pipeline

@@ -399,6 +399,7 @@ def spec_setup():
     batcher.close()
 
 
+@pytest.mark.slow  # spec greedy exactness also pinned quick by test_speculative
 def test_spec_cb_greedy_token_exact(spec_setup):
     """Speculative continuous batching emits exactly the tokens plain
     (non-speculative) greedy decode would, for every interleaved request —
@@ -563,6 +564,7 @@ def test_prefix_cache_eviction_and_no_leaks():
         batcher.close()
 
 
+@pytest.mark.slow  # eviction-pressure sweep — the other prefix tests stay quick
 def test_prefix_cache_own_chain_not_evicted_under_pressure():
     """Regression: when the only evictable cached pages ARE the incoming
     request's prefix chain, the request must wait for capacity, not evict

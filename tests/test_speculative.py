@@ -115,6 +115,7 @@ def test_logprobs_requests_fall_back(pair):
 # all-accept behavior for a perfect draft, and per-seed determinism.
 
 
+@pytest.mark.slow  # statistical distribution check — greedy exactness stays quick
 def test_rejection_round_emits_target_distribution():
     """The Leviathan et al. identity, tested on the pure round function:
     whatever q is, the slot-0 emitted token is distributed exactly as p."""

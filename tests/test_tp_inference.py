@@ -112,6 +112,9 @@ def _deepseek(mla_cache_mode, q_lora_rank=None):
     return model, model.init_params(jax.random.PRNGKey(1), jnp.float32)
 
 
+# rides the slow tier: heavy cross-config sweep — mixtral pp2xtp2/tp2xep2
+# and the deepseek pp2 chained test keep the quick composition signal
+@pytest.mark.slow
 @pytest.mark.parametrize("cache_mode", ["decompressed", "compressed"])
 def test_deepseek_pp2_tp2_matches_single_device(cache_mode):
     """MLA TP: per-head q/kv_b/o shard over tp around the replicated
@@ -128,6 +131,7 @@ def test_deepseek_pp2_tp2_matches_single_device(cache_mode):
     assert [t for t, _ in eng.generate_step(prompt, max_tokens=8)] == want
 
 
+@pytest.mark.slow  # tp x ep composition stays quick via the mixtral variant
 def test_deepseek_tp2_ep2_matches_single_device():
     """tp x ep composition: expert stacks shard over ep (the engine's merge
     lets ep override tp for those stacks), attention + shared experts shard
