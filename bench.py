@@ -1220,6 +1220,204 @@ def measure_kv_int8_vs_bf16(model, params, label: str) -> dict:
     return res
 
 
+def measure_kv_capacity_frontier(model, params, label: str) -> dict:
+    """Capacity frontier at fixed pool bytes (proactive-KV-residency
+    tentpole): how many concurrent streaming sessions one pool budget can
+    keep alive. Three configs at (no more than) the same pool bytes — bf16,
+    int8 (~2D/(D+4)x the pages), and int8 + cold-slot spill — are each
+    driven by 12 one-page sessions whose consumers stall after the first
+    token: the idle-chat shape cold detection targets. A no-spill pool caps
+    live sessions at its page count; the spill config parks cold slots
+    (pages released, block flushed to host DRAM) so live = resident +
+    parked climbs to the whole session set. Live count is sampled from
+    public gauges only (pages-in-use + parked; sessions are one page each
+    by construction, prefix cache off). A second pass records the resume
+    path A/B — wake-to-completion wall and the tick's kv_import stall with
+    prefetch staging on vs off; on CPU the counters (prefetch_hits vs
+    demand_imports) are the evidence, the milliseconds only illustrate."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    d = model.config.head_dim
+    page_size = 128
+    pages_bf16 = 4
+    pages_int8 = int(pages_bf16 * (2 * d) / (d + 4))
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(17)
+    sessions = 12
+    prompts = [
+        [int(x) for x in rng.integers(1, vocab - 64, 8)]
+        for _ in range(sessions)
+    ]
+    spill_kw = dict(spill_bytes=256 << 20, spill_cold_after=2,
+                    kv_prefetch="on")
+
+    def _join_all(threads, budget_s):
+        end = time.monotonic() + budget_s
+        for t in threads:
+            t.join(timeout=max(0.0, end - time.monotonic()))
+
+    def run(kv_dtype: str, pool_pages: int, spill: bool) -> dict:
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1), microbatches=8,
+            max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+            pool_pages=pool_pages, page_size=page_size, kv_dtype=kv_dtype,
+        )
+        batcher = ContinuousBatcher(
+            eng, decode_block=8, **(spill_kw if spill else {})
+        )
+        stall = threading.Event()
+        started = [0]
+        lock = threading.Lock()
+
+        def consume(p):
+            # prompt 8 + max_tokens 112 < page_size: a one-page session in
+            # reserve-mode admission, long enough not to retire mid-window
+            gen = batcher.generate_step(p, max_tokens=page_size - 16)
+            try:
+                next(gen)  # first token: the session is live
+                with lock:
+                    started[0] += 1
+                stall.wait()  # idle mid-stream; backlog builds
+            finally:
+                gen.close()  # cancel — the resume path is measured below
+
+        threads = [
+            threading.Thread(target=consume, args=(p,), daemon=True)
+            for p in prompts
+        ]
+        try:
+            for _ in batcher.generate_step(prompts[0], max_tokens=8):
+                pass  # compile prefill + the 8-slot decode block
+            for t in threads:
+                t.start()
+            peak = 0
+            last_gain = time.monotonic()
+            deadline = last_gain + 30.0
+            while time.monotonic() < deadline:
+                s = batcher.spill_stats() or {}
+                _, in_use, _ = batcher.page_stats()
+                live = in_use + int(s.get("parked", 0))
+                if live > peak:
+                    peak, last_gain = live, time.monotonic()
+                if peak >= sessions or time.monotonic() - last_gain > 3.0:
+                    break
+                time.sleep(0.002)
+            s = batcher.spill_stats() or {}
+            pool_bytes = sum(
+                leaf.nbytes for leaf in
+                jax.tree.leaves((batcher.cache.k, batcher.cache.v))
+            )
+            stall.set()
+            # consumers still waiting for admission stay blocked on their
+            # first token until close() feeds them the shutdown sentinel
+            _join_all(threads, 5.0)
+        finally:
+            batcher.close()
+        _join_all(threads, 30.0)
+        return dict(
+            kv_dtype=kv_dtype, pool_pages=pool_pages,
+            pool_bytes=int(pool_bytes), peak_live_sessions=peak,
+            sessions_started=started[0],
+            cold_spills=int(s.get("cold_spills", 0)),
+            parked=int(s.get("parked", 0)),
+        )
+
+    def run_resume(kv_prefetch: str) -> dict:
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1), microbatches=2,
+            max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+            pool_pages=pages_int8, page_size=page_size, kv_dtype="int8",
+        )
+        batcher = ContinuousBatcher(
+            eng, decode_block=8, **dict(spill_kw, kv_prefetch=kv_prefetch)
+        )
+
+        def cycle(p) -> float:
+            # one full park/resume round trip: stall until cold-spilled AND
+            # host-flushed, then release and time wake -> stream complete
+            stall = threading.Event()
+            done = [0.0]
+
+            def consume():
+                gen = batcher.generate_step(p, max_tokens=48)
+                next(gen)
+                stall.wait()
+                for _ in gen:
+                    pass  # drain the backlog; wake, import, finish
+                done[0] = time.perf_counter()
+
+            th = threading.Thread(target=consume, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                s = batcher.spill_stats() or {}
+                if s.get("parked", 0) > 0 and s.get("blocks_host", 0) > 0:
+                    break  # parked AND host-flushed: a true cold resume
+                time.sleep(0.002)
+            t0 = time.perf_counter()
+            stall.set()
+            th.join(timeout=60)
+            return (done[0] - t0) * 1000.0
+
+        try:
+            for _ in batcher.generate_step(prompts[0], max_tokens=8):
+                pass  # compile
+            cycle(prompts[1])  # warm the wake/import programs (first jit)
+            s0 = batcher.spill_stats() or {}
+            wall_ms = cycle(prompts[2])
+            s = batcher.spill_stats() or {}
+            t = batcher.tick_timing_stats()
+            return dict(
+                kv_prefetch=kv_prefetch,
+                resume_wall_ms=round(wall_ms, 1),
+                kv_import_ms_last=round(t.get("kv_import_ms_last", 0.0), 3),
+                cold_wakes=int(s.get("cold_wakes", 0) - s0.get("cold_wakes", 0)),
+                prefetch_hits=int(
+                    s.get("prefetch_hits", 0) - s0.get("prefetch_hits", 0)),
+                demand_imports=int(
+                    s.get("demand_imports", 0) - s0.get("demand_imports", 0)),
+                prefetch_faults=int(
+                    s.get("prefetch_faults", 0) - s0.get("prefetch_faults", 0)),
+            )
+        finally:
+            batcher.close()
+
+    bf16 = run("bf16", pages_bf16, spill=False)
+    int8 = run("int8", pages_int8, spill=False)
+    spill = run("int8", pages_int8, spill=True)
+    resume_pf = run_resume("on")
+    resume_dm = run_resume("off")
+    res = dict(
+        label=label, sessions=sessions, bf16=bf16, int8=int8,
+        int8_cold_spill=spill,
+        frontier_vs_bf16=round(
+            spill["peak_live_sessions"]
+            / max(bf16["peak_live_sessions"], 1), 2),
+        int8_vs_bf16=round(
+            int8["peak_live_sessions"]
+            / max(bf16["peak_live_sessions"], 1), 2),
+        resume_prefetch=resume_pf, resume_demand=resume_dm,
+    )
+    log(f"[{label}] live sessions at fixed pool bytes: "
+        f"bf16={bf16['peak_live_sessions']} "
+        f"int8={int8['peak_live_sessions']} "
+        f"int8+cold-spill={spill['peak_live_sessions']} "
+        f"({res['frontier_vs_bf16']}x vs bf16); resume "
+        f"prefetch={resume_pf['resume_wall_ms']}ms "
+        f"(hits={resume_pf['prefetch_hits']}) vs "
+        f"demand={resume_dm['resume_wall_ms']}ms "
+        f"(demand={resume_dm['demand_imports']})")
+    return res
+
+
 def measure_overload_shedding(model, params, label: str) -> dict:
     """Goodput under 2x oversubscription (resilience tentpole). A 2-slot
     batcher with a 2-deep admission queue (capacity 4 in flight) is hit by
@@ -1712,6 +1910,7 @@ def main() -> int:
             # int8-KV equal-memory A/B: needs head_dim >= 64 for its
             # capacity claim (the ratio is 2D/(D+4): D=32 caps at 1.78x,
             # D=64 gives 1.88x), so this phase gets its own tiny variant
+            m3 = p3 = None
             try:
                 tiny64 = dict(tiny2, num_attention_heads=2,
                               num_key_value_heads=2, head_dim=64)
@@ -1725,6 +1924,20 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 detail["kv_int8_vs_bf16_cpu"] = dict(error=repr(e)[:300])
                 log(f"[kv_int8_vs_bf16_cpu] FAILED: {e!r}")
+            # the capacity frontier rides the same head_dim-64 variant:
+            # its equal-byte int8 page math needs D >= 64 too
+            if m3 is not None:
+                try:
+                    detail["kv_capacity_frontier_cpu"] = (
+                        measure_kv_capacity_frontier(
+                            m3, p3, "kv_capacity_frontier_cpu"
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001
+                    detail["kv_capacity_frontier_cpu"] = dict(
+                        error=repr(e)[:300]
+                    )
+                    log(f"[kv_capacity_frontier_cpu] FAILED: {e!r}")
 
     if not cpu_fallback:
         n_params = param_count(cfg_dict)

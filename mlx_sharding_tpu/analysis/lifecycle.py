@@ -39,8 +39,9 @@ KNOWN_FAULT_SITES = {
     "scheduler.tick", "scheduler.harvest", "replica.dispatch",
     "multihost.exchange", "server.sse_write",
     # KV migration (kv_transfer.py): block export at preemption/drain,
-    # block import at resume, and the replica drain entry point
-    "cache.export", "cache.import", "replica.drain",
+    # block import at resume, the overlapped prefetch stage, and the
+    # replica drain entry point
+    "cache.export", "cache.import", "cache.prefetch", "replica.drain",
     # elastic fleet (fleet.py): autoscaler control tick and the
     # ReplicaFactory spawn call — both must degrade to the static fleet
     "autoscaler.tick", "replica.spawn",

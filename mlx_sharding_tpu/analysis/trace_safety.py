@@ -56,6 +56,19 @@
   synchronous *pull* of an exported block; this rule catches the migration
   call itself, which stalls the tick even when dispatch-only (tree flatten
   + jit argument marshalling per page chain).
+- **MST109 demand-paged-import-in-tick** — an upload call
+  (``jax.device_put`` / ``jnp.asarray`` / ``jnp.array``) inside a tick-hot
+  function whose argument touches a spilled block's host pages
+  (``.k_pages``/``.v_pages``, or a name fetched from a spill tier via
+  ``.take()``/``.peek()``). That is the demand-paged resume: the tick
+  blocks while a request's whole page chain marshals host→device, stalling
+  every live slot's decode for a copy that could have been in flight
+  already. The residency discipline is PRESERVE-style: stage the block
+  with ``KVPageBlock.prefetch()`` from the (non-hot) wake/admission policy
+  pass when the slot is scheduled to rejoin — the copy overlaps the
+  current decode block's compute — and keep demand import as a counted
+  off-tick fallback. An MST102/MST106 suppression nearby does NOT cover
+  this rule.
 - **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
   timeout arithmetic (an expression whose identifiers mention deadline /
   timeout / expiry / until / budget / ttft / retry_after / lease). The wall
@@ -119,6 +132,15 @@ SPILL_PRODUCER_PREFIXES = ("export_block", "export_pool_pages")
 # the block-migration primitives MST108 keeps out of tick-hot functions:
 # whole-request page-chain gathers/scatters (kv_transfer.py)
 MIGRATION_CALLS = {"export_block", "import_block"}
+
+# host→device upload calls MST109 polices in tick-hot functions when their
+# argument is a spilled block's page payload (the demand-paged resume)
+UPLOAD_CALLS = {"jax.device_put", "jnp.asarray", "jnp.array",
+                "jax.numpy.asarray", "jax.numpy.array"}
+# attribute names that identify a KVPageBlock's page payload, and the spill
+# tier lookups whose results MST109 tracks as block-bearing names
+BLOCK_PAGE_ATTRS = {"k_pages", "v_pages"}
+TIER_LOOKUP_ATTRS = {"take", "peek"}
 
 # decode-hot roots checked by MST105 (beyond '# mst: decode-hot'
 # annotations): every packed decode matmul funnels through these
@@ -493,6 +515,56 @@ def _dynamic_shape(expr: ast.AST) -> bool:
     return False
 
 
+def _check_sync_import(mod: ModuleInfo) -> list[Finding]:
+    """MST109: a demand-paged KV block upload inside a tick-hot function.
+    Matches an ``UPLOAD_CALLS`` call whose argument subtree touches a
+    block's page payload (``.k_pages``/``.v_pages``) or a name assigned
+    from a spill-tier lookup (``.take()``/``.peek()``) earlier in the same
+    function — the resume discipline is prefetch-on-schedule (overlapped
+    with decode), demand import only as a counted off-tick fallback."""
+    findings = []
+    for fn in _hot_functions(mod):
+        block_names: set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in TIER_LOOKUP_ATTRS):
+                for t in node.targets:
+                    tname = dotted_name(t)
+                    if tname:
+                        block_names.add(tname.split(".")[-1])
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in UPLOAD_CALLS:
+                continue
+            touches_block = any(
+                (isinstance(sub, ast.Attribute)
+                 and sub.attr in BLOCK_PAGE_ATTRS)
+                or (isinstance(sub, ast.Name) and sub.id in block_names)
+                for arg in node.args
+                for sub in ast.walk(arg)
+            )
+            if touches_block:
+                findings.append(Finding(
+                    "MST109", mod.display_path, node.lineno, node.col_offset,
+                    f"demand-paged KV import in hot path {fn.name}(): "
+                    f"{name}() marshals a spilled block's host pages inline, "
+                    "stalling every live slot's decode for the full "
+                    "host→device copy — stage the block with "
+                    "KVPageBlock.prefetch() when the slot is scheduled to "
+                    "rejoin (the copy overlaps the current block's compute) "
+                    "and keep demand import off the tick as a counted "
+                    "fallback",
+                    context=qualname_for_line(mod.tree, node.lineno),
+                ))
+    return findings
+
+
 def _check_recompile_hazards(mod: ModuleInfo) -> list[Finding]:
     jitted = _jitted_names(mod.tree)
     if not jitted:
@@ -586,6 +658,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_double_harvest(mod)
     findings += _check_sync_spill(mod)
     findings += _check_block_migration(mod)
+    findings += _check_sync_import(mod)
     findings += _check_recompile_hazards(mod)
     findings += _check_dense_dequant(mod, table)
     findings += _check_wall_clock_deadlines(mod)

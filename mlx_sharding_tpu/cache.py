@@ -168,7 +168,14 @@ def import_pool_pages(
     arrays from a spilled block or device arrays from a live one; dtypes
     are cast to the pool's (a bf16→bf16 or int8→int8 identity in
     practice — cross-mode imports are rejected before this call by
-    ``KVPageBlock.compatible_with``)."""
+    ``KVPageBlock.compatible_with``).
+
+    Residency note: when the leaves are host numpy, the ``jnp.asarray``
+    below IS the demand-paged host→device marshal — the stall the
+    scheduler's prefetch path avoids by handing this function
+    ``KVPageBlock.payload()`` device arrays staged ahead of the resume
+    tick (then the asarray is an identity and the jitted scatter runs
+    against buffers already on device)."""
 
     def put(pool, blk):
         return pool.at[:, :, page_ids].set(jnp.asarray(blk).astype(pool.dtype))

@@ -31,6 +31,16 @@ full-block ``device_get`` in a tick-hot function is an mstcheck violation
 (MST106). Drain is the one exception: it runs quiesced, off the decode
 loop, where a blocking copy is shutdown-grade work.
 
+The return trip is symmetric: when the scheduler knows a spilled block is
+about to rejoin decode (a cold slot's consumer caught up, a preempted
+request reached the head of the waiting line), it calls
+:meth:`KVPageBlock.prefetch` — a dispatch-only ``jax.device_put`` of the
+host payload, so the host→device DMA overlaps the decode block already in
+flight and the admission-time page scatter consumes device-resident
+arrays. Without the prefetch, the scatter marshals host numpy at import
+time — the demand-paged resume stall mstcheck's MST109 polices in
+tick-hot code.
+
 Failure degradation: every consumer treats a failed export/import (fault
 sites ``cache.export`` / ``cache.import``, corrupt block checksum, budget
 or pool exhaustion) as "fall back to yesterday's behavior" — fold the
@@ -100,6 +110,9 @@ class KVPageBlock:
     resume_recent: object    # repetition-penalty recent window at export
     checksum: Optional[str] = None
     _host: bool = False
+    # device-resident (k_pages, v_pages) staged by prefetch(); consumed by
+    # payload() at import so the scatter never marshals host numpy
+    _staged: Optional[tuple] = None
     _lock: object = field(default_factory=lambda: make_lock("KVPageBlock._lock"), repr=False)
 
     @property
@@ -118,6 +131,46 @@ class KVPageBlock:
     @property
     def is_host(self) -> bool:
         return self._host  # mst: allow(MST201): monotonic flag; to_host is idempotent on a racy False
+
+    @property
+    def is_prefetched(self) -> bool:
+        return self._staged is not None  # mst: allow(MST201): racy read is gauge-grade; importers re-read under payload()'s lock
+
+    def prefetch(self, put=None) -> "KVPageBlock":
+        """Stage the host-resident page payload back onto the device ahead
+        of a scheduled import (the PRESERVE-style overlap, arXiv:2501.08192):
+        ``jax.device_put`` only DISPATCHES the host→device DMA, so the copy
+        rides alongside the decode block in flight and the admission-time
+        page scatter consumes already-device-resident arrays. Idempotent; a
+        block the flusher hasn't copied to host yet needs no staging (its
+        payload never left the device). Fault site ``cache.prefetch`` models
+        a failed/refused stage — callers catch, count, and degrade to the
+        demand import (then to re-prefill), never a dropped stream."""
+        inject("cache.prefetch", n_bytes=self.nbytes)
+        putfn = put if put is not None else jax.device_put
+        with self._lock:
+            if not self._host or self._staged is not None:
+                return self
+            self._staged = (
+                jax.tree.map(putfn, self.k_pages),
+                jax.tree.map(putfn, self.v_pages),
+            )
+        return self
+
+    def payload(self) -> tuple:
+        """``(k_pages, v_pages)`` for the import scatter: the prefetch-staged
+        device copies when present, else the raw payload (host numpy after a
+        flush — the demand path — or still-device arrays before one)."""
+        with self._lock:
+            if self._staged is not None:
+                return self._staged
+            return self.k_pages, self.v_pages
+
+    def drop_prefetch(self) -> None:
+        """Release staged device copies — a block leaving this engine
+        (cross-replica migration) must not pin another mesh's buffers."""
+        with self._lock:
+            self._staged = None
 
     def to_host(self) -> "KVPageBlock":
         """Materialize the page payloads in host DRAM and stamp the
@@ -261,7 +314,10 @@ def import_block(cache, block: KVPageBlock, page_ids, *, scatter=None, put=None)
     if put is not None:
         ids = put(ids)
     fn = scatter if scatter is not None else import_pool_pages
-    return fn(cache, block.k_pages, block.v_pages, ids)
+    # prefetch-staged device copies when present (the overlapped path);
+    # otherwise the raw payload — host numpy here IS the demand import
+    k_pages, v_pages = block.payload()
+    return fn(cache, k_pages, v_pages, ids)
 
 
 class KVSpillTier:
@@ -286,7 +342,18 @@ class KVSpillTier:
         self._bytes = 0
         self._lock = make_lock("KVSpillTier._lock")
         self.evictions = 0
+        # rejects split by reason (the aggregate stays for back-compat):
+        # oversize = the block alone exceeds the whole budget; closed = a
+        # put raced the tier's shutdown
         self.rejects = 0
+        self.rejects_oversize = 0
+        self.rejects_closed = 0
+        # take() outcomes: a hit hands the resume its block (one scatter
+        # instead of a re-prefill), a miss means LRU pressure evicted it
+        # since the spill — the caller re-prefills. hit_rate in stats() is
+        # hits / (hits + misses).
+        self.hits = 0
+        self.misses = 0
         self.bytes_spilled_total = 0
         self._flush_async = flush_async
         self._flush_q: "queue.Queue" = queue.Queue()
@@ -322,8 +389,13 @@ class KVSpillTier:
         exceeds the budget or the tier is closed."""
         nb = block.nbytes
         with self._lock:
-            if self._stopped or nb > self.budget_bytes:
+            if self._stopped:
                 self.rejects += 1
+                self.rejects_closed += 1
+                return False
+            if nb > self.budget_bytes:
+                self.rejects += 1
+                self.rejects_oversize += 1
                 return False
             old = self._blocks.pop(key, None)
             if old is not None:
@@ -343,24 +415,45 @@ class KVSpillTier:
             block.to_host()
         return True
 
-    def take(self, key) -> Optional[KVPageBlock]:
-        """Remove and return ``key``'s block, or None if it was evicted."""
+    def _pop(self, key) -> Optional[KVPageBlock]:
+        # caller-agnostic removal: no hit/miss accounting (drop() uses it
+        # for cancelled streams, which are neither)
         with self._lock:
             blk = self._blocks.pop(key, None)
             if blk is not None:
                 self._bytes -= blk.nbytes
             return blk
 
+    def take(self, key) -> Optional[KVPageBlock]:
+        """Remove and return ``key``'s block for a resume, or None if LRU
+        pressure evicted it since the spill; counts the hit/miss."""
+        blk = self._pop(key)
+        with self._lock:
+            if blk is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+        return blk
+
     def peek(self, key) -> Optional[KVPageBlock]:
         with self._lock:
             return self._blocks.get(key)
+
+    def touch(self, key) -> None:
+        """LRU refresh without removal — the scheduler calls this when a
+        spilled request is back in the resume path (head of the waiting
+        line, a cold slot's consumer caught up), so budget pressure evicts
+        some genuinely-cold block instead of the one about to re-import."""
+        with self._lock:
+            if key in self._blocks:
+                self._blocks.move_to_end(key)
 
     def contains(self, key) -> bool:
         with self._lock:
             return key in self._blocks
 
     def drop(self, key) -> None:
-        self.take(key)
+        self._pop(key)
 
     def clear(self) -> None:
         with self._lock:
@@ -369,12 +462,24 @@ class KVSpillTier:
 
     def stats(self) -> dict:
         with self._lock:
+            lookups = self.hits + self.misses
             return {
                 "budget_bytes": self.budget_bytes,
                 "bytes_in_use": self._bytes,
                 "blocks": len(self._blocks),
+                # blocks the flusher has host-materialized so far — the
+                # prefetchable population (a still-device block needs no
+                # staging); also what lets tests wait out the async flush
+                "blocks_host": sum(
+                    1 for b in self._blocks.values() if b.is_host
+                ),
                 "evictions": self.evictions,
                 "rejects": self.rejects,
+                "rejects_oversize": self.rejects_oversize,
+                "rejects_closed": self.rejects_closed,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / lookups) if lookups else 0.0,
                 "bytes_spilled_total": self.bytes_spilled_total,
             }
 

@@ -136,6 +136,12 @@ class _Request:
     # disaggregated serving: emit the first token, then end the stream
     # with HandoffReadyError(ResumeState) instead of entering decode
     prefill_only: bool = False
+    # cold-slot detection scratch: consumed tokens observed at the last
+    # recency scan (produced - out.qsize()) and ticks the count has been
+    # stagnant with a backlog — the consumer stopped pulling, the engine
+    # keeps decoding for nobody
+    _consumed_seen: int = 0
+    _cold_ticks: int = 0
 
 
 @dataclass
@@ -209,7 +215,9 @@ class ContinuousBatcher:
                  policy: str = "fifo", prefix_cache: bool = False,
                  overcommit: bool = False, draft_engine=None, spec_k: int = 4,
                  max_queue: Optional[int] = None, async_sched: str = "auto",
-                 spill_bytes: Optional[int] = None):
+                 spill_bytes: Optional[int] = None,
+                 spill_cold_after: Optional[int] = None,
+                 kv_prefetch: str = "auto"):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
         if max_queue is not None and (not isinstance(max_queue, int) or max_queue < 1):
@@ -291,6 +299,35 @@ class ContinuousBatcher:
                     "KV spill is incompatible with a draft engine — "
                     "speculative slots re-prefill on preemption"
                 )
+        if spill_cold_after is not None:
+            if isinstance(spill_cold_after, bool) \
+                    or not isinstance(spill_cold_after, int) \
+                    or spill_cold_after < 1:
+                raise ValueError(
+                    f"spill_cold_after must be an int >= 1 (ticks), got "
+                    f"{spill_cold_after!r}"
+                )
+            if spill_bytes is None:
+                raise ValueError(
+                    "spill_cold_after needs a spill tier to spill into — "
+                    "set spill_bytes (--spill-bytes)"
+                )
+            if jax.process_count() > 1:
+                # same host-side page-table rewrite problem as overcommit:
+                # a rank-local cold spill would desync mirrored op streams
+                raise ValueError(
+                    "cold-slot spill is not supported in multi-host serving"
+                )
+        if kv_prefetch not in ("on", "off", "auto"):
+            raise ValueError(
+                f"kv_prefetch must be 'on', 'off' or 'auto', got "
+                f"{kv_prefetch!r}"
+            )
+        if kv_prefetch == "on" and spill_bytes is None:
+            raise ValueError(
+                "kv_prefetch='on' needs a spill tier to prefetch from — "
+                "set spill_bytes (--spill-bytes)"
+            )
         if async_sched not in ("on", "off", "auto"):
             raise ValueError(
                 f"async_sched must be 'on', 'off' or 'auto', got {async_sched!r}"
@@ -421,6 +458,28 @@ class ContinuousBatcher:
         self.migrations_in = 0     # resumed requests accepted via _resume
         self.handoffs_out = 0      # prefill-only requests handed to decode
         self.reprefill_tokens = 0  # tokens re-prefilled after discard paths
+        # Proactive KV residency (cold-slot spill + PRESERVE-style
+        # prefetch). A slot whose consumer stopped pulling tokens for
+        # spill_cold_after ticks (backlog stagnant — the engine keeps
+        # decoding, nobody reads) is suspended: its block spills to the
+        # tier, its pool pages free up for admission, and the request
+        # parks off the waiting line until the consumer catches up. Wake
+        # re-queues it at the head; with prefetch on, the host→device
+        # stage is dispatched while it waits its turn, so the re-import
+        # scatter consumes device-resident pages instead of demand-paging
+        # host numpy on the resume tick (the stall MST109 polices).
+        self.spill_cold_after = spill_cold_after
+        self.kv_prefetch = kv_prefetch
+        self._prefetch_on = kv_prefetch == "on" or (
+            kv_prefetch == "auto" and self.spill is not None
+        )
+        self._parked: list[_Request] = []  # cold-spilled, off the waiting line
+        self.cold_spills = 0      # slots suspended by the cold policy
+        self.cold_wakes = 0       # parked requests re-queued on consumer pull
+        self.prefetches = 0       # host→device stages dispatched
+        self.prefetch_hits = 0    # imports that consumed a staged block
+        self.demand_imports = 0   # imports that marshaled host numpy (fallback)
+        self.prefetch_faults = 0  # cache.prefetch faults absorbed → demand path
         # prefill-only requests whose first token was emitted this tick;
         # _handoff_out exports them before the tick's decode dispatch
         self._handoff_ready: list = []
@@ -457,6 +516,12 @@ class ContinuousBatcher:
         self._tick_host_s_total = 0.0
         self._tick_blocked_s_total = 0.0
         self._tick_count = 0  # ticks that harvested a block
+        # time the tick spent inside import_block (device blocked on the
+        # resume path): ~0 when prefetch staged the pages, the full
+        # host→device marshal on a demand import — the number that makes
+        # resume stalls visible next to the async-sched gauges
+        self.tick_kv_import_ms_last = 0.0
+        self._tick_kv_import_s_total = 0.0
         # over-commit page growth must cover whichever step writes furthest
         # ahead: a decode block (1 write/step), TWO decode blocks when the
         # pipeline runs a block ahead of the host's emitted counts (at
@@ -835,12 +900,27 @@ class ContinuousBatcher:
                 "migrations_in": self.migrations_in,
                 "reprefill_tokens": self.reprefill_tokens,
                 "preemptions": self.preemptions,
+                # proactive residency: cold policy + prefetch counters
+                "cold_spills": self.cold_spills,
+                "cold_wakes": self.cold_wakes,
+                "parked": len(self._parked),
+                "prefetch_enabled": self._prefetch_on,
+                "prefetches": self.prefetches,
+                "prefetch_hits": self.prefetch_hits,
+                "demand_imports": self.demand_imports,
+                "prefetch_faults": self.prefetch_faults,
             }
         out["budget_bytes"] = tier.get("budget_bytes", 0)
         out["bytes_in_use"] = tier.get("bytes_in_use", 0)
         out["blocks"] = tier.get("blocks", 0)
+        out["blocks_host"] = tier.get("blocks_host", 0)
         out["evictions"] = tier.get("evictions", 0)
         out["rejects"] = tier.get("rejects", 0)
+        out["rejects_oversize"] = tier.get("rejects_oversize", 0)
+        out["rejects_closed"] = tier.get("rejects_closed", 0)
+        out["tier_hits"] = tier.get("hits", 0)
+        out["tier_misses"] = tier.get("misses", 0)
+        out["hit_rate"] = tier.get("hit_rate", 0.0)
         return out
 
     def health(self) -> dict:
@@ -900,6 +980,10 @@ class ContinuousBatcher:
             "host_ms_avg": 1000.0 * self._tick_host_s_total / n,
             "device_blocked_ms_avg": 1000.0 * self._tick_blocked_s_total / n,
             "ticks": self._tick_count,
+            # resume-path import stall (kv_import): ~0 when prefetch staged
+            # the pages, the full host→device marshal on a demand import
+            "kv_import_ms_last": self.tick_kv_import_ms_last,
+            "kv_import_s_total": self._tick_kv_import_s_total,
         }
 
     def reset_tick_timing(self):
@@ -912,6 +996,8 @@ class ContinuousBatcher:
         self._tick_host_s_total = 0.0
         self._tick_blocked_s_total = 0.0
         self._tick_count = 0
+        self.tick_kv_import_ms_last = 0.0
+        self._tick_kv_import_s_total = 0.0
 
     def _account_kv_read(self, live, steps: int, path: Optional[str] = None):
         if not self.paged or not live:
@@ -1224,10 +1310,27 @@ class ContinuousBatcher:
             pages = [self._free_pages.pop() for _ in range(need)]
             for p in pages:
                 self._page_ref[p] = 1
+            # residency accounting, read BEFORE the import consumes the
+            # stage: a host block with device-staged pages is the overlapped
+            # path (prefetch hit); host without a stage is the demand import
+            # this PR demotes to a counted fallback; a still-device block
+            # (flusher hasn't run) is neither
+            was_host = block.is_host
+            was_staged = block.is_prefetched
+            t0 = time.perf_counter()
             self.cache = import_block(
                 self.cache, block, pages[:data_pages],
                 scatter=self._import_pages, put=self._put,
             )
+            dt = time.perf_counter() - t0
+            self.tick_kv_import_ms_last = dt * 1e3
+            self._tick_kv_import_s_total += dt
+            if was_host:
+                with self._admission_lock:
+                    if was_staged:
+                        self.prefetch_hits += 1
+                    else:
+                        self.demand_imports += 1
         except Exception as e:
             logging.getLogger(__name__).debug(
                 "KV block import failed (falling back to re-prefill): %s", e
@@ -1546,18 +1649,18 @@ class ContinuousBatcher:
                 self.spill_fallbacks += 1
         return ok
 
-    def _preempt(self, req: _Request):
-        """Evict an admitted request back to the head of the waiting line,
-        releasing its pages. Mid-decode, its page chain is exported to the
-        spill tier when one is configured (resume re-imports it — one page
+    def _suspend_slot(self, req: _Request):
+        """Vacate ``req``'s slot, preserving everything a token-exact
+        resume needs. Mid-decode, its page chain is exported to the spill
+        tier when one is configured (resume re-imports it — one page
         scatter instead of a re-prefill); otherwise, or on export failure,
-        its emitted tokens fold into its prompt and resume re-prefills them.
-        Either way the device-side sampler state is stashed so the next
-        sampled token continues the exact PRNG/repetition chain.
-        Mid-prefill there is nothing to stash; the prefill restarts."""
+        its emitted tokens fold into its prompt and resume re-prefills
+        them. Either way the device-side sampler state is stashed so the
+        next sampled token continues the exact PRNG/repetition chain.
+        Mid-prefill there is nothing to stash; the prefill restarts.
+        Shared by overcommit preemption and cold-slot spill; the caller
+        decides where the request goes (waiting line vs parked list)."""
         slot = req.slot
-        with self._admission_lock:
-            self.preemptions += 1
         if self._prefill_done(req):
             # one transfer for both sampler rows; runs only quiesced (no
             # in-flight block) in async mode, so this sync is off the
@@ -1578,9 +1681,131 @@ class ContinuousBatcher:
         self._release_pages(slot)
         self._slots[slot] = None
         req.slot = -1
+
+    def _preempt(self, req: _Request):
+        """Evict an admitted request back to the head of the waiting line,
+        releasing its pages (over-commit pool exhaustion)."""
+        with self._admission_lock:
+            self.preemptions += 1
+        self._suspend_slot(req)
         # head of the waiting line: preemption goes newest-first, so
         # repeated inserts at 0 restore admission order among the victims
         self._waiting.insert(0, req)
+
+    # -------------------------------------------- proactive KV residency
+    def _cold_candidates(self) -> list:
+        """Recency scan: admitted decode slots whose consumer stopped
+        pulling. ``produced - out.qsize()`` is the consumed-token count; a
+        slot with a standing backlog whose count has not moved for
+        ``spill_cold_after`` consecutive scans is cold — the engine is
+        decoding tokens nobody reads, holding pool pages hotter streams
+        (or the waiting line) could use. Cheap host-only bookkeeping; runs
+        every tick from the (non-hot) policy helpers."""
+        if self.spill_cold_after is None or self.spill is None:
+            return []
+        cold = []
+        for req in self._slots:
+            if req is None or req.cancelled or req.prefill_only:
+                continue
+            if not self._prefill_done(req) or not req.history:
+                continue  # mid-prefill slots have nothing to spill
+            # mst: allow(MST201): qsize is advisory; a racy undercount just
+            # delays the cold verdict by one scan
+            backlog = req.out.qsize()
+            consumed = req.produced - backlog
+            if backlog > 0 and consumed == req._consumed_seen:
+                req._cold_ticks += 1
+            else:
+                req._cold_ticks = 0
+            req._consumed_seen = consumed
+            if req._cold_ticks >= self.spill_cold_after:
+                cold.append(req)
+        return cold
+
+    def _spill_cold(self, cold: list):
+        """Suspend cold slots and park them off the waiting line. Parked
+        requests hold no pool pages and don't count against admission —
+        their spilled bytes are reclaimed capacity until the consumer
+        catches up and :meth:`_wake_parked` re-queues them. Callers on the
+        async path quiesce first: suspension device_gets sampler rows and
+        rewrites page tables, which must not race an in-flight block."""
+        for req in cold:
+            with self._admission_lock:
+                self.cold_spills += 1
+            self._suspend_slot(req)
+            req._cold_ticks = 0
+            self._parked.append(req)
+
+    def _wake_parked(self):
+        """Re-queue parked requests whose consumer caught up (backlog
+        drained). Woken requests go to the HEAD of the waiting line — their
+        TTFT is long past, making them the oldest claim on capacity — and,
+        with prefetch on, their host→device stage is dispatched here so
+        the copy overlaps the decode blocks that run while they wait for a
+        slot. Cancelled parked requests are reaped in place."""
+        if not self._parked:
+            return
+        keep, woken = [], []
+        for req in self._parked:
+            if req.cancelled:
+                self._drop_spill(req)
+                req.out.put(None)
+                continue
+            # mst: allow(MST201): racy read only delays the wake one tick
+            if req.out.qsize() == 0:
+                woken.append(req)
+            else:
+                keep.append(req)
+        self._parked = keep
+        if not woken:
+            return
+        for req in woken:
+            req._cold_ticks = 0
+            self._prefetch_block(req)
+            with self._admission_lock:
+                self.cold_wakes += 1
+        self._waiting[:0] = woken
+
+    def _prefetch_block(self, req: _Request):
+        """Dispatch the host→device stage for ``req``'s spilled block (the
+        PRESERVE-style overlap): ``KVPageBlock.prefetch`` device_puts the
+        page arrays without blocking on them, so by the time admission
+        imports the block the scatter consumes device-resident pages. A
+        still-device block (flusher hasn't copied it out) needs no stage.
+        Faults on ``cache.prefetch`` are absorbed here — the block stays
+        host-resident and import falls back to the counted demand path."""
+        if not self._prefetch_on or self.spill is None or not req.spilled:
+            return
+        block = self.spill.peek(req)
+        if block is None:
+            return
+        self.spill.touch(req)  # about to re-import: don't LRU-evict it
+        if not block.is_host or block.is_prefetched:
+            return
+        try:
+            block.prefetch(put=self._put)
+            with self._admission_lock:
+                self.prefetches += 1
+        except Exception as e:
+            with self._admission_lock:
+                self.prefetch_faults += 1
+            logging.getLogger(__name__).debug(
+                "KV prefetch failed (degrading to demand import): %s", e
+            )
+
+    def _prefetch_waiting(self):
+        """Stage blocks for spilled requests near the head of the waiting
+        line (preemption victims about to be re-admitted), bounded so a
+        deep queue can't turn the policy pass into a copy storm."""
+        if not self._prefetch_on or self.spill is None:
+            return
+        budget = 2
+        for req in self._waiting[:4]:
+            if budget == 0:
+                break
+            if req.spilled and not req.cancelled:
+                self._prefetch_block(req)
+                budget -= 1
 
     def migrate_out(self, deadline: float = 30.0) -> int:
         """Gracefully evacuate every request (replica drain): the scheduler
@@ -1647,7 +1872,10 @@ class ContinuousBatcher:
         if admitted:
             self.active = self._zeros_like(self.active)
         self._drain_submissions()
-        for req in self._waiting:
+        # parked cold-spilled sessions migrate too: their tier blocks (or
+        # fold-history fallback) travel in the ResumeState like any
+        # spill-preempted waiter's
+        for req in self._waiting + self._parked:
             if req.cancelled:
                 self._drop_spill(req)
                 req.out.put(None)
@@ -1657,6 +1885,7 @@ class ContinuousBatcher:
             with self._admission_lock:
                 self.migrations_out += 1
         self._waiting.clear()
+        self._parked.clear()
 
     def _export_resume_state(self, req: _Request, slot: int,
                              keys_h, recent_h, *,
@@ -1701,6 +1930,9 @@ class ContinuousBatcher:
                     )
         if block is not None and host:
             try:
+                # staged prefetch copies pin THIS engine's device buffers;
+                # a block leaving the replica must not carry them
+                block.drop_prefetch()
                 block.to_host()  # the block must outlive this engine
             except Exception as e:
                 block = None
@@ -2031,6 +2263,10 @@ class ContinuousBatcher:
                 self.spill_fallbacks += 1
         need = self._need_pages(req)
         if req._block is not None or req.spilled:
+            if req.spilled:
+                # in the resume path: LRU-refresh the tier entry so budget
+                # pressure evicts a genuinely-cold block instead
+                self.spill.touch(req)
             # block import allocates its whole need fresh (no page sharing
             # with the prefix index), so the chain doesn't discount it
             req._chain = None
@@ -2056,9 +2292,14 @@ class ContinuousBatcher:
         # request, so worker mirrors never knew it existed.
         if self._waiting:
             now = time.monotonic()
+            # produced == 0 guard: a woken cold-spilled request is back on
+            # the line long after its first token was delivered — its TTFT
+            # budget is history, not a shed signal; dropping it here would
+            # kill a mid-stream session
             for req in [
                 r for r in self._waiting
-                if not r.cancelled and r.deadlines is not None
+                if not r.cancelled and r.produced == 0
+                and r.deadlines is not None
                 and r.deadlines.ttft_deadline is not None
                 and now > r.deadlines.ttft_deadline
             ]:
@@ -2166,6 +2407,14 @@ class ContinuousBatcher:
             return
         self._reap_cancelled()
         self._drain_submissions()
+        cold = self._cold_candidates()
+        if cold:
+            # suspension device_gets sampler rows and rewrites page tables:
+            # drain the lookahead block first
+            self._quiesce()
+            self._spill_cold(cold)
+        self._wake_parked()
+        self._prefetch_waiting()
         if (self._waiting and None in self._slots) or any(
             r is not None and not self._prefill_done(r) for r in self._slots
         ):
@@ -2202,8 +2451,10 @@ class ContinuousBatcher:
         else:
             self._quiesce()  # leftover lookahead block of finished slots
             if not any(self._slots):
-                # idle: block until the next request arrives
+                # idle: block until the next request arrives (bounded wait,
+                # so parked cold sessions still get their wake poll)
                 self._drain_submissions(block=True)
+                self._wake_parked()
                 self._admit_waiting()
 
     def _tick(self):
@@ -2225,6 +2476,11 @@ class ContinuousBatcher:
             return
         self._reap_cancelled()
         self._drain_submissions()
+        cold = self._cold_candidates()
+        if cold:
+            self._spill_cold(cold)  # sync mode: nothing in flight to drain
+        self._wake_parked()
+        self._prefetch_waiting()
         self._admit_waiting()
         prefilling = [
             r for r in self._slots
@@ -2249,8 +2505,10 @@ class ContinuousBatcher:
             else:
                 self._decode_once()
         elif not any(self._slots):
-            # idle: block until the next request arrives
+            # idle: block until the next request arrives (bounded wait,
+            # so parked cold sessions still get their wake poll)
             self._drain_submissions(block=True)
+            self._wake_parked()
             self._admit_waiting()
 
     def _fail_all(self, exc: BaseException):
@@ -2277,6 +2535,9 @@ class ContinuousBatcher:
         for req in self._waiting:
             req.out.put(exc)
         self._waiting.clear()
+        for req in self._parked:  # cold-spilled sessions die with the rest
+            req.out.put(exc)
+        self._parked.clear()
         while True:
             try:
                 req = self._submit.get_nowait()
@@ -2319,6 +2580,9 @@ class ContinuousBatcher:
         for req in self._waiting:
             req.out.put(None)
         self._waiting.clear()
+        for req in self._parked:  # parked streams end, like waiting ones
+            req.out.put(None)
+        self._parked.clear()
         while True:
             try:
                 req = self._submit.get_nowait()

@@ -122,6 +122,8 @@ class ModelProvider:
         admission_policy: str = "fifo",
         overcommit: bool = False,
         spill_bytes: Optional[int] = None,
+        spill_cold_after: Optional[int] = None,
+        kv_prefetch: str = "auto",
         draft_model: Optional[str] = None,
         spec_k: int = 4,
         prompt_cache: bool = False,
@@ -191,6 +193,10 @@ class ModelProvider:
         # (kv_transfer.KVSpillTier): resume re-imports instead of
         # re-prefilling; None = legacy discard preemption
         self.spill_bytes = spill_bytes
+        # proactive residency: spill slots whose consumer stopped pulling
+        # for N ticks, and stage re-imports ahead of the resume tick
+        self.spill_cold_after = spill_cold_after
+        self.kv_prefetch = kv_prefetch
         self.default_model = default_model
         self.start_layer = start_layer
         self.end_layer = end_layer
@@ -361,6 +367,8 @@ class ModelProvider:
                                 prefix_cache=self.prefix_cache_enabled,
                                 overcommit=self.overcommit,
                                 spill_bytes=self.spill_bytes,
+                                spill_cold_after=self.spill_cold_after,
+                                kv_prefetch=self.kv_prefetch,
                                 draft_engine=draft_eng,
                                 spec_k=self.spec_k,
                                 max_queue=self.max_queue,
@@ -1408,12 +1416,27 @@ def main(argv=None):
                              "higher slot occupancy than reserving every "
                              "request's full prompt+max_tokens need")
     parser.add_argument("--spill-bytes", type=int, default=None,
-                        help="with --overcommit: host-DRAM budget (bytes) "
-                             "for spilled KV page blocks. Preemption exports "
-                             "the victim's pages to host memory and resume "
+                        help="with --overcommit or --spill-cold-after: "
+                             "host-DRAM budget (bytes) for spilled KV page "
+                             "blocks. Preemption/cold-spill exports the "
+                             "victim's pages to host memory and resume "
                              "re-imports them — one page scatter instead of "
                              "a full re-prefill; LRU-evicted past the "
                              "budget, falling back to re-prefill")
+    parser.add_argument("--spill-cold-after", type=int, default=None,
+                        help="with --spill-bytes: proactively spill a "
+                             "decode slot whose consumer stopped pulling "
+                             "tokens for N scheduler ticks (idle streaming "
+                             "session) — its pool pages free up for "
+                             "admission and the session resumes "
+                             "token-exactly when the consumer catches up")
+    parser.add_argument("--kv-prefetch", choices=["on", "off", "auto"],
+                        default="auto",
+                        help="stage spilled KV blocks host→device BEFORE "
+                             "the resume tick (overlapped with decode "
+                             "compute), demoting demand import to a counted "
+                             "fallback; auto = on whenever --spill-bytes is "
+                             "set (default)")
     parser.add_argument("--draft-model", default=None,
                         help="speculative decoding: a small draft model "
                              "proposes --spec-k tokens per round (greedy "
@@ -1629,12 +1652,27 @@ def main(argv=None):
     if args.spill_bytes is not None:
         if args.spill_bytes < 1:
             parser.error("--spill-bytes must be a positive byte count")
-        if not args.overcommit:
-            parser.error("--spill-bytes requires --overcommit: the spill "
-                         "tier holds preempted requests' KV page blocks")
+        if not args.overcommit and args.spill_cold_after is None:
+            parser.error("--spill-bytes requires --overcommit or "
+                         "--spill-cold-after: the spill tier holds "
+                         "preempted or cold-spilled requests' KV page "
+                         "blocks")
         if args.draft_model:
             parser.error("--spill-bytes is incompatible with --draft-model "
                          "(speculative slots re-prefill on preemption)")
+    if args.spill_cold_after is not None:
+        if args.spill_cold_after < 1:
+            parser.error("--spill-cold-after must be >= 1 (scheduler ticks)")
+        if args.spill_bytes is None:
+            parser.error("--spill-cold-after needs a spill tier to spill "
+                         "into: set --spill-bytes")
+        if args.concurrent <= 1:
+            parser.error("--spill-cold-after requires --concurrent N "
+                         "(N > 1): cold-slot residency is a continuous-"
+                         "batching policy")
+    if args.kv_prefetch == "on" and args.spill_bytes is None:
+        parser.error("--kv-prefetch on needs a spill tier to prefetch "
+                     "from: set --spill-bytes")
     if args.disagg:
         if args.concurrent <= 1:
             parser.error("--disagg requires --concurrent N (N > 1): only "
@@ -1715,6 +1753,8 @@ def main(argv=None):
         admission_policy=args.admission_policy,
         overcommit=args.overcommit,
         spill_bytes=args.spill_bytes,
+        spill_cold_after=args.spill_cold_after,
+        kv_prefetch=args.kv_prefetch,
         draft_model=args.draft_model, spec_k=args.spec_k,
         prompt_cache=args.prompt_cache, replicas=args.replicas,
         max_queue=args.max_queue,

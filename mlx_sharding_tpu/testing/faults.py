@@ -26,6 +26,9 @@ Sites wired into the serving stack:
   spill / drain migration; raise here to force the blockless fallback)
 - ``cache.import``        — top of every KV page-block import at resume
   (raise here to force a re-prefill instead of a block re-import)
+- ``cache.prefetch``      — top of ``KVPageBlock.prefetch`` (the overlapped
+  host→device stage; raise here to force the counted demand-import path —
+  the stream must still resume token-exact)
 - ``replica.drain``       — entry of ``ReplicaSet.drain(i)``, after the
   replica is marked draining; ctx ``replica=<i>`` (kill a drain
   mid-migration to test the quarantine-and-retry path)

@@ -178,6 +178,41 @@ class ServingMetrics:
                         "# TYPE mst_kv_reprefill_tokens_total counter",
                         f"mst_kv_reprefill_tokens_total "
                         f"{spill['reprefill_tokens']}",
+                        # proactive residency: cold-policy activity, tier
+                        # lookup quality, and the overlapped-vs-demand
+                        # resume split (.get: ReplicaSet aggregation may
+                        # predate these keys)
+                        "# TYPE mst_kv_spill_cold_total counter",
+                        f"mst_kv_spill_cold_total "
+                        f"{spill.get('cold_spills', 0)}",
+                        "# TYPE mst_kv_spill_wakes_total counter",
+                        f"mst_kv_spill_wakes_total "
+                        f"{spill.get('cold_wakes', 0)}",
+                        "# TYPE mst_kv_spill_parked gauge",
+                        f"mst_kv_spill_parked {spill.get('parked', 0)}",
+                        "# TYPE mst_kv_spill_hit_rate gauge",
+                        f"mst_kv_spill_hit_rate "
+                        f"{spill.get('hit_rate', 0.0):.4f}",
+                        "# TYPE mst_kv_spill_rejects_total counter",
+                        f'mst_kv_spill_rejects_total{{reason="oversize"}} '
+                        f"{spill.get('rejects_oversize', 0)}",
+                        f'mst_kv_spill_rejects_total{{reason="closed"}} '
+                        f"{spill.get('rejects_closed', 0)}",
+                        "# TYPE mst_kv_prefetch_enabled gauge",
+                        f"mst_kv_prefetch_enabled "
+                        f"{int(bool(spill.get('prefetch_enabled', False)))}",
+                        "# TYPE mst_kv_prefetch_total counter",
+                        f"mst_kv_prefetch_total "
+                        f"{spill.get('prefetches', 0)}",
+                        "# TYPE mst_kv_prefetch_hits_total counter",
+                        f"mst_kv_prefetch_hits_total "
+                        f"{spill.get('prefetch_hits', 0)}",
+                        "# TYPE mst_kv_prefetch_demand_total counter",
+                        f"mst_kv_prefetch_demand_total "
+                        f"{spill.get('demand_imports', 0)}",
+                        "# TYPE mst_kv_prefetch_faults_total counter",
+                        f"mst_kv_prefetch_faults_total "
+                        f"{spill.get('prefetch_faults', 0)}",
                     ]
                     if "migrated_streams" in spill:
                         # ReplicaSet-level: streams re-placed across
@@ -225,6 +260,10 @@ class ServingMetrics:
                         "# TYPE mst_tick_device_blocked_ms gauge",
                         f'mst_tick_device_blocked_ms{{path="{path}"}} '
                         f"{tick['device_blocked_ms_last']:.3f}",
+                        # resume-path import stall: ~0 when prefetch staged
+                        # the pages, the full host→device marshal on demand
+                        f'mst_tick_device_blocked_ms{{path="kv_import"}} '
+                        f"{tick.get('kv_import_ms_last', 0.0):.3f}",
                     ]
                 res = getattr(b, "resilience_stats", lambda: None)()
                 if res is not None:

@@ -1,0 +1,11 @@
+"""MST109: a spilled KV block's host pages uploaded inside a tick-hot
+function — the demand-paged resume stall. The stage belongs in the
+(non-hot) wake/admission policy pass via KVPageBlock.prefetch()."""
+import jax
+
+
+# mst: hot-path
+def resume_in_tick(cache, tier, req):
+    blk = tier.take(req)
+    staged = jax.device_put(blk.k_pages)
+    return cache, staged

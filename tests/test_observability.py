@@ -140,3 +140,70 @@ def test_metrics_expose_tick_timing():
     text = ServingMetrics(batcher_fn=lambda: _NoTickBatcher()).render()
     assert "mst_tick_host_ms" not in text
     assert "mst_sched_async" not in text
+
+def test_metrics_expose_kv_residency_and_prefetch():
+    """/metrics reports the proactive-residency split: cold-spill/wake
+    activity, tier lookup quality, reject reasons, the prefetch-vs-demand
+    resume counters, and the per-tick kv_import stall gauge
+    (spill_stats() / tick_timing_stats() contracts)."""
+    from mlx_sharding_tpu.utils.observability import ServingMetrics
+
+    class _FakeBatcher:
+        def stats(self):
+            return (2, 1, 0)
+
+        def spill_stats(self):
+            return {
+                "enabled": True, "spills": 4, "spill_hits": 3,
+                "spill_fallbacks": 1, "evictions": 0, "bytes_in_use": 1024,
+                "budget_bytes": 4096, "migrations_out": 0,
+                "migrations_in": 0, "reprefill_tokens": 7,
+                "cold_spills": 5, "cold_wakes": 4, "parked": 2,
+                "hit_rate": 0.875, "rejects_oversize": 1,
+                "rejects_closed": 2, "prefetch_enabled": True,
+                "prefetches": 4, "prefetch_hits": 3, "demand_imports": 1,
+                "prefetch_faults": 1,
+            }
+
+        def tick_timing_stats(self):
+            return {
+                "path": "async", "host_ms_last": 1.0,
+                "device_blocked_ms_last": 0.5, "host_ms_avg": 1.0,
+                "device_blocked_ms_avg": 0.5, "ticks": 3,
+                "kv_import_ms_last": 2.125,
+            }
+
+    text = ServingMetrics(batcher_fn=lambda: _FakeBatcher()).render()
+    assert "mst_kv_spill_cold_total 5" in text
+    assert "mst_kv_spill_wakes_total 4" in text
+    assert "mst_kv_spill_parked 2" in text
+    assert "mst_kv_spill_hit_rate 0.8750" in text
+    assert 'mst_kv_spill_rejects_total{reason="oversize"} 1' in text
+    assert 'mst_kv_spill_rejects_total{reason="closed"} 2' in text
+    assert "mst_kv_prefetch_enabled 1" in text
+    assert "mst_kv_prefetch_total 4" in text
+    assert "mst_kv_prefetch_hits_total 3" in text
+    assert "mst_kv_prefetch_demand_total 1" in text
+    assert "mst_kv_prefetch_faults_total 1" in text
+    assert 'mst_tick_device_blocked_ms{path="kv_import"} 2.125' in text
+
+    class _LegacySpill(_FakeBatcher):
+        # a ReplicaSet aggregation that predates the residency keys
+        def spill_stats(self):
+            s = _FakeBatcher.spill_stats(self)
+            for k in ("cold_spills", "cold_wakes", "parked", "hit_rate",
+                      "rejects_oversize", "rejects_closed",
+                      "prefetch_enabled", "prefetches", "prefetch_hits",
+                      "demand_imports", "prefetch_faults"):
+                del s[k]
+            return s
+
+        def tick_timing_stats(self):
+            t = _FakeBatcher.tick_timing_stats(self)
+            del t["kv_import_ms_last"]
+            return t
+
+    text = ServingMetrics(batcher_fn=lambda: _LegacySpill()).render()
+    assert "mst_kv_spill_cold_total 0" in text
+    assert "mst_kv_prefetch_enabled 0" in text
+    assert 'mst_tick_device_blocked_ms{path="kv_import"} 0.000' in text

@@ -41,6 +41,7 @@ EXPECTED = {
     "mst106_sync_spill.py": ("MST106", 11, 11),
     "mst107_wall_clock_deadline.py": ("MST107", 7, 22),
     "mst108_block_migration.py": ("MST108", 8, 10),
+    "mst109_demand_import.py": ("MST109", 10, 13),
     "mst201_unlocked_attr.py": ("MST201", 15, 0),
     "mst202_check_then_act.py": ("MST202", 14, 0),
     "mst203_lock_cycle.py": ("MST203", 17, 0),
