@@ -909,6 +909,109 @@ def measure_fleet_elasticity(model, params, label: str) -> dict:
         rs.close()
 
 
+def measure_weight_sharing(model, params, label: str) -> dict:
+    """Cross-replica shared weights (ISSUE 10). A/B over an N=3 fleet:
+    private mode uploads one resident tree per replica (the pre-store
+    behaviour), shared mode places ONE tree and every replica aliases it
+    through a WeightStore lease. Records (1) fleet-resident weight bytes
+    under unique-buffer accounting — ~W shared vs N×W private is the
+    headline; (2) spawn latency — full checkpoint re-placement vs
+    alias-fast construction, the autoscaler's scale-out stall; (3) greedy
+    parity — shared and private replicas must stream identical tokens."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh, mesh_fingerprint
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine, place_weights
+    from mlx_sharding_tpu.weights import WeightKey, WeightStore
+
+    devices = jax.devices()
+    n = 3
+    vocab = model.config.vocab_size
+    prompt = [
+        int(x) for x in
+        np.random.default_rng(23).integers(1, vocab - 64, 16)
+    ]
+    kw = dict(max_seq=256, cache_dtype=jnp.bfloat16, prefill_chunk=16)
+
+    def unique_bytes(engines):
+        seen, total = set(), 0
+        for e in engines:
+            for leaf in jax.tree.leaves(
+                (e.layer_params, e.vocab_parts, e.shared_params)
+            ):
+                if id(leaf) not in seen:
+                    seen.add(id(leaf))
+                    total += leaf.nbytes
+        return total
+
+    # ---- private fleet: one full placement per replica ------------------
+    t_full = time.perf_counter()
+    first_private = PipelineEngine(
+        model, params, make_mesh(pp=1, devices=devices[:1]), **kw
+    )
+    spawn_full_s = time.perf_counter() - t_full
+    private = [first_private] + [
+        PipelineEngine(
+            model, params,
+            make_mesh(pp=1, devices=devices[i % len(devices):
+                                            i % len(devices) + 1]),
+            **kw,
+        )
+        for i in range(1, n)
+    ]
+    bytes_private = unique_bytes(private)
+    want = [t for t, _ in first_private.generate_step(prompt, max_tokens=16)]
+
+    # ---- shared fleet: one placement, N aliased replicas ----------------
+    store = WeightStore()
+    mesh = make_mesh(pp=1, devices=devices[:1])
+    key = WeightKey(checkpoint="bench", stage_bounds=("auto", 1),
+                    dtype="bfloat16", quant="tp1",
+                    placement=mesh_fingerprint(mesh))
+    leases, shared, alias_times = [], [], []
+    for i in range(n):
+        t0 = time.perf_counter()
+        lease = store.acquire(
+            key, lambda: place_weights(model, params, mesh)
+        )
+        eng = PipelineEngine(
+            model, None, lease.weights.mesh, weights=lease.weights, **kw
+        )
+        eng.on_close(lease.release)
+        if i > 0:  # i=0 pays the one real upload; the aliases are the A/B
+            alias_times.append(time.perf_counter() - t0)
+        leases.append(lease)
+        shared.append(eng)
+    bytes_shared = unique_bytes(shared)
+    parity = all(
+        [t for t, _ in e.generate_step(prompt, max_tokens=16)] == want
+        for e in shared
+    )
+    for e in shared:
+        e.close()
+    assert store.stats()["trees"] == 0
+
+    spawn_alias_s = float(np.mean(alias_times))
+    result = dict(
+        label=label,
+        replicas=n,
+        fleet_weight_bytes_private=int(bytes_private),
+        fleet_weight_bytes_shared=int(bytes_shared),
+        bytes_ratio=round(bytes_private / max(1, bytes_shared), 2),
+        spawn_full_s=round(spawn_full_s, 3),
+        spawn_alias_s=round(spawn_alias_s, 3),
+        spawn_speedup=round(spawn_full_s / max(1e-9, spawn_alias_s), 1),
+        greedy_parity=bool(parity),
+    )
+    log(f"[{label}] fleet bytes {bytes_private / 1e6:.1f}MB private -> "
+        f"{bytes_shared / 1e6:.1f}MB shared ({result['bytes_ratio']}x) | "
+        f"spawn {spawn_full_s:.3f}s full -> {spawn_alias_s:.3f}s alias "
+        f"({result['spawn_speedup']}x) | parity={parity}")
+    return result
+
+
 def measure_disagg_prefill_decode(model, params, label: str) -> dict:
     """Disaggregated prefill/decode A/B (ISSUE 8 tentpole): the same mixed
     workload — decode-saturated slots plus long-prefill arrivals — through
@@ -1887,6 +1990,13 @@ def main() -> int:
                 detail["fleet_elasticity_cpu"] = dict(error=repr(e)[:300])
                 log(f"[fleet_elasticity_cpu] FAILED: {e!r}")
             try:
+                detail["weight_sharing_cpu"] = measure_weight_sharing(
+                    m2, p2, "weight_sharing_cpu"
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["weight_sharing_cpu"] = dict(error=repr(e)[:300])
+                log(f"[weight_sharing_cpu] FAILED: {e!r}")
+            try:
                 detail["disagg_prefill_decode_cpu"] = (
                     measure_disagg_prefill_decode(
                         m2, p2, "disagg_prefill_decode_cpu"
@@ -2117,6 +2227,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["fleet_elasticity"] = dict(error=repr(e)[:300])
             log(f"[fleet_elasticity] FAILED: {e!r}")
+        gc.collect()
+        try:
+            detail["weight_sharing"] = measure_weight_sharing(
+                model, params, "weight_sharing"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["weight_sharing"] = dict(error=repr(e)[:300])
+            log(f"[weight_sharing] FAILED: {e!r}")
         gc.collect()
         try:
             # self-skips on a single-chip host (needs one device per pool)

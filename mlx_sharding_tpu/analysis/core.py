@@ -31,6 +31,7 @@ SUPPRESS_RE = re.compile(
 )
 HOT_PATH_RE = re.compile(r"#\s*mst:\s*hot-path\b")
 DECODE_HOT_RE = re.compile(r"#\s*mst:\s*decode-hot\b")
+SPAWN_HOT_RE = re.compile(r"#\s*mst:\s*spawn-hot\b")
 
 
 @dataclass(frozen=True)
@@ -64,6 +65,7 @@ class ModuleInfo:
     bad_suppressions: list[int] = field(default_factory=list)
     hot_lines: set[int] = field(default_factory=set)  # '# mst: hot-path'
     decode_hot_lines: set[int] = field(default_factory=set)  # 'decode-hot'
+    spawn_hot_lines: set[int] = field(default_factory=set)  # 'spawn-hot'
 
     @property
     def basename(self) -> str:
@@ -127,6 +129,8 @@ def parse_module(path: Path, display_path: str) -> tuple[Optional[ModuleInfo], l
             mod.hot_lines.add(i)
         if DECODE_HOT_RE.search(text):
             mod.decode_hot_lines.add(i)
+        if SPAWN_HOT_RE.search(text):
+            mod.spawn_hot_lines.add(i)
         m = SUPPRESS_RE.search(text)
         if m:
             rules = {r.strip() for r in m.group(1).split(",") if r.strip()}

@@ -69,6 +69,17 @@
   current decode block's compute — and keep demand import as a counted
   off-tick fallback. An MST102/MST106 suppression nearby does NOT cover
   this rule.
+- **MST110 weight-upload-in-spawn** — a full param-tree placement
+  (``jax.device_put`` / ``put_global`` / ``place_weights``) inside a
+  spawn-hot function: the replica-spawn factories the autoscaler calls
+  (``replica_factory``/``pool_factory``/``spawn_replica``, ``fleet._spawn``,
+  plus anything annotated ``# mst: spawn-hot``). A spawn that re-uploads or
+  re-shards the checkpoint stalls scale-out on checkpoint I/O and costs a
+  second W of HBM the fleet was sized not to have — the spawn path must
+  alias the host's resident tree through ``weights.WeightStore.acquire``
+  (the store's builder does the one real upload, off the per-spawn path).
+  Only a call whose argument subtree names param-ish data (param / weight /
+  state_dict / checkpoint) fires, so KV staging in a factory stays clean.
 - **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
   timeout arithmetic (an expression whose identifiers mention deadline /
   timeout / expiry / until / budget / ttft / retry_after / lease). The wall
@@ -141,6 +152,19 @@ UPLOAD_CALLS = {"jax.device_put", "jnp.asarray", "jnp.array",
 # tier lookups whose results MST109 tracks as block-bearing names
 BLOCK_PAGE_ATTRS = {"k_pages", "v_pages"}
 TIER_LOOKUP_ATTRS = {"take", "peek"}
+
+# spawn-hot roots checked by MST110 (beyond '# mst: spawn-hot'
+# annotations): the replica-spawn factories the fleet autoscaler calls
+SPAWN_HOT_FUNCS = {
+    "openai_api.py": {"replica_factory", "pool_factory", "spawn_replica"},
+    "fleet.py": {"_spawn"},
+}
+# calls that place a param tree on device — the one real upload belongs in
+# the WeightStore builder, never on the per-spawn path
+WEIGHT_UPLOAD_CALLS = {"device_put", "put_global", "place_weights"}
+# identifier fragments that mark a call's argument as a param tree (vs the
+# KV staging a spawn legitimately does)
+PARAM_TREE_HINTS = ("param", "weight", "state_dict", "checkpoint")
 
 # decode-hot roots checked by MST105 (beyond '# mst: decode-hot'
 # annotations): every packed decode matmul funnels through these
@@ -439,6 +463,61 @@ def _check_block_migration(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+def _spawn_hot_functions(mod: ModuleInfo) -> list[ast.FunctionDef]:
+    configured = SPAWN_HOT_FUNCS.get(mod.basename, set())
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        annotated = any(
+            line in mod.spawn_hot_lines
+            for line in (node.lineno, node.lineno - 1)
+        )
+        if node.name in configured or annotated:
+            out.append(node)
+    return out
+
+
+def _check_spawn_weight_upload(mod: ModuleInfo) -> list[Finding]:
+    """MST110: a full param-tree placement inside a spawn-hot function.
+    Non-transitive by design — the sanctioned path hands a builder callable
+    to ``WeightStore.acquire`` (the upload runs once, inside the store, not
+    per spawn), and that callable's own body is where ``place_weights``
+    belongs. Only the factory's DIRECT body is scanned, and only calls
+    whose arguments name param-ish data fire, so a factory staging KV or
+    slot state stays clean."""
+    findings = []
+    for fn in _spawn_hot_functions(mod):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs (incl. the store's builder) are exempt
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] not in WEIGHT_UPLOAD_CALLS:
+                continue
+            idents: set[str] = set()
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        idents.add(sub.id.lower())
+                    elif isinstance(sub, ast.Attribute):
+                        idents.add(sub.attr.lower())
+            if not any(h in ident for ident in idents
+                       for h in PARAM_TREE_HINTS):
+                continue
+            findings.append(Finding(
+                "MST110", mod.display_path, node.lineno, node.col_offset,
+                f"param-tree upload in spawn-hot {fn.name}(): "
+                f"{name.split('.')[-1]}(...) re-places the checkpoint on "
+                "every spawn — alias the host's resident tree through "
+                "WeightStore.acquire and leave the one real upload to the "
+                "store's builder",
+                context=qualname_for_line(mod.tree, node.lineno),
+            ))
+    return findings
+
+
 def _check_dense_dequant(mod: ModuleInfo, table: dict) -> list[Finding]:
     """MST105: a dense dequantized-weight materialization reachable from a
     decode-hot function. Roots come from ``DECODE_HOT_FUNCS`` (by basename)
@@ -659,6 +738,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_sync_spill(mod)
     findings += _check_block_migration(mod)
     findings += _check_sync_import(mod)
+    findings += _check_spawn_weight_upload(mod)
     findings += _check_recompile_hazards(mod)
     findings += _check_dense_dequant(mod, table)
     findings += _check_wall_clock_deadlines(mod)

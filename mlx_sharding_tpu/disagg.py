@@ -315,7 +315,8 @@ class DisaggCoordinator:
                 events[k] = events.get(k, 0) + v
         out = {"role": None, "pools": [pf, df], "autoscale_events": events}
         for k in ("size", "total", "retired", "draining", "sticky_sessions",
-                  "affinity_entries", "affinity_hits", "sticky_hits"):
+                  "affinity_entries", "affinity_hits", "sticky_hits",
+                  "weights_shared"):
             out[k] = pf.get(k, 0) + df.get(k, 0)
         return out
 

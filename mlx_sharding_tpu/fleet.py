@@ -209,6 +209,8 @@ class FleetAutoscaler:
         self.tick_errors = 0
         self.spawns = 0
         self.spawn_failures = 0
+        self.last_spawn_s = None  # wall time of the last factory() call —
+        # the aliased-vs-full-reload A/B number shared weights exist to move
         self.drains = 0
         self.drain_failures = 0
         self.degraded = False  # last scale action failed → static fleet
@@ -308,7 +310,9 @@ class FleetAutoscaler:
     def _spawn(self, now: float) -> str:
         try:
             inject("replica.spawn")
+            t0 = time.monotonic()
             rep = self.factory()
+            spawn_s = time.monotonic() - t0
             if rep is None:
                 raise RuntimeError("replica factory returned None")
         except Exception:  # noqa: BLE001 — degrade to the static fleet
@@ -326,6 +330,7 @@ class FleetAutoscaler:
         idx = self.rs.add_replica(rep)
         with self._lock:
             self.spawns += 1
+            self.last_spawn_s = spawn_s
             self.degraded = False
             self._last_scale_at = now
             self._up_since = None
@@ -398,6 +403,7 @@ class FleetAutoscaler:
                 "tick_errors": self.tick_errors,
                 "spawns": self.spawns,
                 "spawn_failures": self.spawn_failures,
+                "last_spawn_s": self.last_spawn_s,
                 "drains": self.drains,
                 "drain_failures": self.drain_failures,
                 "degraded": self.degraded,

@@ -48,6 +48,31 @@ def get_model_path(path_or_repo: str, revision: Optional[str] = None) -> Path:
     )
 
 
+def checkpoint_signature(
+    path_or_repo: str, *, keep_quantized: bool = False
+) -> str:
+    """Stable content identity of a checkpoint for ``weights.WeightKey``:
+    the resolved on-disk path plus the quantization config and whether the
+    load keeps packed triples resident. Two replicas may alias one resident
+    tree only when this string matches — same files, same dequant decisions,
+    same in-memory layout."""
+    path = get_model_path(path_or_repo)
+    quant = None
+    cfg = path / "config.json"
+    if cfg.exists():
+        with open(cfg) as f:
+            quant = json.load(f).get("quantization")
+    if quant:
+        qsig = (
+            f"gs{int(quant.get('group_size', 64))}"
+            f"b{int(quant.get('bits', 4))}"
+        )
+        packed = "packed" if keep_quantized else "dense"
+    else:
+        qsig, packed = "dense", "dense"
+    return f"{path.resolve()}::{qsig}::{packed}"
+
+
 def load_config(
     model_path: Path,
     start_layer: Optional[int] = None,

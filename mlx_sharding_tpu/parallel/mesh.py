@@ -49,6 +49,30 @@ def make_mesh(
     return Mesh(grid, MESH_AXIS_ORDER)
 
 
+def mesh_fingerprint(mesh: Mesh) -> str:
+    """Stable identity of a mesh's placement: axis geometry plus the exact
+    device grid, in order. This is the placement half of a
+    ``weights.WeightKey`` — resident arrays are device-addressed, so WHERE
+    a weight tree lives is part of WHAT it is, and two replicas may alias
+    one tree only when their meshes print the same fingerprint."""
+    axes = ",".join(f"{k}={v}" for k, v in mesh.shape.items())
+    devs = ",".join(str(d.id) for d in mesh.devices.flat)
+    return f"{axes}|{devs}"
+
+
+def same_mesh_devices(a: Mesh, b: Mesh) -> bool:
+    """True when two meshes span identical device grids — same axis sizes,
+    same devices, same order. That is the condition for arrays placed
+    against one mesh to feed programs shard_mapped over the other without
+    a cross-device transfer (jit rejects a device-set mismatch outright),
+    i.e. for a ``ResidentWeights`` built on ``a`` to be aliased by an
+    engine running on ``b``."""
+    return (
+        dict(a.shape) == dict(b.shape)
+        and [d.id for d in a.devices.flat] == [d.id for d in b.devices.flat]
+    )
+
+
 def pipeline_mesh(num_stages: int, devices=None) -> Mesh:
     """1-D pipeline mesh — the parity topology (reference §2.3: PP is the
     only strategy)."""

@@ -44,7 +44,14 @@ from mlx_sharding_tpu.cache import (
     quantize_kv_rows,
 )
 from mlx_sharding_tpu.ops.quant import dequantize, is_quantized
-from mlx_sharding_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_TP, shard_map
+from mlx_sharding_tpu.parallel.mesh import (
+    AXIS_EP,
+    AXIS_PP,
+    AXIS_TP,
+    same_mesh_devices,
+    shard_map,
+)
+from mlx_sharding_tpu.weights import ResidentWeights
 from mlx_sharding_tpu.sample import (
     SamplerParams,
     init_recent_tokens,
@@ -168,6 +175,225 @@ def stack_stage_params(stage_param_list: list[dict]) -> dict:
     return {n: jnp.stack([p[n] for p in stage_param_list]) for n in names}
 
 
+def place_weights(model, params, mesh, *, stage_bounds=None) -> ResidentWeights:
+    """Materialize a model's device-resident weight tree on ``mesh``: split
+    the stacked layer params per pipeline stage, apply build-time projection
+    fusion and the GEMV autotune sweep, derive per-name PartitionSpecs over
+    pp/tp/ep, place everything with ``put_global``, and vocab-shard the
+    embedding/head over pp. This is the entire per-replica spawn cost that
+    ISN'T slot/cache setup — which is why it is a free function: the
+    ``weights.WeightStore`` runs it once per key and every data-parallel
+    replica constructs its ``PipelineEngine`` against the returned
+    ``ResidentWeights`` (``weights=`` kwarg), aliasing the same arrays
+    instead of re-uploading W bytes per replica."""
+    cfg = model.config
+    S = mesh.shape[AXIS_PP]
+    tp = mesh.shape.get(AXIS_TP, 1)
+    ep = mesh.shape.get(AXIS_EP, 1)
+    stage_sharding = NamedSharding(mesh, P(AXIS_PP))
+    replicated = NamedSharding(mesh, P())
+
+    if stage_bounds is None:
+        stage_bounds = balanced_stage_bounds(cfg.num_hidden_layers, S)
+    elif len(stage_bounds) != S:
+        raise ValueError(
+            f"{len(stage_bounds)} stage bounds for a {S}-stage pp mesh"
+        )
+    stage_bounds = [tuple(b) for b in stage_bounds]
+    split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
+
+    # Build-time projection fusion (keep-quantized loads): concatenate
+    # each declared group's packed triples along OUT so decode runs QKV
+    # (and gate+up) as ONE fused-GEMV launch sharing a single pass over
+    # the activation planes. tp == 1 only — the fused OUT axis
+    # interleaves the group's rows, which the column-parallel slicing
+    # wouldn't split correctly. Forward code dispatches on the fused
+    # name's presence in the layer pytree (models/llama.py).
+    fused_projections: list[str] = []
+    if tp == 1 and os.environ.get("MST_FUSE_PROJ", "1") != "0":
+        from mlx_sharding_tpu.models.base import apply_projection_fusion
+
+        fused_projections = apply_projection_fusion(model, split)
+
+    # Shape-keyed GEMV autotune: sweep candidate block sizes once per
+    # distinct packed (OUT, IN) at load time (quant_matmul caches the
+    # winner; every layer with that shape reuses it). No-op off-TPU.
+    if os.environ.get("MST_QMM_AUTOTUNE", "1") != "0":
+        from mlx_sharding_tpu.ops.quant_matmul import autotune_gemv
+
+        gs_a, bits_a = model._quant_args()
+        seen_shapes: set = set()
+
+        def _sweep(stack):
+            for w in stack.values():
+                if isinstance(w, dict) and not is_quantized(w):
+                    _sweep(w)
+                elif is_quantized(w):
+                    out_dim = int(w["q"].shape[-2])
+                    in_dim = int(w["scales"].shape[-1]) * gs_a
+                    if (out_dim, in_dim) not in seen_shapes:
+                        seen_shapes.add((out_dim, in_dim))
+                        autotune_gemv(1, out_dim, in_dim, gs_a, bits_a)
+
+        _sweep(split)
+
+    # Per-name shard axes: tp (heads/MLP columns) and ep (expert stacks).
+    # Models declare flat maps (homogeneous stacks) or nested
+    # {group: {name: dim}} maps (DeepSeek's moe group). Values are
+    # (per-layer dim, mesh axis name).
+    def _merge(out, axes_map, axis_name):
+        for n, ax in axes_map.items():
+            if isinstance(ax, dict):
+                out.setdefault(n, {})
+                _merge(out[n], ax, axis_name)
+            elif ax is not None:
+                out[n] = (ax, axis_name)
+
+    axes_by_name: dict = {}
+    if tp > 1:
+        _merge(axes_by_name, model.tp_layer_axes(), AXIS_TP)
+    if ep > 1:
+        _merge(axes_by_name, model.ep_layer_axes(), AXIS_EP)
+
+    def _check_div(name, w, ax, axis_name):
+        if w.shape[2 + ax] % mesh.shape[axis_name]:
+            raise ValueError(
+                f"{name} dim {w.shape[2 + ax]} not divisible over "
+                f"{axis_name}={mesh.shape[axis_name]}"
+            )
+        dims = [AXIS_PP, None] + [None] * (w.ndim - 2)
+        dims[2 + ax] = axis_name
+        return P(*dims)
+
+    def param_spec(entry, name, w):
+        # (S, L, …) array → the model-declared per-layer dim shards over
+        # its mesh axis, offset by the two leading stack axes
+        if entry is None:
+            return P(AXIS_PP)
+        ax, axis_name = entry
+        return _check_div(name, w, ax, axis_name)
+
+    def quant_spec(entry, name, w):
+        """Packed triples under TP/EP. The model declares axes in the
+        DENSE orientation — trailing (…, in, out) matmul dims, any
+        leading stack dims (the expert E axis) before them — but packed
+        leaves keep those two trailing dims in MLX's (out, X) layout:
+        q (out, in/8), scales/biases (out, in/group). Leading stack dims
+        are layout-identical (EP's E axis shards as declared); within
+        the matmul pair the dim flips: column-parallel (dense out)
+        shards packed dim -2, row-parallel (dense in) shards packed
+        dim -1. Per-leaf divisibility checks double as nibble-word and
+        quant-group alignment guards (scales' in/group dim dividing the
+        mesh axis ⇔ the in split lands on group boundaries)."""
+        if entry is None:
+            spec = P(AXIS_PP)
+            return jax.tree.map(lambda _: spec, w)
+        ax, axis_name = entry
+        ndims = {a.ndim for a in w.values()}
+        if len(ndims) != 1:
+            raise ValueError(f"ragged packed leaves for {name}")
+        nd = ndims.pop() - 2  # per-layer dims (drop the S, L stack axes)
+        if ax < nd - 2:
+            axq = ax  # leading stack dim (expert E): same position packed
+        elif ax == nd - 1:
+            axq = nd - 2  # dense out (column-parallel) → packed out dim
+        else:
+            axq = nd - 1  # dense in (row-parallel) → packed in/X dim
+        return {
+            leaf: _check_div(f"{name}.{leaf}", arr, axq, axis_name)
+            for leaf, arr in w.items()
+        }
+
+    def build_specs(stack, axes):
+        out = {}
+        for name, w in stack.items():
+            entry = axes.get(name)
+            if isinstance(w, dict) and not is_quantized(w):
+                out[name] = build_specs(w, entry or {})
+            elif is_quantized(w):
+                out[name] = quant_spec(entry, name, w)
+            else:
+                out[name] = param_spec(entry, name, w)
+        return out
+
+    if not axes_by_name:
+        layer_specs = jax.tree.map(lambda _: P(AXIS_PP), split)
+    else:
+        layer_specs = build_specs(split, axes_by_name)
+    layer_params = put_global(
+        split,
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), layer_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+    )
+    layer_masks = put_global(masks, stage_sharding)
+
+    # Vocab-shard the embedding table and LM head over pp: each device
+    # holds vocab/S rows instead of a full replica (Llama-3 vocab in bf16
+    # is ~1 GB/device replicated). Embedding rows are re-assembled with a
+    # tiny (B,T,H) psum per tick; logits are computed per vocab shard
+    # post-scan and all-gathered — (S-1)/S x V bytes/device vs the full-V
+    # psum before, with head FLOPs divided by S.
+    head_tied = model.head_is_tied()
+    Vs = -(-cfg.vocab_size // S)
+    table = params["embed"]["weight"]
+    if is_quantized(table):
+        # the vocab-sharded embed/head machinery is dense; a packed
+        # table (keep-quantized load) dequantizes once at build — each
+        # device still holds only its V/S rows afterwards
+        gs, bits = model._quant_args()
+        table = dequantize(
+            table["q"], table["scales"], table["biases"], gs, bits,
+            model.compute_dtype,
+        )
+    table = jnp.pad(table, ((0, Vs * S - table.shape[0]), (0, 0)))
+    vparts = [table.reshape(S, Vs, -1)]
+    if not head_tied:
+        head = params["lm_head"]["weight"]  # (H, V)
+        if is_quantized(head):
+            gs, bits = model._quant_args()
+            head = dequantize(
+                head["q"], head["scales"], head["biases"], gs, bits,
+                model.compute_dtype,
+            ).T  # packed is MLX (V, H); the engine wants (H, V)
+        head = jnp.pad(head, ((0, 0), (0, Vs * S - head.shape[1])))
+        # (S, H, Vs) so each device's slice is its vocab shard
+        vparts.append(head.reshape(-1, S, Vs).transpose(1, 0, 2))
+    vocab_parts = put_global(tuple(vparts), stage_sharding)
+    shared_params = put_global(
+        {
+            k: v for k, v in params.items()
+            if k not in ("layers", "embed", "lm_head")
+        },
+        replicated,
+    )
+
+    # total weight bytes one decode tick streams from HBM (every param
+    # leaf is read once per forward) — numerator of the
+    # mst_decode_hbm_bytes_per_token{kind="weights"} gauge. Packed
+    # triples count their actual packed bytes: this is where 4-bit shows
+    # up as 4x less traffic than dense bf16.
+    weight_bytes = sum(
+        leaf.nbytes
+        for leaf in jax.tree.leaves((layer_params, vocab_parts, shared_params))
+    )
+    return ResidentWeights(
+        mesh=mesh,
+        stage_bounds=stage_bounds,
+        layer_specs=layer_specs,
+        layer_params=layer_params,
+        layer_masks=layer_masks,
+        layers_per_stage=slots,
+        fused_projections=fused_projections,
+        vocab_size=cfg.vocab_size,
+        head_tied=head_tied,
+        vocab_parts=vocab_parts,
+        shared_params=shared_params,
+        weight_bytes=weight_bytes,
+    )
+
+
 class PipelineEngine:
     """Runs a full (unsharded-config) model across a ``pp`` mesh axis.
 
@@ -198,6 +424,7 @@ class PipelineEngine:
         page_size: Optional[int] = None,
         paged_attention: str = "auto",
         kv_dtype: Optional[str] = None,
+        weights: Optional[ResidentWeights] = None,
     ):
         cfg = model.config
         if not (cfg.is_first_stage and cfg.is_last_stage):
@@ -250,10 +477,6 @@ class PipelineEngine:
             raise ValueError(
                 "kv_dtype='int8' requires a paged engine (pool_pages)"
             )
-
-        S = self.num_stages
-        stage_sharding = NamedSharding(mesh, P(AXIS_PP))
-        replicated = NamedSharding(mesh, P())
 
         tp_axes = model.tp_layer_axes()
         if self.tp > 1:
@@ -309,13 +532,6 @@ class PipelineEngine:
         if self.ep > 1:
             self._rl_kwargs["ep_axis"] = AXIS_EP
 
-        if stage_bounds is None:
-            stage_bounds = balanced_stage_bounds(cfg.num_hidden_layers, S)
-        elif len(stage_bounds) != S:
-            raise ValueError(
-                f"{len(stage_bounds)} stage bounds for a {S}-stage pp mesh"
-            )
-        self.stage_bounds = [tuple(b) for b in stage_bounds]
         # under TP the KV heads axis is sharded too: each (pp, tp) device
         # holds its stage's cache for its own heads only. A head-count-
         # independent cache (model.cache_tp_replicated: DeepSeek's compressed
@@ -325,188 +541,55 @@ class PipelineEngine:
             P(AXIS_PP, None, None, None, None, AXIS_TP)
             if self.tp > 1 and not model.cache_tp_replicated() else P(AXIS_PP)
         )
-        split, masks, slots = split_stage_stacks(model, params["layers"], stage_bounds)
 
-        # Build-time projection fusion (keep-quantized loads): concatenate
-        # each declared group's packed triples along OUT so decode runs QKV
-        # (and gate+up) as ONE fused-GEMV launch sharing a single pass over
-        # the activation planes. tp == 1 only — the fused OUT axis
-        # interleaves the group's rows, which the column-parallel slicing
-        # wouldn't split correctly. Forward code dispatches on the fused
-        # name's presence in the layer pytree (models/llama.py).
-        self.fused_projections: list[str] = []
-        if self.tp == 1 and os.environ.get("MST_FUSE_PROJ", "1") != "0":
-            from mlx_sharding_tpu.models.base import apply_projection_fusion
-
-            self.fused_projections = apply_projection_fusion(model, split)
-
-        # Shape-keyed GEMV autotune: sweep candidate block sizes once per
-        # distinct packed (OUT, IN) at load time (quant_matmul caches the
-        # winner; every layer with that shape reuses it). No-op off-TPU.
-        if os.environ.get("MST_QMM_AUTOTUNE", "1") != "0":
-            from mlx_sharding_tpu.ops.quant_matmul import autotune_gemv
-
-            gs_a, bits_a = model._quant_args()
-            seen_shapes: set = set()
-
-            def _sweep(stack):
-                for w in stack.values():
-                    if isinstance(w, dict) and not is_quantized(w):
-                        _sweep(w)
-                    elif is_quantized(w):
-                        out_dim = int(w["q"].shape[-2])
-                        in_dim = int(w["scales"].shape[-1]) * gs_a
-                        if (out_dim, in_dim) not in seen_shapes:
-                            seen_shapes.add((out_dim, in_dim))
-                            autotune_gemv(1, out_dim, in_dim, gs_a, bits_a)
-
-            _sweep(split)
-
-        # Per-name shard axes: tp (heads/MLP columns) and ep (expert stacks).
-        # Models declare flat maps (homogeneous stacks) or nested
-        # {group: {name: dim}} maps (DeepSeek's moe group). Values are
-        # (per-layer dim, mesh axis name).
-        def _merge(out, axes_map, axis_name):
-            for n, ax in axes_map.items():
-                if isinstance(ax, dict):
-                    out.setdefault(n, {})
-                    _merge(out[n], ax, axis_name)
-                elif ax is not None:
-                    out[n] = (ax, axis_name)
-
-        axes_by_name: dict = {}
-        if self.tp > 1:
-            _merge(axes_by_name, tp_axes, AXIS_TP)
-        if self.ep > 1:
-            _merge(axes_by_name, model.ep_layer_axes(), AXIS_EP)
-
-        def _check_div(name, w, ax, axis_name):
-            if w.shape[2 + ax] % mesh.shape[axis_name]:
-                raise ValueError(
-                    f"{name} dim {w.shape[2 + ax]} not divisible over "
-                    f"{axis_name}={mesh.shape[axis_name]}"
-                )
-            dims = [AXIS_PP, None] + [None] * (w.ndim - 2)
-            dims[2 + ax] = axis_name
-            return P(*dims)
-
-        def param_spec(entry, name, w):
-            # (S, L, …) array → the model-declared per-layer dim shards over
-            # its mesh axis, offset by the two leading stack axes
-            if entry is None:
-                return P(AXIS_PP)
-            ax, axis_name = entry
-            return _check_div(name, w, ax, axis_name)
-
-        def quant_spec(entry, name, w):
-            """Packed triples under TP/EP. The model declares axes in the
-            DENSE orientation — trailing (…, in, out) matmul dims, any
-            leading stack dims (the expert E axis) before them — but packed
-            leaves keep those two trailing dims in MLX's (out, X) layout:
-            q (out, in/8), scales/biases (out, in/group). Leading stack dims
-            are layout-identical (EP's E axis shards as declared); within
-            the matmul pair the dim flips: column-parallel (dense out)
-            shards packed dim -2, row-parallel (dense in) shards packed
-            dim -1. Per-leaf divisibility checks double as nibble-word and
-            quant-group alignment guards (scales' in/group dim dividing the
-            mesh axis ⇔ the in split lands on group boundaries)."""
-            if entry is None:
-                spec = P(AXIS_PP)
-                return jax.tree.map(lambda _: spec, w)
-            ax, axis_name = entry
-            ndims = {a.ndim for a in w.values()}
-            if len(ndims) != 1:
-                raise ValueError(f"ragged packed leaves for {name}")
-            nd = ndims.pop() - 2  # per-layer dims (drop the S, L stack axes)
-            if ax < nd - 2:
-                axq = ax  # leading stack dim (expert E): same position packed
-            elif ax == nd - 1:
-                axq = nd - 2  # dense out (column-parallel) → packed out dim
-            else:
-                axq = nd - 1  # dense in (row-parallel) → packed in/X dim
-            return {
-                leaf: _check_div(f"{name}.{leaf}", arr, axq, axis_name)
-                for leaf, arr in w.items()
-            }
-
-        def build_specs(stack, axes):
-            out = {}
-            for name, w in stack.items():
-                entry = axes.get(name)
-                if isinstance(w, dict) and not is_quantized(w):
-                    out[name] = build_specs(w, entry or {})
-                elif is_quantized(w):
-                    out[name] = quant_spec(entry, name, w)
-                else:
-                    out[name] = param_spec(entry, name, w)
-            return out
-
-        if not axes_by_name:
-            self.layer_specs = jax.tree.map(lambda _: P(AXIS_PP), split)
+        # Weight residency. Private path: build this engine's own
+        # device-resident tree (the full W-byte upload — split, fuse,
+        # autotune, place). Aliased path (``weights=``): a
+        # ``weights.WeightStore`` lease already holds the resident tree for
+        # this exact placement, and N data-parallel replicas execute
+        # against the SAME arrays — constructing the engine costs
+        # slot/cache setup only. The caller owns the lease and wires its
+        # release through ``on_close()``.
+        if weights is None:
+            weights = place_weights(
+                model, params, mesh, stage_bounds=stage_bounds
+            )
+            self.weights_shared = False
         else:
-            self.layer_specs = build_specs(split, axes_by_name)
-        self.layer_params = put_global(
-            split,
-            jax.tree.map(
-                lambda s: NamedSharding(mesh, s), self.layer_specs,
-                is_leaf=lambda x: isinstance(x, P),
-            ),
-        )
-        self.layer_masks = put_global(masks, stage_sharding)
-        self.layers_per_stage = slots
-
-        # Vocab-shard the embedding table and LM head over pp: each device
-        # holds vocab/S rows instead of a full replica (Llama-3 vocab in bf16
-        # is ~1 GB/device replicated). Embedding rows are re-assembled with a
-        # tiny (B,T,H) psum per tick; logits are computed per vocab shard
-        # post-scan and all-gathered — (S-1)/S x V bytes/device vs the full-V
-        # psum before, with head FLOPs divided by S.
-        self.vocab_size = cfg.vocab_size
-        self._head_tied = model.head_is_tied()
-        Vs = -(-cfg.vocab_size // S)
-        table = params["embed"]["weight"]
-        if is_quantized(table):
-            # the vocab-sharded embed/head machinery is dense; a packed
-            # table (keep-quantized load) dequantizes once at build — each
-            # device still holds only its V/S rows afterwards
-            gs, bits = model._quant_args()
-            table = dequantize(
-                table["q"], table["scales"], table["biases"], gs, bits,
-                model.compute_dtype,
-            )
-        table = jnp.pad(table, ((0, Vs * S - table.shape[0]), (0, 0)))
-        vparts = [table.reshape(S, Vs, -1)]
-        if not self._head_tied:
-            head = params["lm_head"]["weight"]  # (H, V)
-            if is_quantized(head):
-                gs, bits = model._quant_args()
-                head = dequantize(
-                    head["q"], head["scales"], head["biases"], gs, bits,
-                    model.compute_dtype,
-                ).T  # packed is MLX (V, H); the engine wants (H, V)
-            head = jnp.pad(head, ((0, 0), (0, Vs * S - head.shape[1])))
-            # (S, H, Vs) so each device's slice is its vocab shard
-            vparts.append(head.reshape(-1, S, Vs).transpose(1, 0, 2))
-        self.vocab_parts = put_global(tuple(vparts), stage_sharding)
-        self.shared_params = put_global(
-            {
-                k: v for k, v in params.items()
-                if k not in ("layers", "embed", "lm_head")
-            },
-            replicated,
-        )
-
-        # total weight bytes one decode tick streams from HBM (every param
-        # leaf is read once per forward) — numerator of the
-        # mst_decode_hbm_bytes_per_token{kind="weights"} gauge. Packed
-        # triples count their actual packed bytes: this is where 4-bit shows
-        # up as 4x less traffic than dense bf16.
-        self.weight_stream_bytes = sum(
-            leaf.nbytes
-            for leaf in jax.tree.leaves(
-                (self.layer_params, self.vocab_parts, self.shared_params)
-            )
-        )
+            if not same_mesh_devices(weights.mesh, mesh):
+                raise ValueError(
+                    "resident weights were placed on a different device "
+                    "grid than this engine's mesh — aliased construction "
+                    "needs identical placement (same devices, same axis "
+                    "layout)"
+                )
+            if stage_bounds is not None and [
+                tuple(b) for b in stage_bounds
+            ] != list(weights.stage_bounds):
+                raise ValueError(
+                    f"stage_bounds {list(stage_bounds)} disagree with the "
+                    f"resident tree's split {list(weights.stage_bounds)}"
+                )
+            # adopt the resident tree's Mesh OBJECT, not just an equal
+            # grid: shard_map programs closed over the same mesh share
+            # trace caches across aliased replicas
+            self.mesh = mesh = weights.mesh
+            self.weights_shared = True
+        self.resident = weights
+        self.stage_bounds = list(weights.stage_bounds)
+        self.layer_specs = weights.layer_specs
+        self.layer_params = weights.layer_params
+        self.layer_masks = weights.layer_masks
+        self.layers_per_stage = weights.layers_per_stage
+        self.fused_projections = list(weights.fused_projections)
+        self.vocab_size = weights.vocab_size
+        self._head_tied = weights.head_tied
+        self.vocab_parts = weights.vocab_parts
+        self.shared_params = weights.shared_params
+        self.weight_stream_bytes = weights.weight_bytes
+        # resources the engine holds beyond its own arrays (today: the
+        # shared-weight lease release) — close() runs each exactly once
+        self._close_hooks: list = []
 
         self._decode = self._build_step(t_len=1, with_sampling=True)
         self._prefill = self._build_step(t_len=prefill_chunk, with_sampling=False)
@@ -516,6 +599,23 @@ class PipelineEngine:
         self._prefill_slot = None
         self._decode_blocks: dict = {}  # (k_steps, want_lp) → jitted block
         self._spec_progs: dict = {}  # ("propose"|"verify", K) → jitted prog
+
+    def on_close(self, cb):
+        """Register a teardown callback (run once, from close()). The
+        shared-weights spawn path hangs the store lease's release here, so
+        drain/retire/hot-swap teardown — which all funnel through
+        ``close()`` — decrement the refcount and the LAST engine frees the
+        tree."""
+        self._close_hooks.append(cb)
+
+    def close(self):
+        """Release resources held beyond the engine's own arrays.
+        Idempotent: hooks run exactly once, so the drain→retire→fleet-close
+        sequence (each of which closes the replica) releases a shared
+        weight lease once, not thrice."""
+        hooks, self._close_hooks = self._close_hooks, []
+        for cb in hooks:
+            cb()
 
     def decode_cb(self):
         if self._decode_cb is None:

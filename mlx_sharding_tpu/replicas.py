@@ -139,6 +139,12 @@ class ReplicaSet:
             else make_lock("ReplicaSet._serial_locks[*]")
             for r in self.replicas
         ]
+        # retirement callback (server wiring): invoked with the replica
+        # object after drain() closes and retires it, so the owner can
+        # recycle what the replica held — today the device-slice free-list
+        # the spawn factories draw from. Failures are logged, never raised:
+        # a broken recycle hook must not fail an otherwise-clean drain.
+        self.on_retire = None
         # ---------------------------------------- load-aware routing state
         if route_imbalance < 0:
             raise ValueError("route_imbalance must be >= 0")
@@ -562,6 +568,17 @@ class ReplicaSet:
             if hasattr(r, "close"):
                 r.close()
             closed = True
+            # replica fully out: hand its resources back (device-slice
+            # free-list). Retired-without-closing replicas keep theirs —
+            # their streams are still unwinding on those devices.
+            hook = self.on_retire
+            if hook is not None:
+                try:
+                    hook(r)
+                except Exception:  # noqa: BLE001 — recycling is best-effort
+                    logging.getLogger(__name__).exception(
+                        "on_retire hook failed for replica %d", i
+                    )
         else:
             logging.getLogger(__name__).warning(
                 "replica %d retired with %d dispatches still unwinding — "
@@ -671,6 +688,11 @@ class ReplicaSet:
                 except Exception:  # noqa: BLE001 — gauge, not a contract
                     q = 0
             snap[j]["queue_depth"] = q
+            # cross-replica shared weights (weights.WeightStore): which
+            # replicas alias a resident tree vs own a private upload
+            snap[j]["weights_shared"] = bool(
+                getattr(r, "weights_shared", False)
+            )
         return snap
 
     def fleet_stats(self) -> dict:
@@ -690,6 +712,11 @@ class ReplicaSet:
                 "affinity_entries": len(self._affinity),
                 "affinity_hits": self.route_affinity_hits,
                 "sticky_hits": self.route_sticky_hits,
+                "weights_shared": sum(
+                    1 for j, r in enumerate(self.replicas)
+                    if not self._retired[j]
+                    and getattr(r, "weights_shared", False)
+                ),
             }
 
     def page_stats(self):

@@ -830,6 +830,14 @@ class ContinuousBatcher:
         finally:
             req.cancelled = True  # scheduler reclaims the slot next tick
 
+    @property
+    def weights_shared(self) -> bool:
+        """True when this batcher's engine aliases a WeightStore-resident
+        tree instead of owning a private upload — the per-replica
+        ``mst_replica_weights_shared`` gauge reads this through the
+        ReplicaSet."""
+        return bool(getattr(self.engine, "weights_shared", False))
+
     def stats(self) -> tuple[int, int, int]:
         """(total slots, active slots, queued requests) — the /metrics
         contract, kept here so scheduler internals can change freely."""
@@ -1149,6 +1157,14 @@ class ContinuousBatcher:
         spill = self.spill  # mst: allow(MST201): bound once in __init__, never reassigned
         if spill is not None:
             spill.close()
+        # release engine-held resources (a shared-weight store lease drops
+        # its ref here — drain/retire/hot-swap all funnel through close())
+        eng_close = getattr(self.engine, "close", None)  # mst: allow(MST201): bound once in __init__, never reassigned
+        if eng_close is not None:
+            eng_close()
+        draft = self.draft  # mst: allow(MST201): bound once in __init__, never reassigned
+        if draft is not None and hasattr(draft, "close"):
+            draft.close()
 
     # ------------------------------------------------------------ internals
     def _ensure_running(self):

@@ -21,6 +21,7 @@ of accidental).
 
 from __future__ import annotations
 
+import heapq
 import json
 import logging
 import os
@@ -47,6 +48,7 @@ from mlx_sharding_tpu.tokenizer_utils import (
     stopping_criteria,
 )
 from mlx_sharding_tpu.utils.observability import ServingMetrics, profile_trace
+from mlx_sharding_tpu.weights import weight_store
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +90,46 @@ def convert_chat(messages: list, role_mapping: Optional[dict] = None) -> str:
         prompt += f"{prefix}{m['content']}{stop}"
     prompt += role_mapping.get("assistant", "")
     return prompt.rstrip()
+
+
+class _SliceAllocator:
+    """Free-list of per-replica device slices. The spawn factories used to
+    burn a fresh slice index per spawn (``spawn_state["next"] += 1``), so a
+    few spawn/drain cycles exhausted the grid while drained replicas'
+    devices sat idle — a device-slice leak. Retired slices now come back
+    through ``ReplicaSet.on_retire`` and are handed out lowest-index-first
+    (heap), so the fleet reuses hardware instead of failing spawns."""
+
+    def __init__(self, devices, per: int):
+        self.devices = devices
+        self.per = per
+        self.total = len(devices) // per
+        self._free = list(range(self.total))
+        heapq.heapify(self._free)
+        self._lock = make_lock("_SliceAllocator._lock")
+
+    def slice_for(self, i: int):
+        return self.devices[i * self.per : (i + 1) * self.per]
+
+    def take(self) -> int:
+        with self._lock:
+            if not self._free:
+                raise RuntimeError(
+                    f"no free device slice: all {self.total} slices of "
+                    f"{self.per} device(s) are held by live replicas"
+                )
+            return heapq.heappop(self._free)
+
+    def give(self, i: int):
+        with self._lock:
+            # a double-give is an upstream bug, but corrupting the heap
+            # with a duplicate entry would hand one slice to two replicas
+            if 0 <= i < self.total and i not in self._free:
+                heapq.heappush(self._free, i)
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
 
 
 class ModelProvider:
@@ -139,6 +181,7 @@ class ModelProvider:
         disagg: bool = False,
         prefill_replicas: int = 1,
         decode_replicas: int = 1,
+        shared_weights: str = "auto",
     ):
         # admission control: per-batcher bound on queued requests; a full
         # queue rejects with QueueFullError (HTTP 429 + Retry-After)
@@ -165,6 +208,14 @@ class ModelProvider:
         self.disagg = bool(disagg)
         self.prefill_replicas = max(1, prefill_replicas)
         self.decode_replicas = max(1, decode_replicas)
+        # cross-replica shared weights (weights.WeightStore): one resident
+        # packed tree per host, every replica co-located on one model-
+        # parallel slice and aliasing it — fleet weight bytes ~W, not N×W.
+        # "auto" turns it on exactly when a fleet would otherwise hold N
+        # copies: multiple replicas (or disagg pools), single-host, on the
+        # fused-engine path.
+        self.shared_weights = shared_weights
+        self.shared_weights_active = False
         # speculative decoding (single-chip generator path only)
         self.draft_model = draft_model
         self.spec_k = spec_k
@@ -228,6 +279,17 @@ class ModelProvider:
         share: the cache changes the page-allocation sequence, so a
         rank-divergent answer here is a multi-host desync."""
         return bool(self.prompt_cache and self.paged_pool is not None)
+
+    def _shared_weights_on(self) -> bool:
+        """Resolve --shared-weights. ``on`` forces (main() already rejected
+        the incompatible multihost/chained configs); ``auto`` shares exactly
+        when a fleet would otherwise upload N private copies."""
+        mode = (self.shared_weights or "auto").lower()
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        return (self.replicas > 1 or self.disagg) and not self.multihost
 
     def _load_draft(self, cache_dtype):
         """Load the draft model pair for speculative decoding. The draft
@@ -320,29 +382,115 @@ class ModelProvider:
                         self.prefill_replicas + self.decode_replicas
                         if self.disagg else self.replicas
                     )
-                    if want * per > len(devices):
+                    shared = self._shared_weights_on() and not self.multihost
+                    self.shared_weights_active = shared
+                    if shared:
+                        # shared-weights replicas all co-locate on ONE
+                        # model-parallel slice and alias one resident tree
+                        # (jit rejects arrays committed to a different
+                        # device set, so sharing REQUIRES co-location) —
+                        # fleet size is bounded by KV memory, not by how
+                        # many weight copies the grid can hold
+                        if per > len(devices):
+                            raise ValueError(
+                                f"shared-weights serving needs one slice "
+                                f"of {per} devices, have {len(devices)}"
+                            )
+                    elif want * per > len(devices):
                         raise ValueError(
                             f"{want} replicas x {per} devices each "
                             f"needs {want * per} devices, have "
                             f"{len(devices)}"
                         )
 
-                    def build_engine(dev_slice):
-                        engine = PipelineEngine(
-                            model, params,
-                            make_mesh(pp=stages, tp=self.tp, ep=self.ep,
-                                      devices=dev_slice),
-                            stage_bounds=self.stage_bounds,
-                            microbatches=self.concurrent,
-                            max_seq=self.max_seq, cache_dtype=cache_dtype,
-                            prefill_chunk=self.prefill_chunk,
-                            decode_block=self.decode_block,
-                            pool_pages=self.paged_pool
-                            if self.concurrent > 1 else None,
-                            page_size=self.page_size,
-                            paged_attention=self.paged_attention,
-                            kv_dtype=self.kv_dtype,
+                    alloc = _SliceAllocator(devices, per)
+                    store = key = build_weights = None
+                    if shared:
+                        from mlx_sharding_tpu.loading import (
+                            checkpoint_signature,
                         )
+                        from mlx_sharding_tpu.parallel.mesh import (
+                            mesh_fingerprint,
+                        )
+                        from mlx_sharding_tpu.parallel.pipeline import (
+                            place_weights,
+                        )
+                        from mlx_sharding_tpu.weights import (
+                            WeightKey,
+                            aliased_spawn,
+                            weight_store,
+                        )
+
+                        base_mesh = make_mesh(
+                            pp=stages, tp=self.tp, ep=self.ep,
+                            devices=devices[:per],
+                        )
+                        store = weight_store()
+                        key = WeightKey(
+                            checkpoint=checkpoint_signature(
+                                target, keep_quantized=self.keep_quantized
+                            ),
+                            stage_bounds=(
+                                tuple(tuple(b) for b in self.stage_bounds)
+                                if self.stage_bounds else ("auto", stages)
+                            ),
+                            dtype=jnp.dtype(cache_dtype).name,
+                            # build-time transforms are part of the tree's
+                            # identity: projection fusion rewrites the
+                            # layout, the autotune sweep fixes kernel picks
+                            quant=(
+                                f"tp{self.tp}:ep{self.ep}"
+                                f":fuse="
+                                f"{os.environ.get('MST_FUSE_PROJ', '')}"
+                                f":tune="
+                                f"{os.environ.get('MST_QMM_AUTOTUNE', '')}"
+                            ),
+                            placement=mesh_fingerprint(base_mesh),
+                        )
+
+                        def build_weights():
+                            return place_weights(
+                                model, params, base_mesh,
+                                stage_bounds=self.stage_bounds,
+                            )
+
+                    def build_engine(dev_slice, *, weights_lease=None):
+                        if weights_lease is not None:
+                            engine = PipelineEngine(
+                                model, None, weights_lease.weights.mesh,
+                                weights=weights_lease.weights,
+                                stage_bounds=self.stage_bounds,
+                                microbatches=self.concurrent,
+                                max_seq=self.max_seq,
+                                cache_dtype=cache_dtype,
+                                prefill_chunk=self.prefill_chunk,
+                                decode_block=self.decode_block,
+                                pool_pages=self.paged_pool
+                                if self.concurrent > 1 else None,
+                                page_size=self.page_size,
+                                paged_attention=self.paged_attention,
+                                kv_dtype=self.kv_dtype,
+                            )
+                            # retirement releases the ref; the LAST engine
+                            # to close frees the store's tree
+                            engine.on_close(weights_lease.release)
+                        else:
+                            engine = PipelineEngine(
+                                model, params,
+                                make_mesh(pp=stages, tp=self.tp, ep=self.ep,
+                                          devices=dev_slice),
+                                stage_bounds=self.stage_bounds,
+                                microbatches=self.concurrent,
+                                max_seq=self.max_seq,
+                                cache_dtype=cache_dtype,
+                                prefill_chunk=self.prefill_chunk,
+                                decode_block=self.decode_block,
+                                pool_pages=self.paged_pool
+                                if self.concurrent > 1 else None,
+                                page_size=self.page_size,
+                                paged_attention=self.paged_attention,
+                                kv_dtype=self.kv_dtype,
+                            )
                         if self.concurrent > 1 and not self.multihost:
                             from mlx_sharding_tpu.scheduler import (
                                 ContinuousBatcher,
@@ -376,6 +524,40 @@ class ModelProvider:
                             )
                         return engine
 
+                    def spawn_replica():
+                        """One replica by either strategy: alias the
+                        store's resident tree (shared) or take a private
+                        device slice and upload a full copy. Both paths
+                        leave state consistent when the build faults — the
+                        lease is released / the slice returned before the
+                        error propagates, so the autoscaler degrades to
+                        the static fleet with nothing leaked and nothing
+                        freed in use."""
+                        if shared:
+                            return aliased_spawn(
+                                store, key, build_weights,
+                                lambda lease: build_engine(
+                                    devices[:per], weights_lease=lease
+                                ),
+                            )
+                        i = alloc.take()
+                        try:
+                            eng = build_engine(alloc.slice_for(i))
+                        except BaseException:
+                            alloc.give(i)
+                            raise
+                        eng._mst_slice = i
+                        return eng
+
+                    def recycle_slice(rep):
+                        # ReplicaSet.on_retire: a drained-and-closed
+                        # replica's device slice goes back on the free list
+                        # (shared replicas carry no slice tag — their
+                        # release rides the engine close hook)
+                        i = getattr(rep, "_mst_slice", None)
+                        if i is not None:
+                            alloc.give(i)
+
                     if self.disagg:
                         from mlx_sharding_tpu.disagg import DisaggCoordinator
                         from mlx_sharding_tpu.replicas import ReplicaSet
@@ -390,16 +572,13 @@ class ModelProvider:
                         n_pf = self.prefill_replicas
                         n_dc = self.decode_replicas
                         prefill = ReplicaSet([
-                            build_engine(devices[i * per : (i + 1) * per])
-                            for i in range(n_pf)
+                            spawn_replica() for _ in range(n_pf)
                         ], role="prefill")
                         decode = ReplicaSet([
-                            build_engine(
-                                devices[(n_pf + i) * per
-                                        : (n_pf + i + 1) * per]
-                            )
-                            for i in range(n_dc)
+                            spawn_replica() for _ in range(n_dc)
                         ], role="decode")
+                        prefill.on_retire = recycle_slice
+                        decode.on_retire = recycle_slice
                         generator = DisaggCoordinator(prefill, decode)
                         if self.autoscale:
                             from mlx_sharding_tpu.fleet import FleetAutoscaler
@@ -408,36 +587,23 @@ class ModelProvider:
                             # reads only its own pool's pressure
                             # (fleet.pool_pressure), so a prefill storm
                             # can't spawn decode replicas and vice versa.
-                            # Spawns draw device slices from a shared tail:
-                            # the pools compete for leftover hardware
-                            # first-come, and a consumed tail fails the next
-                            # spawn — which degrades to the static pool, by
-                            # design.
-                            spawn_state = {"next": n_pf + n_dc}
-                            spawn_lock = make_lock(
-                                "ModelProvider.disagg_spawn_lock"
-                            )
-
-                            def pool_factory():
-                                with spawn_lock:
-                                    i = spawn_state["next"]
-                                    lo, hi = i * per, (i + 1) * per
-                                    if hi > len(devices):
-                                        raise RuntimeError(
-                                            f"no free device slice for "
-                                            f"replica {i}: need devices "
-                                            f"[{lo}, {hi}), have "
-                                            f"{len(devices)}"
-                                        )
-                                    spawn_state["next"] = i + 1
-                                return build_engine(devices[lo:hi])
-
-                            spare = len(devices) // per - (n_pf + n_dc)
+                            # Private spawns draw device slices from the
+                            # shared free list: the pools compete for
+                            # leftover (and recycled) hardware first-come,
+                            # and an empty list fails the next spawn —
+                            # which degrades to the static pool, by design.
+                            # Shared spawns consume no slice, so each pool
+                            # keeps at least one elastic spawn even on a
+                            # fully-consumed grid.
+                            spare = alloc.total - (n_pf + n_dc)
                             self.fleet = tuple(
                                 FleetAutoscaler(
-                                    pool, pool_factory,
+                                    pool, spawn_replica,
                                     min_replicas=base,
-                                    max_replicas=base + max(0, spare),
+                                    max_replicas=base + (
+                                        max(1, spare) if shared
+                                        else max(0, spare)
+                                    ),
                                     interval_s=self.autoscale_interval,
                                     cooldown_s=self.autoscale_cooldown,
                                     enable_brownout=self.brownout,
@@ -452,38 +618,27 @@ class ModelProvider:
                         from mlx_sharding_tpu.replicas import ReplicaSet
 
                         generator = ReplicaSet([
-                            build_engine(devices[i * per : (i + 1) * per])
-                            for i in range(self.replicas)
+                            spawn_replica() for _ in range(self.replicas)
                         ])
+                        generator.on_retire = recycle_slice
                         if self.autoscale:
                             from mlx_sharding_tpu.fleet import FleetAutoscaler
 
-                            # ReplicaFactory: each spawn takes the next
-                            # unused device slice. Slices are never reused
-                            # after a drain (retired indices are stable), so
-                            # a fleet that has consumed every slice fails
-                            # the spawn — which the autoscaler degrades to
-                            # the static fleet, by design.
-                            spawn_state = {"next": self.replicas}
-
-                            def replica_factory():
-                                i = spawn_state["next"]
-                                lo, hi = i * per, (i + 1) * per
-                                if hi > len(devices):
-                                    raise RuntimeError(
-                                        f"no free device slice for replica "
-                                        f"{i}: need devices [{lo}, {hi}), "
-                                        f"have {len(devices)}"
-                                    )
-                                spawn_state["next"] = i + 1
-                                return build_engine(devices[lo:hi])
-
-                            hw_max = len(devices) // per
+                            hw_max = alloc.total
                             self.fleet = FleetAutoscaler(
-                                generator, replica_factory,
+                                generator, spawn_replica,
                                 min_replicas=self.autoscale_min or 1,
-                                max_replicas=min(
-                                    self.autoscale_max or hw_max, hw_max
+                                # shared replicas don't consume device
+                                # slices, so the grid doesn't cap the fleet
+                                # — KV memory does; private spawns stay
+                                # clamped to the slice count (now a true
+                                # bound on LIVE replicas, since drains
+                                # recycle slices through the free list)
+                                max_replicas=(
+                                    (self.autoscale_max or hw_max) if shared
+                                    else min(
+                                        self.autoscale_max or hw_max, hw_max
+                                    )
                                 ),
                                 interval_s=self.autoscale_interval,
                                 cooldown_s=self.autoscale_cooldown,
@@ -491,7 +646,7 @@ class ModelProvider:
                             )
                             self.fleet.start()
                     else:
-                        generator = build_engine(devices[:per])
+                        generator = spawn_replica()
                     if self.multihost:
                         # (--replicas is rejected with --coordinator, so
                         # `generator` here is the raw single engine)
@@ -650,6 +805,22 @@ class APIHandler(BaseHTTPRequestHandler):
             if hasattr(gen, "health"):
                 payload = dict(gen.health())
                 serving = bool(payload.pop("serving", True))
+            # resident weight-tree occupancy (weights.WeightStore): how many
+            # trees this host holds, how many engine refs alias them, and
+            # the resident bytes — the N×W → ~W number, live
+            try:
+                st = weight_store().stats()
+                payload["weight_store"] = {
+                    "shared_weights": bool(
+                        getattr(self.provider, "shared_weights_active",
+                                False)
+                    ),
+                    "trees": st["trees"],
+                    "refs": st["refs"],
+                    "bytes": st["bytes"],
+                }
+            except Exception:  # noqa: BLE001 — health must render anyway
+                pass
             ctrl = getattr(gen, "ctrl", None)
             if ctrl is not None:
                 # a timed-out collective marks the plane dead (multihost.py
@@ -1340,6 +1511,7 @@ def make_server(
                 spec_fn=lambda: provider.generator
                 if hasattr(provider.generator, "accepted_tokens")
                 else None,
+                weight_store_fn=weight_store,
             ),
             "profile_dir": profile_dir,
             "api_key": api_key,
@@ -1462,6 +1634,20 @@ def main(argv=None):
                              "import instead of a re-prefill; handoff "
                              "failures degrade to serve-in-place (never a "
                              "dropped stream)")
+    parser.add_argument("--shared-weights", choices=("on", "off", "auto"),
+                        default="auto",
+                        help="cross-replica shared weights: place ONE "
+                             "resident packed param tree per host and have "
+                             "every replica (and both disagg pools) alias "
+                             "it — fleet weight bytes ~W instead of N*W, "
+                             "and an autoscaler spawn costs slot/cache "
+                             "setup instead of a checkpoint re-upload. "
+                             "Replicas co-locate on one model-parallel "
+                             "slice (capacity is then bounded by KV "
+                             "memory, not weight copies). auto: on when "
+                             "--replicas > 1 or --disagg on a single-host "
+                             "fused-engine config; off: always private "
+                             "per-replica copies")
     parser.add_argument("--prefill-replicas", type=int, default=1,
                         help="with --disagg: replicas in the prefill pool")
     parser.add_argument("--decode-replicas", type=int, default=1,
@@ -1716,6 +1902,19 @@ def main(argv=None):
     if args.autoscale_interval <= 0 or args.autoscale_cooldown < 0:
         parser.error("--autoscale-interval must be > 0 and "
                      "--autoscale-cooldown >= 0")
+    if args.shared_weights == "on":
+        if args.coordinator or (args.num_processes or 1) > 1:
+            parser.error("--shared-weights on is single-host only: worker "
+                         "ranks hold their own device grids, there is no "
+                         "one resident tree for them to alias")
+        if args.engine == "chained":
+            parser.error("--shared-weights on requires the fused engine "
+                         "path (chained stage processes each own their "
+                         "stage's weights)")
+        if args.replicas <= 1 and not args.disagg:
+            parser.error("--shared-weights on requires --replicas N "
+                         "(N > 1) or --disagg: with one engine there is "
+                         "nothing to alias")
     if args.max_queue is not None:
         if args.max_queue < 1:
             parser.error("--max-queue must be a positive integer")
@@ -1768,6 +1967,7 @@ def main(argv=None):
         disagg=args.disagg,
         prefill_replicas=args.prefill_replicas,
         decode_replicas=args.decode_replicas,
+        shared_weights=args.shared_weights,
     )
     if multihost:
         import jax

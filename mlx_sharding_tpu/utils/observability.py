@@ -77,6 +77,10 @@ class ServingMetrics:
     batcher_fn: object = None
     # zero-arg callable returning the live SpeculativeGenerator (or None)
     spec_fn: object = None
+    # zero-arg callable returning the host's weights.WeightStore (or None);
+    # defaults to the module singleton at render time so the shared-weights
+    # gauges exist even for servers built without make_server
+    weight_store_fn: object = None
 
     def record_request(
         self,
@@ -338,6 +342,14 @@ class ServingMetrics:
                             f"mst_replica_breaker_state{{{_rl(rep)}}} "
                             f"{rep['breaker_state']}"
                         )
+                    # 1 = this replica aliases the host's resident weight
+                    # tree (weights.WeightStore), 0 = private upload
+                    lines.append("# TYPE mst_replica_weights_shared gauge")
+                    for rep in per_rep:
+                        lines.append(
+                            f"mst_replica_weights_shared{{{_rl(rep)}}} "
+                            f"{int(bool(rep.get('weights_shared')))}"
+                        )
                 fleet = getattr(b, "fleet_stats", lambda: None)()
                 if fleet is not None:
                     lines += [
@@ -435,5 +447,28 @@ class ServingMetrics:
                     "# TYPE mst_spec_tokens_replayed_total counter",
                     f"mst_spec_tokens_replayed_total "
                     f"{getattr(spec, 'replayed_tokens', 0)}",
+                ]
+            # cross-replica shared weights (weights.WeightStore): resident
+            # tree count, engine refs aliasing them, and resident bytes —
+            # with sharing on, bytes stays ~W while refs tracks fleet size;
+            # always emitted (zeros mean every replica owns a private copy)
+            try:
+                if self.weight_store_fn is not None:
+                    ws = self.weight_store_fn()
+                else:
+                    from mlx_sharding_tpu.weights import weight_store
+
+                    ws = weight_store()
+                store = ws.stats() if ws is not None else None
+            except Exception:  # noqa: BLE001 — scrapes must never 500
+                store = None
+            if store is not None:
+                lines += [
+                    "# TYPE mst_weight_store_trees gauge",
+                    f"mst_weight_store_trees {store['trees']}",
+                    "# TYPE mst_weight_store_refs gauge",
+                    f"mst_weight_store_refs {store['refs']}",
+                    "# TYPE mst_weight_store_bytes gauge",
+                    f"mst_weight_store_bytes {store['bytes']}",
                 ]
         return "\n".join(lines) + "\n"

@@ -1,0 +1,215 @@
+"""Cross-replica shared weights: one resident packed copy per host.
+
+Every data-parallel replica used to upload its OWN device copy of the
+packed params — N replicas cost N×W HBM and every autoscaler spawn paid a
+full checkpoint re-placement before it could serve. The ``WeightStore``
+breaks that: device-resident param trees (the output of
+``parallel.pipeline.place_weights``) are keyed by (checkpoint, stage
+bounds, dtype, quant/fusion config, mesh placement) and placed ONCE; every
+replica whose engine runs on the same model-parallel footprint aliases the
+same arrays through a refcounted lease. Fleet weight bytes drop from N×W
+to ~W, and a spawn that hits the store costs slot/cache setup only — the
+PRESERVE-style property (arXiv:2501.08192) that scaling out overlaps with
+serving instead of stalling on checkpoint I/O.
+
+Lifecycle contract:
+
+- ``acquire(key, build)`` returns a ``WeightLease``; the first acquire of
+  a key runs ``build()`` (the one real upload), later acquires alias it.
+- Each engine holds exactly one lease and releases it from ``close()``
+  (``PipelineEngine.on_close``); ``ReplicaSet.drain``/``close`` and disagg
+  pool teardown ride that hook, so retirement releases refs and the LAST
+  release drops the store's reference (the arrays die with the last
+  engine).
+- A faulted spawn must release the lease it acquired before re-raising
+  (``aliased_spawn`` wraps that), so ``replica.spawn`` faults leave
+  refcounts consistent: never a leaked tree, never one freed in use.
+- Releasing a key the store doesn't hold — or the same lease twice — is a
+  bug, and raises.
+
+The store is deliberately jax-free: it holds whatever resident-tree object
+the builder returns (``ResidentWeights`` in practice) and only reads its
+``weight_bytes`` for the ``mst_weight_store_bytes`` gauge.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from mlx_sharding_tpu.analysis.runtime import make_lock
+
+
+@dataclass
+class ResidentWeights:
+    """A device-resident weight tree plus everything an engine needs to
+    execute against it without re-deriving placement: the mesh it lives
+    on, the resolved stage split, the PartitionSpecs, and the vocab-shard
+    machinery. Built by ``parallel.pipeline.place_weights``; consumed by
+    ``PipelineEngine(..., weights=...)`` for alias-fast construction."""
+
+    mesh: Any
+    stage_bounds: list
+    layer_specs: Any
+    layer_params: Any
+    layer_masks: Any
+    layers_per_stage: int
+    fused_projections: list
+    vocab_size: int
+    head_tied: bool
+    vocab_parts: Any
+    shared_params: Any
+    weight_bytes: int
+
+
+@dataclass(frozen=True)
+class WeightKey:
+    """Identity of a resident tree. Two engines share arrays iff every
+    field matches: the checkpoint's weight content (resolved path + quant
+    config + packed/dense residency, see ``loading.checkpoint_signature``),
+    the stage split, the compute dtype, the build-time fusion config, and
+    the mesh placement (``mesh_fingerprint``) — arrays are device-resident,
+    so WHERE they live is part of WHAT they are."""
+
+    checkpoint: str
+    stage_bounds: tuple
+    dtype: str
+    quant: str
+    placement: str
+
+
+class WeightLease:
+    """One engine's refcounted handle on a resident tree. ``release()`` is
+    single-shot by contract — the double-release of a shared tree is how a
+    freed-in-use bug starts, so the second call raises instead of silently
+    decrementing someone else's ref."""
+
+    __slots__ = ("store", "key", "weights", "_released")
+
+    def __init__(self, store: "WeightStore", key: WeightKey, weights):
+        self.store = store
+        self.key = key
+        self.weights = weights
+        self._released = False
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+    def release(self) -> bool:
+        """Drop this lease's ref. Returns True when this was the last ref
+        and the store freed the tree."""
+        if self._released:
+            raise RuntimeError(
+                f"weight lease for {self.key.checkpoint!r} released twice"
+            )
+        self._released = True
+        return self.store.release(self.key)
+
+
+class _Entry:
+    __slots__ = ("weights", "refs")
+
+    def __init__(self, weights):
+        self.weights = weights
+        self.refs = 0
+
+
+@dataclass
+class WeightStore:
+    """Refcounted registry of device-resident weight trees, one per
+    ``WeightKey``. Per-host singleton in serving (``weight_store()``);
+    tests build private instances."""
+
+    _lock: Any = field(default_factory=lambda: make_lock("WeightStore._lock"))
+    _entries: dict = field(default_factory=dict)
+
+    def acquire(self, key: WeightKey, build: Callable[[], Any]) -> WeightLease:
+        """Lease the tree for ``key``, building (uploading) it iff absent.
+        The build runs under the store lock: two concurrent spawns of the
+        same key must produce ONE placement, and an upload racing a
+        last-release free must not resurrect a half-dropped entry. A build
+        that raises leaves no entry behind."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = _Entry(build())
+                self._entries[key] = entry
+            entry.refs += 1
+            return WeightLease(self, key, entry.weights)
+
+    def release(self, key: WeightKey) -> bool:
+        """Drop one ref on ``key``; the last release frees the store's
+        reference (engines still alive keep the arrays alive through their
+        own attributes — the device memory dies with the last of them).
+        Releasing a key the store doesn't hold raises: it means a lease
+        was double-released or never acquired."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                raise RuntimeError(
+                    f"release of weight tree the store does not hold: {key}"
+                )
+            entry.refs -= 1
+            if entry.refs == 0:
+                del self._entries[key]
+                return True
+            return False
+
+    def refs(self, key: WeightKey) -> int:
+        with self._lock:
+            entry = self._entries.get(key)
+            return 0 if entry is None else entry.refs
+
+    def stats(self) -> dict:
+        """Gauge source for ``mst_weight_store_{bytes,trees,refs}`` and the
+        /health store block."""
+        with self._lock:
+            entries = [
+                {
+                    "checkpoint": key.checkpoint,
+                    "placement": key.placement,
+                    "refs": e.refs,
+                    "bytes": int(getattr(e.weights, "weight_bytes", 0) or 0),
+                }
+                for key, e in self._entries.items()
+            ]
+        return {
+            "trees": len(entries),
+            "refs": sum(e["refs"] for e in entries),
+            "bytes": sum(e["bytes"] for e in entries),
+            "entries": entries,
+        }
+
+
+def aliased_spawn(
+    store: WeightStore,
+    key: WeightKey,
+    build: Callable[[], Any],
+    make_engine: Callable[[WeightLease], Any],
+):
+    """The spawn-path contract in one place: acquire a lease, construct the
+    engine against it, and on ANY construction failure release the lease
+    before re-raising — a faulted ``replica.spawn`` degrades to the static
+    fleet with refcounts exactly as they were, never holding a ref for an
+    engine that doesn't exist (leak) and never having freed a tree another
+    replica is executing against."""
+    lease = store.acquire(key, build)
+    try:
+        return make_engine(lease)
+    except BaseException:
+        lease.release()
+        raise
+
+
+_STORE: Optional[WeightStore] = None
+_STORE_LOCK = make_lock("weights._STORE_LOCK")
+
+
+def weight_store() -> WeightStore:
+    """The per-host (per-process) store serving and /metrics share."""
+    global _STORE
+    with _STORE_LOCK:
+        if _STORE is None:
+            _STORE = WeightStore()
+        return _STORE

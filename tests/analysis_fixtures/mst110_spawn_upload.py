@@ -1,0 +1,11 @@
+"""MST110: the full param tree re-placed on device inside a spawn-hot
+replica factory — every autoscaler spawn pays a checkpoint upload and a
+second W of HBM. The upload belongs in the WeightStore builder; the
+factory should alias the resident tree through store.acquire()."""
+import jax
+
+
+# mst: spawn-hot
+def spawn_with_upload(model, params, shardings, mesh):
+    resident = jax.device_put(params, shardings)
+    return model.bind(resident, mesh)
