@@ -525,6 +525,192 @@ def measure_cb_prefix(model, params, label: str) -> dict:
     return res
 
 
+def measure_prefix_reuse_ttft(model, params, label: str) -> dict:
+    """Content-addressed prefix store (PrefixStore) under a system-prompt-
+    heavy arrival mix: 3 hot 3-page prefixes x 12 continuations vs 12
+    all-unique prompts of the same shape, A/B store on/off. Reports p50/p99
+    TTFT and prefill tokens-executed per cohort (store accounting: prompt
+    tokens minus tokens served from registered pages) — the hot cohort's
+    executed count dropping to ~one prefill per unique prefix is the
+    feature; the TTFT delta scales with chip speed. Two more legs:
+    zero-dropped-streams under fault injection at cache.prefix_lookup
+    (every probe raises, every stream must still finish off the miss
+    path), and the capacity composition — max live one-fresh-page sessions
+    at fixed pool bytes, bf16 bare vs int8 + cold-spill + shared-prefix
+    COW (the frontier composition)."""
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.prefix_store import PrefixStore
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+    from mlx_sharding_tpu.testing import faults
+
+    vocab = model.config.vocab_size
+    page = 128
+    rng = np.random.default_rng(23)
+
+    def toks(n: int) -> list:
+        return [int(x) for x in rng.integers(1, vocab - 64, n)]
+
+    hot_heads = [toks(3 * page) for _ in range(3)]
+    suffixes = [toks(page // 2) for _ in range(12)]
+    hot_mix = [hot_heads[i % 3] + suffixes[i] for i in range(12)]
+    uniq_mix = [toks(3 * page) + suffixes[i] for i in range(12)]
+
+    def run_mix(prompts, store) -> dict:
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1), microbatches=2,
+            max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+            pool_pages=24, page_size=page,
+        )
+        kw = dict(prefix_store=store) if store is not None else {}
+        batcher = ContinuousBatcher(eng, decode_block=8, **kw)
+        ttfts, dropped = [], 0
+        try:
+            # warmup: 1-page prompt (below the store's digest floor) so
+            # compiles land outside the measurement without touching stats
+            for _ in batcher.generate_step(toks(page), max_tokens=8):
+                pass
+            for p in prompts:
+                t0 = time.perf_counter()
+                first = None
+                for _tok, _ in batcher.generate_step(p, max_tokens=16):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                if first is None:
+                    dropped += 1
+                else:
+                    ttfts.append(first * 1e3)
+        finally:
+            batcher.close()
+        ttfts.sort()
+        total = sum(len(p) for p in prompts)
+        s = store.stats() if store is not None else {}
+        return dict(
+            ttft_p50_ms=round(ttfts[len(ttfts) // 2], 1) if ttfts else None,
+            ttft_p99_ms=round(ttfts[-1], 1) if ttfts else None,
+            prompt_tokens=total,
+            prefill_tokens_executed=total - int(s.get("tokens_reused", 0)),
+            tokens_reused=int(s.get("tokens_reused", 0)),
+            hits=int(s.get("hits", 0)), misses=int(s.get("misses", 0)),
+            inserts=int(s.get("inserts", 0)),
+            lookup_faults=int(s.get("lookup_faults", 0)),
+            dropped_streams=dropped,
+        )
+
+    def run_frontier(kv_dtype, pool_pages: int, composed: bool) -> dict:
+        # 16 sessions over ONE shared 1-page head: bare bf16 reserves 2
+        # pages each; the composed config (int8 pages + cold-slot spill +
+        # store COW) maps the head read-only and parks idle slots, so live
+        # climbs toward the whole session set at no more pool bytes
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1), microbatches=8,
+            max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+            pool_pages=pool_pages, page_size=page, kv_dtype=kv_dtype,
+        )
+        kw: dict = {}
+        if composed:
+            kw.update(spill_bytes=256 << 20, spill_cold_after=2,
+                      kv_prefetch="on",
+                      prefix_store=PrefixStore(host_bytes=256 << 20))
+        batcher = ContinuousBatcher(eng, decode_block=8, **kw)
+        sessions = 16
+        shared = toks(page)
+        prompts = [shared + toks(8) for _ in range(sessions)]
+        stall = threading.Event()
+        started = [0]
+        lock = threading.Lock()
+
+        def consume(p):
+            gen = batcher.generate_step(p, max_tokens=page - 24)
+            try:
+                next(gen)  # first token: the session is live
+                with lock:
+                    started[0] += 1
+                stall.wait()  # idle mid-stream; the cold policy's shape
+            finally:
+                gen.close()
+
+        threads = [
+            threading.Thread(target=consume, args=(p,), daemon=True)
+            for p in prompts
+        ]
+
+        def _join_all(budget_s):
+            end = time.monotonic() + budget_s
+            for t in threads:
+                t.join(timeout=max(0.0, end - time.monotonic()))
+
+        try:
+            for _ in batcher.generate_step(prompts[0], max_tokens=8):
+                pass  # compile prefill + the 8-slot decode block
+            for t in threads:
+                t.start()
+            peak = parked = 0
+            last_gain = time.monotonic()
+            deadline = last_gain + 30.0
+            while time.monotonic() < deadline:
+                s = batcher.spill_stats() or {}
+                _, in_use, _ = batcher.page_stats()
+                parked = int(s.get("parked", 0))
+                if composed:
+                    # resident sessions hold 1 fresh page past the shared
+                    # head; parked ones hold none (pages released to host)
+                    live = max(0, in_use - 1) + parked
+                else:
+                    live = in_use // 2  # 2 reserved pages per session
+                if live > peak:
+                    peak, last_gain = live, time.monotonic()
+                if peak >= sessions or time.monotonic() - last_gain > 3.0:
+                    break
+                time.sleep(0.002)
+            stall.set()
+            # consumers still waiting on admission stay blocked until
+            # close() feeds them the shutdown sentinel
+            _join_all(5.0)
+        finally:
+            batcher.close()
+        _join_all(30.0)
+        return dict(kv_dtype=kv_dtype, pool_pages=pool_pages,
+                    peak_live_sessions=peak, parked=parked,
+                    sessions_started=started[0], sessions=sessions)
+
+    res = dict(label=label)
+    res["hot_store"] = run_mix(hot_mix, PrefixStore(host_bytes=256 << 20))
+    res["hot_bare"] = run_mix(hot_mix, None)
+    res["uniq_store"] = run_mix(uniq_mix, PrefixStore(host_bytes=256 << 20))
+    res["uniq_bare"] = run_mix(uniq_mix, None)
+    # fault leg: every prefix_lookup probe raises; streams degrade to the
+    # miss path and must all complete — dropped_streams is the contract
+    faults.arm("cache.prefix_lookup", exc=faults.FaultError)
+    try:
+        res["hot_store_lookup_fault"] = run_mix(
+            hot_mix, PrefixStore(host_bytes=256 << 20)
+        )
+    finally:
+        faults.disarm()
+    d = model.config.head_dim
+    pages_bf16 = 4
+    pages_int8 = int(pages_bf16 * (2 * d) / (d + 4))
+    res["frontier_bf16"] = run_frontier("bf16", pages_bf16, composed=False)
+    res["frontier_composed"] = run_frontier("int8", pages_int8,
+                                            composed=True)
+    hs, hb = res["hot_store"], res["hot_bare"]
+    log(f"[{label}] hot mix: prefill exec {hs['prefill_tokens_executed']}"
+        f"/{hs['prompt_tokens']} tok (bare {hb['prefill_tokens_executed']}), "
+        f"p50 TTFT {hs['ttft_p50_ms']}ms vs {hb['ttft_p50_ms']}ms, "
+        f"fault-leg dropped={res['hot_store_lookup_fault']['dropped_streams']}"
+        f" (faults={res['hot_store_lookup_fault']['lookup_faults']}); "
+        f"frontier live {res['frontier_bf16']['peak_live_sessions']} -> "
+        f"{res['frontier_composed']['peak_live_sessions']}"
+        f"/{res['frontier_composed']['sessions']}")
+    return res
+
+
 def measure_cb_overcommit(model, params, label: str) -> dict:
     """Over-commit occupancy under MIXED traffic (VERDICT r4 weak #3: the
     uniform cb config never showed it). Four requests ask for a large
@@ -2048,6 +2234,19 @@ def main() -> int:
                         error=repr(e)[:300]
                     )
                     log(f"[kv_capacity_frontier_cpu] FAILED: {e!r}")
+                # prefix-store reuse rides it too: the composed frontier
+                # leg shares the frontier's D >= 64 int8 page math
+                try:
+                    detail["prefix_reuse_ttft_cpu"] = (
+                        measure_prefix_reuse_ttft(
+                            m3, p3, "prefix_reuse_ttft_cpu"
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001
+                    detail["prefix_reuse_ttft_cpu"] = dict(
+                        error=repr(e)[:300]
+                    )
+                    log(f"[prefix_reuse_ttft_cpu] FAILED: {e!r}")
 
     if not cpu_fallback:
         n_params = param_count(cfg_dict)
@@ -2179,6 +2378,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["cb_prefix_cache"] = dict(error=repr(e)[:300])
             log(f"[cb_prefix_cache] FAILED: {e!r}")
+        gc.collect()
+        try:
+            detail["prefix_reuse_ttft"] = measure_prefix_reuse_ttft(
+                model, params, "prefix_reuse_ttft"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["prefix_reuse_ttft"] = dict(error=repr(e)[:300])
+            log(f"[prefix_reuse_ttft] FAILED: {e!r}")
         gc.collect()
         try:
             detail["cb_overcommit"] = measure_cb_overcommit(

@@ -48,6 +48,9 @@ KNOWN_FAULT_SITES = {
     # disaggregated serving (disagg.py): the prefill→decode handoff
     # control point — must degrade to serve-in-place, never drop a stream
     "disagg.handoff",
+    # content-addressed prefix store (prefix_store.py): the admission-time
+    # LPM probe — must degrade to plain prefill, never a wrong stream
+    "cache.prefix_lookup",
 }
 # basename -> the inject() site that file must keep calling
 REQUIRED_FAULT_SITES = {
@@ -58,6 +61,7 @@ REQUIRED_FAULT_SITES = {
     "fleet.py": "autoscaler.tick",
     "kv_transfer.py": "cache.export",
     "disagg.py": "disagg.handoff",
+    "prefix_store.py": "cache.prefix_lookup",
 }
 
 

@@ -69,6 +69,18 @@
   current decode block's compute — and keep demand import as a counted
   off-tick fallback. An MST102/MST106 suppression nearby does NOT cover
   this rule.
+- **MST111 store-import-in-tick** — an upload call inside a tick-hot
+  function whose argument touches a result fetched from a prefix KV store
+  (a name assigned from ``<...store...>.lookup()`` / ``.host_block()``).
+  The fleet-wide prefix store's host tier holds whole page-chain payloads;
+  marshaling one host→device inline in the tick stalls every live slot's
+  decode exactly like the MST109 demand-paged resume. The admission
+  discipline is the same PRESERVE shape: the (non-hot) waiting-queue pass
+  stages the block with ``KVPageBlock.prefetch()`` while decode runs
+  (``_prefetch_store_waiting``), admission scatters the staged copy, and
+  demand import stays a counted off-tick fallback. MST109 tracks the spill
+  tier's ``take``/``peek``; this rule tracks the store's lookup surface —
+  a suppression on one does NOT cover the other.
 - **MST110 weight-upload-in-spawn** — a full param-tree placement
   (``jax.device_put`` / ``put_global`` / ``place_weights``) inside a
   spawn-hot function: the replica-spawn factories the autoscaler calls
@@ -152,6 +164,11 @@ UPLOAD_CALLS = {"jax.device_put", "jnp.asarray", "jnp.array",
 # tier lookups whose results MST109 tracks as block-bearing names
 BLOCK_PAGE_ATTRS = {"k_pages", "v_pages"}
 TIER_LOOKUP_ATTRS = {"take", "peek"}
+
+# prefix-store lookup surface MST111 tracks: a call ``<recv>.<attr>(...)``
+# where the receiver's dotted name mentions "store" and the attr is one of
+# these marks the assigned name as (potentially) host-block-bearing
+STORE_LOOKUP_ATTRS = {"lookup", "host_block"}
 
 # spawn-hot roots checked by MST110 (beyond '# mst: spawn-hot'
 # annotations): the replica-spawn factories the fleet autoscaler calls
@@ -644,6 +661,59 @@ def _check_sync_import(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+def _check_store_import(mod: ModuleInfo) -> list[Finding]:
+    """MST111: a prefix-store host block uploaded inside a tick-hot
+    function. MST109-shaped, but tracking the store's lookup surface
+    (``<...store...>.lookup()`` / ``.host_block()``) instead of the spill
+    tier's ``take``/``peek`` — the admission discipline stages the block
+    via the non-hot waiting-queue prefetch pass and keeps demand import
+    off the tick as a counted fallback."""
+    findings = []
+    for fn in _hot_functions(mod):
+        block_names: set[str] = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in STORE_LOOKUP_ATTRS):
+                continue
+            recv = dotted_name(node.value.func.value)
+            if recv is None or "store" not in recv.lower():
+                continue
+            for t in node.targets:
+                tname = dotted_name(t)
+                if tname:
+                    block_names.add(tname.split(".")[-1])
+        if not block_names:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in UPLOAD_CALLS:
+                continue
+            touches_block = any(
+                isinstance(sub, ast.Name) and sub.id in block_names
+                for arg in node.args
+                for sub in ast.walk(arg)
+            )
+            if touches_block:
+                findings.append(Finding(
+                    "MST111", mod.display_path, node.lineno, node.col_offset,
+                    f"prefix-store import in hot path {fn.name}(): "
+                    f"{name}() marshals a store-held host block inline, "
+                    "stalling every live slot's decode for the full "
+                    "host→device copy — stage it with KVPageBlock.prefetch() "
+                    "from the waiting-queue pass (the copy overlaps decode) "
+                    "and keep demand import off the tick as a counted "
+                    "fallback",
+                    context=qualname_for_line(mod.tree, node.lineno),
+                ))
+    return findings
+
+
 def _check_recompile_hazards(mod: ModuleInfo) -> list[Finding]:
     jitted = _jitted_names(mod.tree)
     if not jitted:
@@ -738,6 +808,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_sync_spill(mod)
     findings += _check_block_migration(mod)
     findings += _check_sync_import(mod)
+    findings += _check_store_import(mod)
     findings += _check_spawn_weight_upload(mod)
     findings += _check_recompile_hazards(mod)
     findings += _check_dense_dequant(mod, table)

@@ -101,7 +101,7 @@ class DisaggCoordinator:
     supports_sessions = True  # stickiness applies to the prefill leg
 
     def __init__(self, prefill_pool, decode_pool, *,
-                 handoff_window: int = 512):
+                 handoff_window: int = 512, prefix_store=None):
         for rep in getattr(prefill_pool, "replicas", [prefill_pool]):
             if not getattr(rep, "supports_prefill_only", False):
                 raise ValueError(
@@ -117,9 +117,16 @@ class DisaggCoordinator:
                 )
         self.prefill = prefill_pool
         self.decode = decode_pool
+        # fleet-wide prefix store (optional): when the WHOLE prompt is
+        # already covered — a device entry on some decode replica or a
+        # host-tier block — phase 1 is pure overhead, so generate_step
+        # skips the prefill pool entirely and the decode pool serves from
+        # token 0 (its admission imports/leases the covered prefix)
+        self.prefix_store = prefix_store
         self._lock = make_lock("DisaggCoordinator._lock")
         self.handoffs = 0          # completed prefill→decode handoffs
         self.handoff_bytes = 0     # sum of shipped block payloads
+        self.store_skips = 0       # full store hits that skipped phase 1
         self.fallbacks: dict = {}  # degradation counts by kind
         self._ms: deque = deque(maxlen=handoff_window)  # DMA+control ms
 
@@ -175,41 +182,60 @@ class DisaggCoordinator:
         if ttft is not None and resume_kw.get("stall_timeout") is None:
             resume_kw["stall_timeout"] = ttft
 
-        # ---- phase 1: the prefill pool delivers the first token
+        # ---- phase 0: fleet-store full-hit check — when the store already
+        # covers the ENTIRE prompt (a decode replica's device entry or a
+        # host-tier block), dispatching to the prefill pool would prefill
+        # nothing: skip phase 1 outright and let the decode pool serve
+        # from token 0, admission leasing/importing the covered prefix.
+        # A sick store (injected ``cache.prefix_lookup``) degrades to the
+        # normal two-phase path — never a wrong or dropped stream.
         state: Optional[ResumeState] = None
         monolithic = False
-        it = self.prefill.generate_step(
-            prompt_tokens, _prefill_only=True, **kw
-        )
-        try:
-            for item in it:
-                if trackable:
-                    trackable = _track(item)
-                yield item
-            return  # max_tokens == 1: the stream completed during prefill
-        except GeneratorExit:
-            it.close()
-            raise
-        except HandoffReadyError as exc:
-            state = exc.state  # the expected exit: run the handoff below
-        except (ValueError, RequestTimeoutError):
-            raise  # bad request / blown budget — not a placement problem
-        except QueueFullError:
-            if not emitted:
-                raise  # saturation: 429 + Retry-After, do not spill the
-                # overflow onto the decode pool (that is the SLO leak
-                # disaggregation exists to close)
-            self._count("prefill_failed")  # mid-replacement full queues
-        except Exception:
-            if emitted and not trackable:
-                raise  # tokens delivered, no exact continuation possible
-            if emitted:
-                self._count("prefill_failed")
-            else:
-                # nothing delivered yet: the decode pool serves the whole
-                # request monolithically — degraded, never dropped
-                self._count("prefill_unavailable")
-                monolithic = True
+        skip_prefill = False
+        if self.prefix_store is not None:
+            try:
+                skip_prefill = self.prefix_store.covers_full(prompt_tokens)
+            except Exception:  # noqa: BLE001 — advisory check only
+                skip_prefill = False
+        if skip_prefill:
+            with self._lock:
+                self.store_skips += 1
+            monolithic = True  # decode-pool-first, original kwargs
+
+        # ---- phase 1: the prefill pool delivers the first token
+        if not monolithic:
+            it = self.prefill.generate_step(
+                prompt_tokens, _prefill_only=True, **kw
+            )
+            try:
+                for item in it:
+                    if trackable:
+                        trackable = _track(item)
+                    yield item
+                return  # max_tokens == 1: the stream completed during prefill
+            except GeneratorExit:
+                it.close()
+                raise
+            except HandoffReadyError as exc:
+                state = exc.state  # the expected exit: run the handoff below
+            except (ValueError, RequestTimeoutError):
+                raise  # bad request / blown budget — not a placement problem
+            except QueueFullError:
+                if not emitted:
+                    raise  # saturation: 429 + Retry-After, do not spill the
+                    # overflow onto the decode pool (that is the SLO leak
+                    # disaggregation exists to close)
+                self._count("prefill_failed")  # mid-replacement full queues
+            except Exception:
+                if emitted and not trackable:
+                    raise  # tokens delivered, no exact continuation possible
+                if emitted:
+                    self._count("prefill_failed")
+                else:
+                    # nothing delivered yet: the decode pool serves the whole
+                    # request monolithically — degraded, never dropped
+                    self._count("prefill_unavailable")
+                    monolithic = True
 
         # ---- phase 2: handoff (or fallback re-placement)
         if state is not None:
@@ -287,6 +313,7 @@ class DisaggCoordinator:
             return {
                 "handoffs": self.handoffs,
                 "bytes_total": self.handoff_bytes,
+                "store_skips": self.store_skips,
                 "fallbacks": dict(self.fallbacks),
                 "ms_p50": _pct(ms, 50),
                 "ms_p99": _pct(ms, 99),

@@ -16,7 +16,9 @@ Two controllers:
 level  degradation (cumulative)
 ====== ==========================================================
 0      healthy — no intervention
-1      cap ``max_tokens`` (long generations are the cheapest ballast)
+1      cap ``max_tokens`` (long generations are the cheapest ballast),
+       and pause prefix-store INSERTION (demotion exports are deferrable
+       churn; serving hits stays on — hits SHED load, they don't add it)
 2      … and disable speculation (draft compute goes to real tokens)
 3      … and tighten admission to half the queue bound (shed earlier,
        shallower queues, bounded queue-wait)

@@ -213,7 +213,10 @@ class KVPageBlock:
                 f"block claims {self.n_tokens} KV rows but carries only "
                 f"{self.n_pages} pages of {self.page_size}"
             )
-        if not self.history:
+        if not self.history and self.produced != 0:
+            # resume blocks always carry history; only a pure-prefix block
+            # (prefix_store demotion: prompt KV, nothing emitted) may be
+            # history-less, and it must claim zero produced tokens
             raise BlockIntegrityError("block without emitted history")
         # hold the block lock so the fingerprint reads a consistent
         # (payload, checksum) pair against a racing flusher to_host()
@@ -289,7 +292,9 @@ def export_block(
         prompt=np.array(prompt, np.int32, copy=True),
         history=history,
         produced=int(produced),
-        last_tok=int(history[-1]),
+        # a pure-prefix export (prefix_store demotion) has emitted nothing:
+        # there is no next decode input, so last_tok is a sentinel
+        last_tok=int(history[-1]) if history else -1,
         resume_keys=resume_keys,
         resume_recent=resume_recent,
     )

@@ -53,13 +53,13 @@ set keeps serving and ``health()`` reports degraded, not dead.
 
 from __future__ import annotations
 
-import hashlib
 import logging
 import time
 from collections import OrderedDict
 from typing import Optional
 
 from mlx_sharding_tpu.analysis.runtime import make_lock
+from mlx_sharding_tpu.utils.digests import chunk_digests
 from mlx_sharding_tpu.resilience import (
     HandoffReadyError,
     QueueFullError,
@@ -94,7 +94,8 @@ class ReplicaSet:
     def __init__(self, replicas: list, *, breaker_threshold: int = 3,
                  probe_interval: float = 5.0, resume_streams: bool = True,
                  route_imbalance: int = 4, affinity_page: int = 128,
-                 tight_ttft_s: float = 10.0, role: Optional[str] = None):
+                 tight_ttft_s: float = 10.0, role: Optional[str] = None,
+                 prefix_store=None):
         if not replicas:
             raise ValueError("ReplicaSet needs at least one replica")
         # disaggregated serving: pools are role-tagged ("prefill"/"decode")
@@ -163,6 +164,12 @@ class ReplicaSet:
         self._sticky_cap = 4096
         self.route_affinity_hits = 0
         self.route_sticky_hits = 0
+        # fleet-wide prefix store (optional): a replica that HOLDS the
+        # prompt's prefix as a live device entry beats the digest-affinity
+        # guess — the hint is ground truth (zero-copy lease on admission)
+        # where the affinity map is only a plausible-warmth memory
+        self.prefix_store = prefix_store
+        self.route_store_hits = 0
         # ------------------------------------------------- elastic fleet
         # autoscale event counters, written by the fleet controller via
         # record_autoscale_event (kind -> count; /metrics renders them)
@@ -189,21 +196,15 @@ class ReplicaSet:
     def _affinity_chunks(self, prompt) -> list:
         """Chained digests over fixed ``affinity_page``-token chunks of the
         prompt, mirroring the prefix-cache page chaining: matching the
-        first k digests means sharing a k-page prefix. Non-int prompts (or
+        first k digests means sharing a k-page prefix. The chain itself
+        lives in ``utils.digests`` — the ONE content-address the prefix
+        store keys on too, so a router hit and a store hit can never
+        disagree about what "same prefix" means. Non-int prompts (or
         prompts shorter than one page) contribute no affinity signal."""
         try:
-            toks = [int(t) for t in list(prompt)[: self.affinity_page * 32]]
+            return chunk_digests(prompt, self.affinity_page, max_chunks=32)
         except (TypeError, ValueError):
             return []
-        page = self.affinity_page
-        n = len(toks) // page
-        keys, h = [], b""
-        for c in range(n):
-            m = hashlib.blake2b(h, digest_size=16)
-            m.update(",".join(map(str, toks[c * page:(c + 1) * page])).encode())
-            h = m.digest()
-            keys.append(h)
-        return keys
 
     def _queue_depths(self) -> list:
         """Per-replica queue-depth snapshot for routing, gathered OUTSIDE
@@ -225,12 +226,12 @@ class ReplicaSet:
         return out
 
     def _route(self, closed: list, depths: list, chunks: list,
-               session, tight: bool) -> int:
+               session, tight: bool, hint=None) -> int:
         """Pick from the closed-breaker candidates (``_lock`` held).
-        Stickiness, then affinity, may override least-loaded — but only
-        within ``route_imbalance`` load units of the best candidate, and
-        never for tight-TTFT requests (their deadline headroom can't absorb
-        a deeper queue)."""
+        Stickiness, then the prefix-store owner hint, then affinity may
+        override least-loaded — but only within ``route_imbalance`` load
+        units of the best candidate, and never for tight-TTFT requests
+        (their deadline headroom can't absorb a deeper queue)."""
         def load(j):
             return self._inflight[j] + (depths[j] if j < len(depths) else 0)
 
@@ -241,6 +242,14 @@ class ReplicaSet:
             if s in closed and load(s) - base <= tol:
                 self.route_sticky_hits += 1
                 return s
+        if hint is not None:
+            # the store says this replica holds the prompt's prefix as a
+            # live DEVICE entry right now — admission there is a zero-copy
+            # lease, so it outranks the affinity map's plausible warmth
+            for j in closed:
+                if self.replicas[j] is hint and load(j) - base <= tol:
+                    self.route_store_hits += 1
+                    return j
         if chunks:
             best, best_n = None, 0
             for j in closed:
@@ -275,6 +284,14 @@ class ReplicaSet:
     def _pick(self, exclude=(), *, prompt=None, session=None,
               tight: bool = False) -> tuple[int, bool]:
         chunks = self._affinity_chunks(prompt) if prompt is not None else []
+        hint = None
+        if self.prefix_store is not None and prompt is not None:
+            # OUTSIDE _lock: the store takes its own lock (never nested
+            # under ours), and a sick store must not break routing
+            try:
+                hint = self.prefix_store.owner_hint(prompt)
+            except Exception:  # noqa: BLE001 — hint is advisory only
+                hint = None
         depths = self._queue_depths()
         with self._lock:
             now = time.monotonic()
@@ -302,7 +319,7 @@ class ReplicaSet:
                 self._probing[i] = True
                 probe = True
             elif closed:
-                i = self._route(closed, depths, chunks, session, tight)
+                i = self._route(closed, depths, chunks, session, tight, hint)
                 self._remember_route(i, chunks, session)
             else:
                 raise ReplicasUnavailableError(
@@ -712,6 +729,7 @@ class ReplicaSet:
                 "affinity_entries": len(self._affinity),
                 "affinity_hits": self.route_affinity_hits,
                 "sticky_hits": self.route_sticky_hits,
+                "store_hits": self.route_store_hits,
                 "weights_shared": sum(
                     1 for j, r in enumerate(self.replicas)
                     if not self._retired[j]

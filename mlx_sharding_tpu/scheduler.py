@@ -120,6 +120,13 @@ class _Request:
     # same admission pass
     _pkeys: Optional[list] = None
     _chain: Optional[list] = None
+    # prefix-STORE scratch (fleet-wide content-addressed reuse): the chained
+    # chunk digests (memoized like _pkeys), the ("device"|"host", cover)
+    # plan _fits resolved, and the held PrefixLease while the slot maps
+    # shared store pages — released exactly once on every exit path
+    _sdigests: Optional[list] = None
+    _splan: Optional[tuple] = None
+    _please: Optional[object] = None
     # over-commit admission state: order ticket (oldest admitted request is
     # never preempted), tokens emitted since the last (re)admission (folded
     # into the prompt on preemption so resume re-prefills them), and the
@@ -217,7 +224,8 @@ class ContinuousBatcher:
                  max_queue: Optional[int] = None, async_sched: str = "auto",
                  spill_bytes: Optional[int] = None,
                  spill_cold_after: Optional[int] = None,
-                 kv_prefetch: str = "auto"):
+                 kv_prefetch: str = "auto",
+                 prefix_store=None):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
         if max_queue is not None and (not isinstance(max_queue, int) or max_queue < 1):
@@ -259,6 +267,34 @@ class ContinuousBatcher:
                 "prefix_cache requires a paged engine (pool_pages): sharing "
                 "is page-granular"
             )
+        if prefix_store is not None:
+            if not getattr(engine, "paged", False):
+                raise ValueError(
+                    "the prefix store requires a paged engine (pool_pages): "
+                    "prefix reuse is page-granular"
+                )
+            if prefix_cache:
+                raise ValueError(
+                    "prefix_cache and prefix_store are mutually exclusive — "
+                    "the fleet-wide store subsumes the slot-local prefix "
+                    "cache (--prompt-cache); drop --prompt-cache"
+                )
+            if draft_engine is not None:
+                raise ValueError(
+                    "the prefix store is incompatible with a draft engine: "
+                    "the draft's dense KV has no shareable pages, so a "
+                    "store hit would leave it attending to unprefilled state"
+                )
+            if jax.process_count() > 1:
+                # same class of problem as overcommit: lookup/lease/import
+                # are host-side page-table decisions outside the op stream
+                # worker ranks mirror
+                raise ValueError(
+                    "the prefix store is not supported in multi-host "
+                    "serving: store hits rewrite page tables host-side, "
+                    "outside the mirrored op stream; run it on single-host "
+                    "replicas (e.g. behind --replicas) instead"
+                )
         if overcommit and not getattr(engine, "paged", False):
             raise ValueError(
                 "overcommit admission requires a paged engine (pool_pages)"
@@ -428,6 +464,15 @@ class ContinuousBatcher:
         # decides how much of a request's need is claimed up front.
         self.paged = getattr(engine, "paged", False)
         self.prefix_cache = bool(prefix_cache)
+        # Fleet-wide content-addressed prefix KV store (prefix_store.py):
+        # admission LPM-matches the prompt's chained chunk digests against
+        # device entries (zero-copy COW page share) and the host tier
+        # (block import), and completed prefills register their prefix
+        # back. One store is shared by every batcher in the process — the
+        # subsystem the slot-local _prefix_index cannot grow into.
+        self.prefix_store = prefix_store
+        if prefix_store is not None:
+            prefix_store.bind_page_size(engine.page_size)
         # Admission accounting mode. "reserve" (default) claims a request's
         # whole page need (prompt + max_tokens) up front: deadlock-free by
         # construction, but a request that asks for max_tokens=4096 and emits
@@ -863,10 +908,16 @@ class ContinuousBatcher:
 
     def set_pressure(self, level: int):
         """Brownout ladder input from the fleet controller (fleet.py):
-        level >= 2 pauses speculation, level >= 3 halves the effective
-        admission bound. Idempotent; levels outside [0, 3] are clamped."""
+        level >= 1 pauses prefix-store INSERTION (serving hits stays on —
+        reuse sheds prefill work exactly when the fleet needs it), level
+        >= 2 pauses speculation, level >= 3 halves the effective admission
+        bound. Idempotent; levels outside [0, 3] are clamped."""
+        lvl = max(0, min(3, int(level)))
         with self._admission_lock:
-            self._pressure = max(0, min(3, int(level)))
+            self._pressure = lvl
+        store = self.prefix_store
+        if store is not None:
+            store.pause_inserts(lvl >= 1)
 
     def resilience_stats(self) -> dict:
         """Deadline/shedding counters + queue bound for /metrics."""
@@ -1134,6 +1185,222 @@ class ContinuousBatcher:
     def _release_pages(self, slot: int):
         self._unref_pages(self._pages_of.pop(slot, []))
 
+    # ------------------------------------------ prefix store (fleet-wide)
+    def _store_digests(self, req: _Request) -> list:
+        """The request's chained chunk digests for store keying, memoized
+        like ``_pkeys`` (recomputing per _fits poll would make a blocked
+        fifo head quadratic). Cleared whenever the prompt changes (fold)."""
+        if req._sdigests is None:
+            req._sdigests = self.prefix_store.digests_for(req.prompt)
+        return req._sdigests
+
+    def _store_lookup(self, req: _Request) -> Optional[tuple]:
+        """Poll-safe store LPM for ``req``; absorbs the
+        ``cache.prefix_lookup`` fault site into a counted no-hit — the
+        stream degrades to plain prefill, never drops."""
+        digests = self._store_digests(req)
+        if not digests:
+            return None
+        try:
+            return self.prefix_store.lookup(self, digests)
+        except Exception as e:
+            self.prefix_store.count_lookup_fault()
+            logging.getLogger(__name__).debug(
+                "prefix-store lookup failed (plain prefill): %s", e
+            )
+            return None
+
+    def _store_admit(self, req: _Request, plan: tuple,
+                     n: int) -> Optional[tuple]:
+        """Admission-side half of a store hit: returns ``(pages,
+        reused_tokens)`` for the slot, or None to fall back to plain
+        prefill admission (the plan went stale between _fits and here).
+
+        Device plan: lease the entry's shared pages copy-on-write — the
+        slot maps them read-only (its own +1 per page on top of the
+        entry's claim) and allocates only the uncovered tail; decode and
+        tail-prefill write past ``reused_tokens``, so a fork never touches
+        the shared prefix. Host plan: allocate the full need fresh and
+        scatter the tier block into the prefix pages (prefetch-staged when
+        the waiting-line pass got to it, counted demand import otherwise),
+        then re-register the imported pages as a device entry so the next
+        same-pool admission shares them zero-copy. An import failure keeps
+        the already-mapped pages and prefills from token 0 — token-exact
+        either way."""
+        store = self.prefix_store
+        kind, cover = plan
+        digests = self._store_digests(req)
+        if len(digests) < cover:
+            return None  # prompt changed since the plan was computed
+        if kind == "device":
+            lease = store.acquire(self, digests, cover)
+            if lease is None:
+                store.count_lookup("miss", digests)
+                return None  # entry demoted since _fits; plain prefill
+            store.count_lookup("device")
+            for p in lease.pages:
+                # the slot's own claim on each shared page, released by
+                # _release_pages like any mapped page; the entry's claim
+                # (+1 at registration) outlives the slot
+                self._page_ref[p] += 1
+            self._evict_for(n - cover)
+            pages = list(lease.pages) + [
+                self._free_pages.pop() for _ in range(n - cover)
+            ]
+            for p in pages[cover:]:
+                self._page_ref[p] = 1
+            req._please = lease
+            return pages, lease.n_tokens
+        block = store.host_block(digests[cover - 1])
+        if block is None:
+            store.count_lookup("miss", digests)
+            return None  # evicted since _fits; plain prefill
+        store.count_lookup("host")
+        self._evict_for(n)
+        pages = [self._free_pages.pop() for _ in range(n)]
+        for p in pages:
+            self._page_ref[p] = 1
+        page = self.engine.page_size
+        try:
+            was_staged = block.is_prefetched
+            t0 = time.perf_counter()
+            self.cache = import_block(
+                self.cache, block, pages[:cover],
+                scatter=self._import_pages, put=self._put,
+            )
+            dt = time.perf_counter() - t0
+            self.tick_kv_import_ms_last = dt * 1e3
+            self._tick_kv_import_s_total += dt
+            store.count_import(staged=was_staged, n_tokens=cover * page)
+            with self._admission_lock:
+                if was_staged:
+                    self.prefetch_hits += 1
+                else:
+                    self.demand_imports += 1
+        except Exception as e:
+            # the pages are already this slot's — keep them and prefill
+            # the whole prompt into them; nothing reached the consumer,
+            # so the stream stays token-exact
+            store.count_import_fault()
+            logging.getLogger(__name__).debug(
+                "prefix-store block import failed (re-prefill): %s", e
+            )
+            return pages, 0
+        block.drop_prefetch()  # staged copies served their one import
+        lease = store.register(
+            self, digests[:cover], pages[:cover],
+            req.prompt[: cover * page], cover * page * self._kv_row_bytes,
+            force=True,
+        )
+        if lease is not None:
+            for p in lease.pages:
+                self._page_ref[p] += 1  # the promoted entry's own claim
+            req._please = lease
+        return pages, cover * page
+
+    def _store_insert(self, req: _Request):
+        """Register a freshly prefilled prompt's page-aligned prefix in the
+        store, under its insertion policy. Bookkeeping only — dict entries
+        and refcounts, no device work — which is what keeps this legal in
+        the tick-hot prefill-completion path (MST111 polices the opposite:
+        store traffic that marshals host bytes in tick-hot code). The
+        request itself holds the entry's first lease; pages it registered
+        become shared the moment a same-prefix admission leases them."""
+        store = self.prefix_store
+        digests = self._store_digests(req)
+        if not digests:
+            return
+        k = len(digests)
+        pages = self._pages_of.get(req.slot, [])[:k]
+        if len(pages) < k:
+            return
+        page = self.engine.page_size
+        lease = store.register(
+            self, digests, pages, req.prompt[: k * page],
+            k * page * self._kv_row_bytes,
+        )
+        if lease is None:
+            return
+        for p in lease.pages:
+            self._page_ref[p] += 1  # the entry's own claim on each page
+        req._please = lease
+
+    def _drop_prefix_lease(self, req: _Request):
+        """Release ``req``'s prefix lease exactly once (idempotent via the
+        None swap; a true double release raises inside the store). On the
+        LAST release the entry comes back for demotion: its pages leave
+        the device for the host tier and return to the free list."""
+        lease, req._please = req._please, None
+        if lease is None:
+            return
+        entry = lease.release()
+        if entry is not None:
+            self._demote_prefix_entry(entry)
+
+    def _demote_prefix_entry(self, entry):
+        """Last-release demotion: export the entry's pages as a pure-prefix
+        ``KVPageBlock`` (dispatch-only gather; the device→host copy runs on
+        the host tier's flusher) keyed by the full-chain digest, then
+        return the pages to the pool. Skips the export when the host tier
+        already holds the digest (a re-imported prefix demoting again);
+        any failure — injected ``cache.export``, tier budget reject —
+        just drops the prefix (re-prefilled on next use), never an error
+        the stream can see."""
+        store = self.prefix_store
+        digest = entry.digests[-1]
+        try:
+            if not store.host_contains(digest):
+                block = export_block(
+                    self.cache, entry.pages,
+                    page_size=self.engine.page_size,
+                    n_tokens=len(entry.pages) * self.engine.page_size,
+                    prompt=entry.tokens, history=[], produced=0,
+                    resume_keys=None, resume_recent=None,
+                    gather=self._export_pages, put=self._put,
+                )
+                store.host_put(digest, block)
+        except Exception as e:
+            store.count_demote_drop()
+            logging.getLogger(__name__).debug(
+                "prefix demotion export failed (prefix dropped): %s", e
+            )
+        self._unref_pages(entry.pages)
+
+    def _prefetch_store_waiting(self):
+        """Stage host-tier prefix blocks for head-of-line waiting requests
+        (the same PRESERVE-style overlap as the spill prefetch): a
+        dispatch-only ``device_put`` here means the admission scatter a few
+        ticks later consumes device-resident arrays instead of
+        demand-marshaling host numpy. Bounded like _prefetch_waiting so a
+        deep queue can't turn the pass into a copy storm."""
+        store = self.prefix_store
+        if store is None or not self._waiting:
+            return
+        budget = 2
+        for req in self._waiting[:4]:
+            if budget == 0:
+                break
+            if req.cancelled or req.spilled or req._block is not None:
+                continue
+            plan = self._store_lookup(req)
+            if plan is None or plan[0] != "host":
+                continue
+            digests = self._store_digests(req)
+            block = store.host_block(digests[plan[1] - 1])
+            if block is None or not block.is_host or block.is_prefetched:
+                continue
+            budget -= 1
+            try:
+                block.prefetch(put=self._put)
+                with self._admission_lock:
+                    self.prefetches += 1
+            except Exception as e:
+                with self._admission_lock:
+                    self.prefetch_faults += 1
+                logging.getLogger(__name__).debug(
+                    "prefix block prefetch failed (demand import): %s", e
+                )
+
     def close(self, timeout: float = 10.0):
         with self._start_lock:
             self._stop = True
@@ -1157,6 +1424,14 @@ class ContinuousBatcher:
         spill = self.spill  # mst: allow(MST201): bound once in __init__, never reassigned
         if spill is not None:
             spill.close()
+        store = self.prefix_store  # mst: allow(MST201): bound once in __init__, never reassigned
+        if store is not None:
+            # drop this engine's device entries from the fleet store: the
+            # pool backing those pages is going away with the engine, so
+            # any index entry pointing at them would be a use-after-free
+            # for the next admission. Host-tier blocks survive (they're
+            # self-contained numpy) and keep serving other replicas.
+            store.drop_owner(self)
         # release engine-held resources (a shared-weight store lease drops
         # its ref here — drain/retire/hot-swap all funnel through close())
         eng_close = getattr(self.engine, "close", None)  # mst: allow(MST201): bound once in __init__, never reassigned
@@ -1214,6 +1489,33 @@ class ContinuousBatcher:
             return
         if self.paged:
             n = self._need_pages(req)
+            if self.prefix_store is not None:
+                # one admitted request == one token of insert budget (the
+                # deterministic damping clock — no wall time on this path)
+                self.prefix_store.note_admission()
+                splan, req._splan = req._splan, None
+                got = self._store_admit(req, splan, n) if splan else None
+                if got is None and splan is None:
+                    self.prefix_store.count_lookup(
+                        "miss", self._store_digests(req) or None
+                    )
+                if got is not None:
+                    pages, reused_tokens = got
+                    self._pages_of[slot] = pages
+                    self._write_table_row(slot, pages)
+                    self.cache = self.cache._replace(
+                        offset=self._row_set(
+                            self.cache.offset, slot_arr,
+                            self._put(jnp.asarray(reused_tokens, jnp.int32)),
+                        )
+                    )
+                    self._write_sampler_row(req, slot_arr)
+                    self._slots[slot] = req
+                    req.slot = slot
+                    # prefill only the uncovered tail; the shared (or
+                    # imported) prefix KV is already mapped to this slot
+                    req.prefill_pos = reused_tokens
+                    return
             chain = req._chain if req._chain is not None else self._prefix_lookup(req)
             req._chain = None
             if self.prefix_cache:
@@ -1458,6 +1760,14 @@ class ContinuousBatcher:
                     continue
                 self._prefix_index[key] = pages[i]
                 self._page_ref[pages[i]] = self._page_ref.get(pages[i], 0) + 1
+        elif self.prefix_store is not None and req._please is None:
+            # fleet-store insertion (bookkeeping only — refcounts and dict
+            # entries, no device work on this hot path): the freshly
+            # prefilled full prompt pages become a shareable device entry,
+            # subject to the store's min-hits / burst / brownout damping.
+            # A slot that ADMITTED via the store (req._please set) already
+            # holds its lease — re-registering would double-claim pages.
+            self._store_insert(req)
 
         # Seed the PRNG key and repetition window only NOW: decode ticks for
         # other slots ran between this request's chunks and they split/shift
@@ -1544,6 +1854,11 @@ class ContinuousBatcher:
                 # writes start past them). Index-registered pages survive
                 # as cache entries until LRU eviction needs them back.
                 self._release_pages(req.slot)
+                # the slot's claim on any store-shared prefix pages is gone
+                # with _release_pages; the lease is the ENTRY's lifetime —
+                # last release demotes the prefix to the host tier
+                # (dispatch-only export; the flusher does the host copy)
+                self._drop_prefix_lease(req)
                 if self._inflight is not None:
                     # the in-flight block's frozen active mask advances this
                     # dead slot's offset one block past its true end; queue
@@ -1625,6 +1940,8 @@ class ContinuousBatcher:
             )
             req.history = []
             req._pkeys = None  # prompt changed: content keys are stale
+            req._sdigests = None  # and so are the store digests
+        req._splan = None  # any admission plan predates the fold
 
     def _spill_block(self, req: _Request) -> bool:
         """Export ``req``'s KV page chain into the spill tier. Device-side
@@ -1687,6 +2004,7 @@ class ContinuousBatcher:
             if not self._spill_block(req):
                 self._fold_history(req)
         req._chain = None
+        req._splan = None
         req._last_logits = None
         req.prefill_pos = 0
         req.draft_pos = 0
@@ -1695,6 +2013,9 @@ class ContinuousBatcher:
             self._put(jnp.asarray(False)),
         )
         self._release_pages(slot)
+        # suspend runs quiesced, so a last-release demotion's export
+        # dispatch is safe here; re-admission re-plans against the store
+        self._drop_prefix_lease(req)
         self._slots[slot] = None
         req.slot = -1
 
@@ -1812,16 +2133,19 @@ class ContinuousBatcher:
     def _prefetch_waiting(self):
         """Stage blocks for spilled requests near the head of the waiting
         line (preemption victims about to be re-admitted), bounded so a
-        deep queue can't turn the policy pass into a copy storm."""
-        if not self._prefetch_on or self.spill is None:
-            return
-        budget = 2
-        for req in self._waiting[:4]:
-            if budget == 0:
-                break
-            if req.spilled and not req.cancelled:
-                self._prefetch_block(req)
-                budget -= 1
+        deep queue can't turn the policy pass into a copy storm. The
+        prefix-store pass rides the same policy slot: host-tier prefix
+        blocks for soon-to-be-admitted prompts get their stage started
+        here so admission's import scatters device-resident arrays."""
+        if self._prefetch_on and self.spill is not None:
+            budget = 2
+            for req in self._waiting[:4]:
+                if budget == 0:
+                    break
+                if req.spilled and not req.cancelled:
+                    self._prefetch_block(req)
+                    budget -= 1
+        self._prefetch_store_waiting()
 
     def migrate_out(self, deadline: float = 30.0) -> int:
         """Gracefully evacuate every request (replica drain): the scheduler
@@ -1877,11 +2201,13 @@ class ContinuousBatcher:
             req.slot = -1
             if req.cancelled:
                 self._release_pages(slot)
+                self._drop_prefix_lease(req)
                 self._drop_spill(req)
                 req.out.put(None)
                 continue
             state = self._export_resume_state(req, slot, keys_h, recent_h)
             self._release_pages(slot)
+            self._drop_prefix_lease(req)
             req.out.put(RequestMigratedError(state))
             with self._admission_lock:
                 self.migrations_out += 1
@@ -2005,6 +2331,11 @@ class ContinuousBatcher:
                 self._put(jnp.asarray(False)),
             )
             self._release_pages(slot)
+            # a prefill-only request's insertion lease drops HERE: last
+            # release demotes the freshly prefilled prefix to the host
+            # tier, which is exactly what lets the disagg coordinator skip
+            # the prefill pool next time this prefix arrives
+            self._drop_prefix_lease(req)
             self._slots[slot] = None
             req.slot = -1
             req.out.put(HandoffReadyError(state))
@@ -2287,6 +2618,23 @@ class ContinuousBatcher:
             # with the prefix index), so the chain doesn't discount it
             req._chain = None
             return need <= len(self._free_pages) + self._evictable_pages()
+        if self.prefix_store is not None:
+            # fleet-store LPM instead of the slot-local chain (mutually
+            # exclusive by construction): a device hit discounts the
+            # covered pages — the slot leases them instead of allocating.
+            # A host hit discounts nothing (the import scatters into fresh
+            # pages), it just records the plan for _assign_slot. Pure
+            # probe: counters resolve once, at admission.
+            req._splan = None
+            plan = self._store_lookup(req)
+            discount = plan[1] if plan is not None and plan[0] == "device" else 0
+            ok = need - discount <= len(self._free_pages) + self._evictable_pages()
+            if ok and plan is not None:
+                # only a fitting request carries its plan into _assign_slot
+                # (same admission pass, same thread — no staleness window
+                # beyond the store's own acquire re-check)
+                req._splan = plan
+            return ok
         chain = self._prefix_lookup(req)
         # the chain's own pages must not double as eviction fodder: they're
         # about to be mapped, so only OTHER cached pages can be reclaimed
@@ -2544,6 +2892,12 @@ class ContinuousBatcher:
             self._page_ref.clear()
             self._prefix_index.clear()
             self._free_pages = list(range(self.engine.pool_pages - 1, -1, -1))
+            if self.prefix_store is not None:
+                # the fleet store's device entries for THIS engine point at
+                # pages the wholesale reset just freed — drop them (marking
+                # any outstanding leases dead so late releases are no-ops);
+                # host-tier blocks are self-contained and stay valid
+                self.prefix_store.drop_owner(self)
         if self.spill is not None:
             # spilled blocks reference requests whose streams just died;
             # host DRAM back to the budget

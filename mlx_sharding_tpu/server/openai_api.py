@@ -169,6 +169,9 @@ class ModelProvider:
         draft_model: Optional[str] = None,
         spec_k: int = 4,
         prompt_cache: bool = False,
+        prefix_store: bool = False,
+        prefix_store_bytes: Optional[int] = None,
+        prefix_insert_min_hits: int = 1,
         replicas: int = 1,
         max_queue: Optional[int] = None,
         async_sched: str = "auto",
@@ -221,6 +224,15 @@ class ModelProvider:
         self.spec_k = spec_k
         # prompt-prefix KV reuse across requests (single-chip generator)
         self.prompt_cache = prompt_cache
+        # fleet-wide content-addressed prefix KV store (prefix_store.py):
+        # ONE store shared by every batcher this provider builds — device
+        # entries leased copy-on-write within a replica, host-tier blocks
+        # imported across replicas. Subsumes --prompt-cache (main()
+        # rejects the combination).
+        self.prefix_store = bool(prefix_store)
+        self.prefix_store_bytes = prefix_store_bytes
+        self.prefix_insert_min_hits = prefix_insert_min_hits
+        self.prefix_store_obj = None  # built once per load()
         self.chat_template = chat_template
         self.keep_quantized = keep_quantized
         # decode steps fused per program launch: 16 amortizes a network-
@@ -344,6 +356,7 @@ class ModelProvider:
             from mlx_sharding_tpu.loading import get_model_path, load_model
 
             cache_dtype = self.cache_dtype or jnp.bfloat16
+            pstore = None  # built below iff --prefix-store applies
             if self.stage_bounds and self.engine == "chained":
                 from mlx_sharding_tpu.parallel.chained import load_chained_pipeline
 
@@ -375,6 +388,20 @@ class ModelProvider:
                         self._load_draft(cache_dtype)
                         if self.draft_model and self.concurrent > 1 else None
                     )
+
+                    if (self.prefix_store and self.concurrent > 1
+                            and self.paged_pool and not self.multihost):
+                        from mlx_sharding_tpu.prefix_store import PrefixStore
+
+                        # ONE store for the whole fleet: every batcher
+                        # (all replicas, both disagg pools, autoscaler
+                        # spawns) binds to it — device entries are
+                        # per-engine (page ids are pool-local) but the
+                        # host tier and the digest index span the fleet
+                        pstore = PrefixStore(
+                            host_bytes=self.prefix_store_bytes or (256 << 20),
+                            insert_min_hits=self.prefix_insert_min_hits,
+                        )
 
                     per = stages * self.tp * self.ep
                     devices = _jax.devices()
@@ -521,6 +548,7 @@ class ModelProvider:
                                 spec_k=self.spec_k,
                                 max_queue=self.max_queue,
                                 async_sched=self.async_sched,
+                                prefix_store=pstore,
                             )
                         return engine
 
@@ -573,13 +601,15 @@ class ModelProvider:
                         n_dc = self.decode_replicas
                         prefill = ReplicaSet([
                             spawn_replica() for _ in range(n_pf)
-                        ], role="prefill")
+                        ], role="prefill", prefix_store=pstore)
                         decode = ReplicaSet([
                             spawn_replica() for _ in range(n_dc)
-                        ], role="decode")
+                        ], role="decode", prefix_store=pstore)
                         prefill.on_retire = recycle_slice
                         decode.on_retire = recycle_slice
-                        generator = DisaggCoordinator(prefill, decode)
+                        generator = DisaggCoordinator(
+                            prefill, decode, prefix_store=pstore
+                        )
                         if self.autoscale:
                             from mlx_sharding_tpu.fleet import FleetAutoscaler
 
@@ -619,7 +649,7 @@ class ModelProvider:
 
                         generator = ReplicaSet([
                             spawn_replica() for _ in range(self.replicas)
-                        ])
+                        ], prefix_store=pstore)
                         generator.on_retire = recycle_slice
                         if self.autoscale:
                             from mlx_sharding_tpu.fleet import FleetAutoscaler
@@ -695,7 +725,13 @@ class ModelProvider:
             from transformers import AutoTokenizer
 
             tokenizer = AutoTokenizer.from_pretrained(str(get_model_path(target)))
+            # swap the fleet store with the generator: _set closes the old
+            # generator first (its close() drops its owner entries), so
+            # the old store drains cleanly before its host tier is freed
+            old_store, self.prefix_store_obj = self.prefix_store_obj, pstore
             self._set(target, generator, tokenizer)
+            if old_store is not None:
+                old_store.close()
             return self.generator, self.tokenizer
 
     def _set(self, key, generator, tokenizer):
@@ -821,6 +857,15 @@ class APIHandler(BaseHTTPRequestHandler):
                 }
             except Exception:  # noqa: BLE001 — health must render anyway
                 pass
+            # fleet prefix store: residency split, hit rate, insertion-
+            # policy counters — the block operators watch to size
+            # --prefix-store-bytes and tune --prefix-insert-min-hits
+            store = getattr(self.provider, "prefix_store_obj", None)
+            if store is not None:
+                try:
+                    payload["prefix_store"] = store.stats()
+                except Exception:  # noqa: BLE001 — health must render anyway
+                    pass
             ctrl = getattr(gen, "ctrl", None)
             if ctrl is not None:
                 # a timed-out collective marks the plane dead (multihost.py
@@ -1512,6 +1557,9 @@ def make_server(
                 if hasattr(provider.generator, "accepted_tokens")
                 else None,
                 weight_store_fn=weight_store,
+                prefix_store_fn=lambda: getattr(
+                    provider, "prefix_store_obj", None
+                ),
             ),
             "profile_dir": profile_dir,
             "api_key": api_key,
@@ -1687,6 +1735,28 @@ def main(argv=None):
                              "worker mirrors rebuild the same index from the "
                              "op stream — and with --replicas, one cache per "
                              "replica)")
+    parser.add_argument("--prefix-store", action="store_true",
+                        help="fleet-wide content-addressed prefix KV store "
+                             "(with --concurrent --paged-pool): completed "
+                             "prefills register their page-aligned prompt "
+                             "prefix under chained chunk digests; later "
+                             "requests sharing the prefix lease the pages "
+                             "copy-on-write (zero-copy within a replica) or "
+                             "import them from the host tier (across "
+                             "replicas / after demotion) and prefill only "
+                             "the uncovered tail. Subsumes --prompt-cache "
+                             "(the two are mutually exclusive); with "
+                             "--disagg a full-prefix hit skips the prefill "
+                             "pool entirely")
+    parser.add_argument("--prefix-store-bytes", type=int, default=None,
+                        help="with --prefix-store: host-DRAM budget (bytes) "
+                             "for the demoted-prefix tier (default 256 MiB); "
+                             "LRU-evicted past the budget, falling back to "
+                             "plain prefill")
+    parser.add_argument("--prefix-insert-min-hits", type=int, default=1,
+                        help="with --prefix-store: a prefix must MISS this "
+                             "many times before a completed prefill inserts "
+                             "it (damps one-shot prompts; default 1)")
     parser.add_argument("--decode-block", type=int, default=16,
                         help="decode steps fused per program launch (token "
                              "pulls amortize over this many tokens; set 1 "
@@ -1786,28 +1856,66 @@ def main(argv=None):
                      "generator or to --concurrent serving "
                      "(no --coordinator/--tp/--ep/stage or "
                      "layer-range flags)")
-    if args.prompt_cache and args.concurrent > 1 and not args.paged_pool:
-        parser.error("--prompt-cache with --concurrent requires --paged-pool "
-                     "(prefix sharing is page-granular)")
-    if args.prompt_cache and args.concurrent <= 1 and (
-        args.coordinator or args.tp > 1
-        or args.ep > 1 or args.stage_bounds or (args.num_stages or 1) > 1
-        or args.engine == "chained" or args.draft_model
-        or args.start_layer is not None or args.end_layer is not None
-    ):
-        parser.error("--prompt-cache applies to the single-chip full-model "
-                     "generator path or to --concurrent --paged-pool serving "
-                     "(no --coordinator/--tp/--ep/stage, layer-range, or "
-                     "--draft-model flags)")
+    # ---- prompt-prefix reuse flags. --prefix-store (the fleet-wide
+    # content-addressed store) SUBSUMES --prompt-cache (engine-local page
+    # index): running both would put two owners over the same pool pages,
+    # so the pair is rejected outright with a migration hint.
+    if args.prefix_store:
+        if args.prompt_cache:
+            parser.error(
+                "--prompt-cache is subsumed by --prefix-store: the fleet-"
+                "wide store covers the slot-local prefix cache's reuse and "
+                "adds cross-replica sharing and a host tier — drop "
+                "--prompt-cache (see README: migrating from --prompt-cache)"
+            )
+        if args.concurrent <= 1 or not args.paged_pool:
+            parser.error("--prefix-store requires --concurrent N (N > 1) "
+                         "with --paged-pool (prefix reuse is page-granular)")
+        if args.draft_model:
+            parser.error("--prefix-store is incompatible with --draft-model "
+                         "(the draft cache cannot alias shared prefix pages)")
+        if args.coordinator and (args.num_processes or 1) > 1:
+            parser.error("--prefix-store is single-host only: store "
+                         "admissions rewrite page tables host-side, outside "
+                         "the op stream worker ranks mirror")
+    elif (args.prefix_store_bytes is not None
+          or args.prefix_insert_min_hits != 1):
+        parser.error("--prefix-store-bytes/--prefix-insert-min-hits require "
+                     "--prefix-store")
+    if args.prefix_store_bytes is not None and args.prefix_store_bytes < 1:
+        parser.error("--prefix-store-bytes must be a positive byte count")
+    if args.prefix_insert_min_hits < 1:
+        parser.error("--prefix-insert-min-hits must be >= 1")
+    if args.prompt_cache:
+        # ONE home for every --prompt-cache rule (this used to be three
+        # overlapping conditionals, each re-encoding part of the story —
+        # the replicas check below no longer mentions --prompt-cache):
+        # concurrent serving needs the paged pool; otherwise the flag
+        # means the single-chip full-model generator path, nothing else.
+        if args.concurrent > 1:
+            if not args.paged_pool:
+                parser.error("--prompt-cache with --concurrent requires "
+                             "--paged-pool (prefix sharing is "
+                             "page-granular)")
+        elif (args.coordinator or args.tp > 1 or args.ep > 1
+              or args.stage_bounds or (args.num_stages or 1) > 1
+              or args.engine == "chained" or args.draft_model
+              or args.replicas > 1 or args.disagg
+              or args.start_layer is not None
+              or args.end_layer is not None):
+            parser.error("--prompt-cache applies to the single-chip "
+                         "full-model generator path or to --concurrent "
+                         "--paged-pool serving (no --coordinator/--tp/--ep/"
+                         "stage, layer-range, --draft-model, or fleet "
+                         "flags)")
     if args.replicas > 1 and (
         args.coordinator or args.engine == "chained"
-        or (args.prompt_cache and args.concurrent <= 1)
         or (args.draft_model and args.concurrent <= 1)
         or args.start_layer is not None or args.end_layer is not None
     ):
         parser.error("--replicas requires the fused full-model engine path "
                      "(no --coordinator/--engine chained/layer-range flags; "
-                     "--prompt-cache/--draft-model only with --concurrent)")
+                     "--draft-model only with --concurrent)")
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
     if args.paged_pool and args.engine == "chained":
@@ -1956,6 +2064,9 @@ def main(argv=None):
         kv_prefetch=args.kv_prefetch,
         draft_model=args.draft_model, spec_k=args.spec_k,
         prompt_cache=args.prompt_cache, replicas=args.replicas,
+        prefix_store=args.prefix_store,
+        prefix_store_bytes=args.prefix_store_bytes,
+        prefix_insert_min_hits=args.prefix_insert_min_hits,
         max_queue=args.max_queue,
         async_sched=args.async_sched,
         autoscale=args.autoscale,

@@ -42,6 +42,10 @@ Sites wired into the serving stack:
   the DisaggCoordinator, after the first token but before the block's
   device→host copy; ctx ``n_bytes=<block payload>`` (raise here to force
   serve-in-place: the prefill pool finishes the stream itself)
+- ``cache.prefix_lookup`` — top of every PrefixStore LPM probe (admission
+  lookup, disagg full-hit check); ctx ``engine=id(batcher)`` or
+  ``probe="covers"`` (raise here to prove a sick store degrades to plain
+  prefill — the stream is never wrong and never drops)
 
 Programmatic use (the fault-injection test suite)::
 

@@ -81,6 +81,9 @@ class ServingMetrics:
     # defaults to the module singleton at render time so the shared-weights
     # gauges exist even for servers built without make_server
     weight_store_fn: object = None
+    # zero-arg callable returning the live prefix_store.PrefixStore (or
+    # None) — callable for the same hot-swap reason as batcher_fn
+    prefix_store_fn: object = None
 
     def record_request(
         self,
@@ -380,6 +383,14 @@ class ServingMetrics:
                             f"mst_route_affinity_hits_total "
                             f"{fleet['affinity_hits']}",
                         ]
+                    if "store_hits" in fleet:
+                        # routed to the replica already holding the prefix
+                        # resident in the fleet-wide store
+                        lines += [
+                            "# TYPE mst_route_store_hits_total counter",
+                            f"mst_route_store_hits_total "
+                            f"{fleet['store_hits']}",
+                        ]
                 hand = getattr(b, "handoff_stats", lambda: None)()
                 if hand is not None:
                     # disaggregated serving: prefill→decode KV handoffs —
@@ -403,6 +414,14 @@ class ServingMetrics:
                             f'mst_disagg_fallbacks_total{{kind="{kind}"}} '
                             f"{hand['fallbacks'][kind]}"
                         )
+                    if "store_skips" in hand:
+                        # full-prefix store hits that skipped the prefill
+                        # pool entirely (no phase-1 dispatch, no handoff)
+                        lines += [
+                            "# TYPE mst_disagg_store_skips_total counter",
+                            f"mst_disagg_store_skips_total "
+                            f"{hand['store_skips']}",
+                        ]
                 bro = getattr(b, "brownout", None)
                 if bro is not None:
                     lines += [
@@ -470,5 +489,80 @@ class ServingMetrics:
                     f"mst_weight_store_refs {store['refs']}",
                     "# TYPE mst_weight_store_bytes gauge",
                     f"mst_weight_store_bytes {store['bytes']}",
+                ]
+            # fleet-wide content-addressed prefix KV store (prefix_store.py):
+            # residency by tier, lookup quality, COW fork volume, insertion
+            # damping, and eviction churn by reason
+            try:
+                ps = (
+                    self.prefix_store_fn()
+                    if self.prefix_store_fn is not None
+                    else None
+                )
+                pstats = ps.stats() if ps is not None else None
+            except Exception:  # noqa: BLE001 — scrapes must never 500
+                pstats = None
+            if pstats is not None:
+                lines += [
+                    "# TYPE mst_prefix_store_blocks gauge",
+                    f'mst_prefix_store_blocks{{tier="device"}} '
+                    f"{pstats['device_blocks']}",
+                    f'mst_prefix_store_blocks{{tier="host"}} '
+                    f"{pstats['host_blocks']}",
+                    "# TYPE mst_prefix_store_bytes gauge",
+                    f'mst_prefix_store_bytes{{tier="device"}} '
+                    f"{pstats['device_bytes']}",
+                    f'mst_prefix_store_bytes{{tier="host"}} '
+                    f"{pstats['host_bytes']}",
+                    "# TYPE mst_prefix_store_budget_bytes gauge",
+                    f"mst_prefix_store_budget_bytes "
+                    f"{pstats['host_budget_bytes']}",
+                    "# TYPE mst_prefix_store_hits_total counter",
+                    f'mst_prefix_store_hits_total{{tier="device"}} '
+                    f"{pstats['hits_device']}",
+                    f'mst_prefix_store_hits_total{{tier="host"}} '
+                    f"{pstats['hits_host']}",
+                    "# TYPE mst_prefix_store_misses_total counter",
+                    f"mst_prefix_store_misses_total {pstats['misses']}",
+                    "# TYPE mst_prefix_store_hit_rate gauge",
+                    f"mst_prefix_store_hit_rate {pstats['hit_rate']:.4f}",
+                    "# TYPE mst_prefix_store_tokens_reused_total counter",
+                    f"mst_prefix_store_tokens_reused_total "
+                    f"{pstats['tokens_reused']}",
+                    "# TYPE mst_prefix_store_cow_forks_total counter",
+                    f"mst_prefix_store_cow_forks_total "
+                    f"{pstats['cow_forks']}",
+                    "# TYPE mst_prefix_store_inserts_total counter",
+                    f"mst_prefix_store_inserts_total {pstats['inserts']}",
+                    "# TYPE mst_prefix_store_inserts_damped_total counter",
+                    f"mst_prefix_store_inserts_damped_total "
+                    f"{pstats['inserts_damped']}",
+                    # 1 while brownout level >= 1 holds insertion closed
+                    "# TYPE mst_prefix_store_inserts_paused gauge",
+                    f"mst_prefix_store_inserts_paused "
+                    f"{int(bool(pstats['inserts_paused']))}",
+                    "# TYPE mst_prefix_store_demotions_total counter",
+                    f"mst_prefix_store_demotions_total "
+                    f"{pstats['demotions']}",
+                    "# TYPE mst_prefix_store_demote_drops_total counter",
+                    f"mst_prefix_store_demote_drops_total "
+                    f"{pstats['demote_drops']}",
+                    "# TYPE mst_prefix_store_evictions_total counter",
+                    f'mst_prefix_store_evictions_total{{reason="budget"}} '
+                    f"{pstats['evictions_budget']}",
+                    f'mst_prefix_store_evictions_total{{reason="oversize"}} '
+                    f"{pstats['evictions_oversize']}",
+                    f'mst_prefix_store_evictions_total{{reason="reset"}} '
+                    f"{pstats['evictions_reset']}",
+                    "# TYPE mst_prefix_store_imports_total counter",
+                    f'mst_prefix_store_imports_total{{kind="staged"}} '
+                    f"{pstats['imports_staged']}",
+                    f'mst_prefix_store_imports_total{{kind="demand"}} '
+                    f"{pstats['imports_demand']}",
+                    "# TYPE mst_prefix_store_faults_total counter",
+                    f'mst_prefix_store_faults_total{{kind="lookup"}} '
+                    f"{pstats['lookup_faults']}",
+                    f'mst_prefix_store_faults_total{{kind="import"}} '
+                    f"{pstats['import_faults']}",
                 ]
         return "\n".join(lines) + "\n"

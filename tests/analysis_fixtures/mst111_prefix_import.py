@@ -1,0 +1,11 @@
+"""MST111: a prefix-store host block uploaded inside a tick-hot function —
+the store-served admission stall. The stage belongs in the (non-hot)
+waiting-queue prefetch pass via KVPageBlock.prefetch()."""
+import jax.numpy as jnp
+
+
+# mst: hot-path
+def admit_in_tick(cache, store, digests):
+    block = store.host_block(digests[-1])
+    staged = jnp.asarray(block)
+    return cache, staged

@@ -207,3 +207,84 @@ def test_metrics_expose_kv_residency_and_prefetch():
     assert "mst_kv_spill_cold_total 0" in text
     assert "mst_kv_prefetch_enabled 0" in text
     assert 'mst_tick_device_blocked_ms{path="kv_import"} 0.000' in text
+
+
+def test_metrics_expose_prefix_store():
+    """/metrics reports the fleet-wide prefix store family — residency by
+    tier, lookup quality, COW forks, insertion damping, eviction reasons —
+    against a REAL PrefixStore so the renderer's key reads stay in lock-step
+    with stats(); plus the routing/disagg counters and the never-500 rule."""
+    from mlx_sharding_tpu.prefix_store import PrefixStore
+    from mlx_sharding_tpu.utils.observability import ServingMetrics
+
+    store = PrefixStore(host_bytes=1 << 20)
+    try:
+        text = ServingMetrics(prefix_store_fn=lambda: store).render()
+        assert 'mst_prefix_store_blocks{tier="device"} 0' in text
+        assert 'mst_prefix_store_blocks{tier="host"} 0' in text
+        assert 'mst_prefix_store_bytes{tier="host"} 0' in text
+        assert f"mst_prefix_store_budget_bytes {1 << 20}" in text
+        assert 'mst_prefix_store_hits_total{tier="device"} 0' in text
+        assert 'mst_prefix_store_hits_total{tier="host"} 0' in text
+        assert "mst_prefix_store_misses_total 0" in text
+        assert "mst_prefix_store_hit_rate 0.0000" in text
+        assert "mst_prefix_store_tokens_reused_total 0" in text
+        assert "mst_prefix_store_cow_forks_total 0" in text
+        assert "mst_prefix_store_inserts_total 0" in text
+        assert "mst_prefix_store_inserts_damped_total 0" in text
+        assert "mst_prefix_store_inserts_paused 0" in text
+        assert "mst_prefix_store_demotions_total 0" in text
+        assert "mst_prefix_store_demote_drops_total 0" in text
+        assert 'mst_prefix_store_evictions_total{reason="budget"} 0' in text
+        assert 'mst_prefix_store_evictions_total{reason="oversize"} 0' in text
+        assert 'mst_prefix_store_evictions_total{reason="reset"} 0' in text
+        assert 'mst_prefix_store_imports_total{kind="staged"} 0' in text
+        assert 'mst_prefix_store_imports_total{kind="demand"} 0' in text
+        assert 'mst_prefix_store_faults_total{kind="lookup"} 0' in text
+        assert 'mst_prefix_store_faults_total{kind="import"} 0' in text
+    finally:
+        store.close()
+
+    # no store wired -> no family
+    assert "mst_prefix_store_" not in ServingMetrics().render()
+
+    # a broken accessor must not 500 the scrape
+    def _boom():
+        raise RuntimeError("store gone")
+
+    text = ServingMetrics(prefix_store_fn=_boom).render()
+    assert "mst_requests_total" in text
+    assert "mst_prefix_store_" not in text
+
+    # routing + disagg counters ride the existing fleet/handoff blocks
+    class _FakeFleet:
+        def stats(self):
+            return (2, 1, 0)
+
+        def fleet_stats(self):
+            return {"size": 2, "sticky_hits": 1, "affinity_hits": 2,
+                    "store_hits": 3}
+
+        def handoff_stats(self):
+            return {"handoffs": 4, "bytes_total": 100, "ms_p50": 1.0,
+                    "ms_p99": 2.0, "fallbacks": {}, "store_skips": 5}
+
+    text = ServingMetrics(batcher_fn=lambda: _FakeFleet()).render()
+    assert "mst_route_store_hits_total 3" in text
+    assert "mst_disagg_store_skips_total 5" in text
+
+    class _OldFleet(_FakeFleet):
+        # pre-store aggregations lack the new keys -> lines stay absent
+        def fleet_stats(self):
+            f = _FakeFleet.fleet_stats(self)
+            del f["store_hits"]
+            return f
+
+        def handoff_stats(self):
+            h = _FakeFleet.handoff_stats(self)
+            del h["store_skips"]
+            return h
+
+    text = ServingMetrics(batcher_fn=lambda: _OldFleet()).render()
+    assert "mst_route_store_hits_total" not in text
+    assert "mst_disagg_store_skips_total" not in text

@@ -1,0 +1,560 @@
+"""Content-addressed prefix KV store (ISSUE 12): fleet-wide COW reuse.
+
+The load-bearing properties: (1) greedy streams are bit-identical with the
+store on or off — on a device hit (COW fork), a host-tier import, and
+EVERY fault-degradation path; (2) a hot prefix is prefilled roughly once:
+later same-prefix admissions reuse its pages (device) or import its block
+(host) instead of re-running prefill; (3) the disagg coordinator's
+full-coverage probe skips the prefill pool entirely; (4) every
+``cache.prefix_lookup`` / import / export fault degrades to plain
+prefill — never a dropped stream.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.cache import KVCache
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.generate import Generator
+from mlx_sharding_tpu.kv_transfer import export_block
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.parallel.mesh import make_mesh, pipeline_mesh
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+from mlx_sharding_tpu.prefix_store import PrefixStore
+from mlx_sharding_tpu.scheduler import ContinuousBatcher
+from mlx_sharding_tpu.testing import faults
+from mlx_sharding_tpu.utils.digests import chunk_digests
+from tests.helpers import hard_timeout
+
+TINY = dict(
+    vocab_size=256,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+PAGE = 8
+# one shared 2-page prefix, divergent tails: the hot-prefix traffic shape
+BASE = [7, 7, 2, 1, 9, 4, 4, 6, 3, 17, 42, 5, 11, 2, 2, 8]
+JOB_A = (BASE + [5], dict(max_tokens=40))
+JOB_B = (BASE + [9], dict(max_tokens=12))
+JOB_C = (BASE + [3], dict(max_tokens=12))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------- keying + LPM units
+def test_chunk_digests_chain_addresses_whole_prefix():
+    """digests[k] depends on every token before it (chained seeding), and
+    equal prefixes agree digest-for-digest regardless of the tails."""
+    a = chunk_digests(BASE + [5, 5, 5, 5, 5, 5, 5, 5], PAGE)
+    b = chunk_digests(BASE + [9, 9, 9, 9, 9, 9, 9, 9], PAGE)
+    assert len(a) == len(b) == 3
+    assert a[:2] == b[:2] and a[2] != b[2]
+    # perturbing an EARLY token changes every later digest (the chain)
+    c = chunk_digests([1] + BASE[1:], PAGE)
+    assert c[0] != a[0] and c[1] != a[1]
+
+
+def test_digests_for_caps_one_token_short_of_prompt():
+    """The last prompt token must go through prefill (it produces the
+    first sample's logits), so a page-exact prompt yields one fewer chunk."""
+    store = PrefixStore(host_bytes=1 << 20)
+    assert store.digests_for(list(range(17))) == []  # unbound: no geometry
+    store.bind_page_size(PAGE)
+    assert store.digests_for(list(range(8))) == []
+    assert len(store.digests_for(list(range(16)))) == 1
+    assert len(store.digests_for(list(range(17)))) == 2
+    store.close()
+
+
+def test_bind_page_size_is_write_once():
+    store = PrefixStore(host_bytes=1 << 20)
+    store.bind_page_size(8)
+    store.bind_page_size(8)  # idempotent
+    with pytest.raises(ValueError, match="chained at page_size=8"):
+        store.bind_page_size(16)
+    store.close()
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="host_bytes"):
+        PrefixStore(host_bytes=0)
+    with pytest.raises(ValueError, match="insert_min_hits"):
+        PrefixStore(insert_min_hits=0)
+    with pytest.raises(ValueError, match="insert_burst"):
+        PrefixStore(insert_burst=0)
+
+
+def _primed(store, owner, prompt):
+    """Register ``prompt``'s chain after the one counted miss the default
+    insert_min_hits=1 policy needs; returns (digests, lease)."""
+    digests = store.digests_for(prompt)
+    store.count_lookup("miss", digests)
+    lease = store.register(owner, digests, list(range(len(digests))),
+                           prompt[: len(digests) * PAGE], 1024)
+    return digests, lease
+
+
+def test_device_lookup_is_longest_prefix_match():
+    """A 3-chunk probe against a 2-chunk entry hits at cover=2 — the
+    chained digest makes the longest single probe exact — and acquire is
+    the counted COW fork whose LAST release returns the entry."""
+    store = PrefixStore(host_bytes=1 << 20)
+    store.bind_page_size(PAGE)
+    owner = object()
+    digests, lease = _primed(store, owner, BASE + [5])
+    assert lease is not None and lease.cover == 2
+    probe = store.digests_for(BASE + [9] * 9)  # 3 chunks, shares 2
+    assert len(probe) == 3
+    assert store.lookup(owner, probe) == ("device", 2)
+    assert store.lookup(object(), probe) is None  # other pool: no entry
+    fork = store.acquire(owner, probe, 2)
+    assert fork is not None and fork.pages == lease.pages[:2]
+    assert store.stats()["cow_forks"] == 1
+    assert store.stats()["tokens_reused"] == 16
+    assert fork.release() is None       # the entry's first lease survives
+    entry = lease.release()
+    assert entry is not None            # last out: caller demotes
+    assert store.lookup(owner, probe) is None
+    with pytest.raises(RuntimeError, match="released twice"):
+        lease.release()
+    store.close()
+
+
+def test_insertion_policy_min_hits_bucket_and_pause():
+    store = PrefixStore(host_bytes=1 << 20, insert_min_hits=2,
+                        insert_burst=1)
+    store.bind_page_size(PAGE)
+    owner = object()
+    digests = store.digests_for(BASE + [5])
+    store.count_lookup("miss", digests)
+    assert store.register(owner, digests, [0, 1], BASE, 64) is None
+    assert store.stats()["inserts_damped"] == 1  # one miss < min_hits=2
+    store.count_lookup("miss", digests)
+    lease = store.register(owner, digests, [0, 1], BASE, 64)
+    assert lease is not None  # demand proven; burst token spent
+    other = store.digests_for(list(range(100, 117)))
+    store.count_lookup("miss", other)
+    store.count_lookup("miss", other)
+    assert store.register(owner, other, [2, 3], list(range(100, 116)),
+                          64) is None  # bucket empty
+    store.note_admission()  # one admission = one insert credit
+    lease2 = store.register(owner, other, [2, 3], list(range(100, 116)), 64)
+    assert lease2 is not None
+    store.pause_inserts(True)  # the brownout rung
+    third = store.digests_for(list(range(200, 217)))
+    store.count_lookup("miss", third)
+    store.count_lookup("miss", third)
+    store.note_admission()
+    assert store.register(owner, third, [4, 5], list(range(200, 216)),
+                          64) is None
+    assert store.stats()["inserts_paused"] is True
+    store.pause_inserts(False)
+    lease.release(), lease2.release()
+    store.close()
+
+
+def _pure_prefix_block(tokens, pages=(0, 1)):
+    shape = (1, 2, 4, 1, PAGE, 2, 4)
+    vals = jnp.arange(np.prod(shape), dtype=jnp.float32).reshape(shape)
+    cache = KVCache(k=vals, v=vals + 1000.0, offset=jnp.zeros((), jnp.int32))
+    return export_block(
+        cache, list(pages), page_size=PAGE, n_tokens=len(pages) * PAGE,
+        prompt=list(tokens), history=[], produced=0,
+        resume_keys=None, resume_recent=None,
+    )
+
+
+def test_host_tier_covers_full_and_owner_hint():
+    """host_block() is non-consuming (any number of admissions import the
+    same prefix), covers_full() sees both tiers, and owner_hint() names
+    only a DEVICE holder (host blocks import anywhere)."""
+    store = PrefixStore(host_bytes=1 << 20)
+    store.bind_page_size(PAGE)
+    prompt = BASE + [5]
+    digests = store.digests_for(prompt)
+    assert store.host_put(digests[-1], _pure_prefix_block(BASE))
+    assert store.host_block(digests[-1]) is not None
+    assert store.host_block(digests[-1]) is not None  # still there
+    assert store.lookup(object(), digests) == ("host", 2)
+    assert store.covers_full(prompt)
+    assert not store.covers_full(BASE + [9] * 9)  # 3rd chunk unknown
+    assert store.owner_hint(prompt) is None  # host tier: no placement pull
+    owner = object()
+    # a chain the host tier already serves is never duplicated on device
+    digests2, dup = _primed(store, owner, prompt)
+    assert dup is None
+    other = BASE[::-1] + [5]
+    _, lease = _primed(store, owner, other)
+    assert lease is not None
+    assert store.owner_hint(other) is owner
+    assert store.stats()["demotions"] == 1
+    lease.release()
+    store.close()
+
+
+def test_drop_owner_orphans_outstanding_leases():
+    """Pool reset / close: entries vanish without export, outstanding
+    leases release as no-ops, and the reset is counted."""
+    store = PrefixStore(host_bytes=1 << 20)
+    store.bind_page_size(PAGE)
+    owner = object()
+    digests, lease = _primed(store, owner, BASE + [5])
+    store.drop_owner(owner)
+    assert store.lookup(owner, digests) is None
+    assert lease.release() is None  # orphan: nothing to demote
+    assert store.stats()["evictions_reset"] == 1
+    store.close()
+
+
+def test_lookup_fault_site_fires_on_both_probes():
+    store = PrefixStore(host_bytes=1 << 20)
+    store.bind_page_size(PAGE)
+    faults.arm("cache.prefix_lookup", exc=faults.FaultError)
+    with pytest.raises(faults.FaultError):
+        store.lookup(object(), store.digests_for(BASE + [5]))
+    with pytest.raises(faults.FaultError):
+        store.covers_full(BASE + [5])
+    store.close()
+
+
+# --------------------------------------------- engine-level happy/degraded
+@pytest.fixture(scope="module")
+def store_env():
+    """One shared pp=2 paged engine + solo reference; each test wraps it
+    in its own batcher + store (the policy knobs differ, the engine
+    doesn't)."""
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(2), microbatches=2, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+        pool_pages=8, page_size=PAGE,
+    )
+    ref = Generator(
+        model, params, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    return eng, ref
+
+
+def _store_batcher(eng, store, **kw):
+    return ContinuousBatcher(eng, decode_block=3, prefix_store=store, **kw)
+
+
+def _collect(gen_like, job):
+    prompt, kw = job
+    return [t for t, _ in gen_like.generate_step(prompt, **kw)]
+
+
+def test_store_requires_paged_engine_and_excludes_prompt_cache(store_env):
+    eng, _ = store_env
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    dense = PipelineEngine(
+        model, params, make_mesh(pp=1, devices=jax.devices()[:1]),
+        microbatches=2, max_seq=64, cache_dtype=jnp.float32, prefill_chunk=8,
+    )
+    store = PrefixStore(host_bytes=1 << 20)
+    with pytest.raises(ValueError, match="paged engine"):
+        ContinuousBatcher(dense, prefix_store=store)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ContinuousBatcher(eng, prefix_cache=True, prefix_store=store)
+    store.close()
+
+
+@hard_timeout(420)
+def test_sequential_hot_prefix_served_from_host_tier_exact(store_env):
+    """The fleet traffic shape, serialized: A prefills + registers the
+    prefix, A's finish demotes it to the host tier, B and C import it —
+    every stream bit-identical to the solo reference, the prefix
+    prefilled once, and the pool fully drained at the end."""
+    eng, ref = store_env
+    jobs = (JOB_A, JOB_B, JOB_C)
+    want = [_collect(ref, j) for j in jobs]
+    store = PrefixStore(host_bytes=64 << 20)
+    batcher = _store_batcher(eng, store)
+    try:
+        for job, expect in zip(jobs, want):
+            assert _collect(batcher, job) == expect
+        s = store.stats()
+        assert s["inserts"] >= 1 and s["demotions"] >= 1
+        assert s["hits_host"] >= 2  # B and C both imported
+        assert s["tokens_reused"] >= 2 * len(BASE)
+        assert s["imports_staged"] + s["imports_demand"] >= 2
+        assert s["import_faults"] == 0 and s["lookup_faults"] == 0
+        # all leases released + demoted: nothing device-resident remains
+        assert s["device_blocks"] == 0
+        total, in_use, _ = batcher.page_stats()
+        assert in_use == 0
+    finally:
+        batcher.close()
+        store.close()
+
+
+@hard_timeout(420)
+def test_concurrent_same_prefix_cow_forks_device_pages(store_env):
+    """B admits while A still decodes on the same prefix: B leases A's
+    registered pages copy-on-write (zero-copy, no import) and both
+    streams stay bit-identical — divergent tails prove the shared pages
+    were never rewritten."""
+    eng, ref = store_env
+    # A must leave pool room for B: admission reserves pages for the whole
+    # max_tokens budget (no overcommit), so A takes 5 of 8 pages and B's
+    # fork needs only 2 fresh ones past the 2 it shares
+    job_a = (BASE + [5], dict(max_tokens=16))
+    want_a, want_b = _collect(ref, job_a), _collect(ref, JOB_B)
+    store = PrefixStore(host_bytes=64 << 20)
+    batcher = _store_batcher(eng, store)
+    got_a: list = []
+    done_a = threading.Event()
+
+    def consume_a():
+        prompt, kw = job_a
+        for t, _ in batcher.generate_step(prompt, **kw):
+            got_a.append(t)
+        done_a.set()
+
+    # throttle every tick: the tiny model decodes A's whole 40-token tail
+    # in milliseconds, which would demote the entry before B could even be
+    # submitted — the delay keeps A live across B's admission without
+    # changing a single token
+    faults.arm("scheduler.tick", delay=0.05)
+    th = threading.Thread(target=consume_a, daemon=True)
+    th.start()
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if store.stats()["inserts"] >= 1:
+                break
+            time.sleep(0.005)
+        assert store.stats()["inserts"] >= 1, "A's prefill never registered"
+        assert not done_a.is_set(), "A finished before B could fork"
+        assert _collect(batcher, JOB_B) == want_b
+        faults.disarm("scheduler.tick")  # let A's tail run at full speed
+        th.join(timeout=90)
+        assert not th.is_alive(), "stream A hung"
+        assert got_a == want_a
+        s = store.stats()
+        assert s["cow_forks"] >= 1 and s["hits_device"] >= 1
+        assert s["imports_staged"] + s["imports_demand"] == 0
+    finally:
+        faults.disarm()
+        batcher.close()
+        store.close()
+
+
+@hard_timeout(420)
+def test_lookup_fault_degrades_to_plain_prefill_exact(store_env):
+    """cache.prefix_lookup armed for the whole run: every probe becomes a
+    counted no-hit, every stream plain-prefills, nothing drops."""
+    eng, ref = store_env
+    jobs = (JOB_A, JOB_B)
+    want = [_collect(ref, j) for j in jobs]
+    store = PrefixStore(host_bytes=64 << 20)
+    batcher = _store_batcher(eng, store)
+    faults.arm("cache.prefix_lookup", exc=faults.FaultError)
+    try:
+        for job, expect in zip(jobs, want):
+            assert _collect(batcher, job) == expect
+        s = store.stats()
+        assert s["lookup_faults"] >= 2
+        assert s["hits"] == 0 and s["tokens_reused"] == 0
+    finally:
+        faults.disarm()
+        batcher.close()
+        store.close()
+
+
+@hard_timeout(420)
+def test_import_fault_reprefills_from_token_zero_exact(store_env):
+    """A primes the host tier; cache.import armed: B's host-hit admission
+    fails mid-import, keeps its pages, and re-prefills the whole prompt —
+    stream still exact, fault counted, no import recorded."""
+    eng, ref = store_env
+    want_a, want_b = _collect(ref, JOB_A), _collect(ref, JOB_B)
+    store = PrefixStore(host_bytes=64 << 20)
+    batcher = _store_batcher(eng, store)
+    try:
+        assert _collect(batcher, JOB_A) == want_a
+        assert store.stats()["demotions"] >= 1
+        faults.arm("cache.import", exc=faults.FaultError)
+        assert _collect(batcher, JOB_B) == want_b
+        s = store.stats()
+        assert s["import_faults"] >= 1
+        assert s["imports_staged"] + s["imports_demand"] == 0
+    finally:
+        faults.disarm()
+        batcher.close()
+        store.close()
+
+
+@hard_timeout(420)
+def test_export_fault_drops_prefix_never_stream(store_env):
+    """cache.export armed: A's finish-time demotion fails, the prefix is
+    simply gone (counted), and A's own stream is untouched."""
+    eng, ref = store_env
+    want = _collect(ref, JOB_A)
+    store = PrefixStore(host_bytes=64 << 20)
+    batcher = _store_batcher(eng, store)
+    faults.arm("cache.export", exc=faults.FaultError)
+    try:
+        assert _collect(batcher, JOB_A) == want
+        s = store.stats()
+        assert s["demote_drops"] >= 1 and s["demotions"] == 0
+        assert s["host_blocks"] == 0
+    finally:
+        faults.disarm()
+        batcher.close()
+        store.close()
+
+
+@hard_timeout(420)
+def test_brownout_pressure_pauses_insertion_not_hits(store_env):
+    """set_pressure(1) (the fleet ladder's first rung) closes the store to
+    NEW prefixes while already-resident ones keep serving hits."""
+    eng, ref = store_env
+    want_a, want_b = _collect(ref, JOB_A), _collect(ref, JOB_B)
+    store = PrefixStore(host_bytes=64 << 20)
+    batcher = _store_batcher(eng, store)
+    try:
+        assert _collect(batcher, JOB_A) == want_a  # registers + demotes
+        batcher.set_pressure(1)
+        assert store.inserts_paused
+        assert _collect(batcher, JOB_B) == want_b
+        assert store.stats()["hits_host"] >= 1  # hits still serve
+        # (B's host-import PROMOTION registers force=True — promotion of
+        # an already-proven prefix is exempt from the pause by design)
+        base_inserts = store.stats()["inserts"]
+        cold = ([23, 31] * 9, dict(max_tokens=4))  # a NEW prefix under
+        want_cold = _collect(ref, cold)            # pressure
+        assert _collect(batcher, cold) == want_cold
+        s = store.stats()
+        assert s["inserts"] == base_inserts  # the new prefix was refused
+        assert s["inserts_damped"] >= 1
+        batcher.set_pressure(0)
+        assert not store.inserts_paused
+    finally:
+        batcher.close()
+        store.close()
+
+
+# ------------------------------------------------------------------ disagg
+@hard_timeout(420)
+def test_disagg_full_hit_skips_prefill_pool():
+    """A store that fully covers the prompt's page-aligned prefix lets the
+    coordinator skip phase 1 outright: the decode pool serves from token
+    0 (store-hit admission), no handoff happens, and the stream matches
+    the two-phase run of the same request."""
+    from mlx_sharding_tpu.disagg import DisaggCoordinator
+    from mlx_sharding_tpu.replicas import ReplicaSet
+
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    devices = jax.devices()
+
+    def mk(dev_idx):
+        eng = PipelineEngine(
+            model, params,
+            make_mesh(pp=1, devices=devices[dev_idx:dev_idx + 1]),
+            microbatches=2, max_seq=64, cache_dtype=jnp.float32,
+            prefill_chunk=8, pool_pages=10, page_size=PAGE,
+        )
+        return ContinuousBatcher(eng, decode_block=3, prefix_store=store)
+
+    store = PrefixStore(host_bytes=64 << 20)
+    co = DisaggCoordinator(
+        ReplicaSet([mk(0)], role="prefill", prefix_store=store),
+        ReplicaSet([mk(1)], role="decode", prefix_store=store),
+        prefix_store=store,
+    )
+    job = (BASE + [5], dict(max_tokens=16))
+    try:
+        first = _collect(co, job)
+        h0 = co.handoff_stats()
+        assert h0["store_skips"] == 0  # cold: the normal two-phase path
+        assert store.stats()["demotions"] >= 1  # handoff demoted the prefix
+        second = _collect(co, job)
+        assert second == first
+        h1 = co.handoff_stats()
+        assert h1["store_skips"] == 1
+        assert h1["handoffs"] == h0["handoffs"]  # phase 1 never ran
+        # the fault site also guards the coverage probe: armed, the
+        # coordinator falls back to the normal two-phase plan
+        faults.arm("cache.prefix_lookup", exc=faults.FaultError)
+        third = _collect(co, job)
+        assert third == first
+        assert co.handoff_stats()["store_skips"] == 1  # no new skip
+    finally:
+        faults.disarm()
+        co.close()
+        store.close()
+
+
+# -------------------------------------------------- slow parity sweeps
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+@pytest.mark.parametrize("async_sched", ["off", "on"])
+@pytest.mark.parametrize("fault", [None, "cache.prefix_lookup",
+                                   "cache.import", "cache.export"])
+def test_store_parity_sweep(kv_dtype, async_sched, fault):
+    """Full matrix: {bf16, int8 pool} x {sync, async} x {happy, lookup
+    fault, import fault, export fault} — hot-prefix streams through the
+    store are always bit-identical to the same engine geometry with the
+    store off (the int8 pool's quantization drift makes the fp32 stream
+    an invalid reference)."""
+    eng_kw = dict(kv_dtype=kv_dtype) if kv_dtype else {}
+    cfg = LlamaConfig(**TINY)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+
+    def mk_engine():
+        return PipelineEngine(
+            model, params, pipeline_mesh(2), microbatches=2, max_seq=64,
+            cache_dtype=jnp.float32, prefill_chunk=8,
+            pool_pages=8, page_size=PAGE, **eng_kw,
+        )
+
+    plain = ContinuousBatcher(mk_engine(), decode_block=3)
+    try:
+        want = [_collect(plain, j) for j in (JOB_A, JOB_B, JOB_C)]
+    finally:
+        plain.close()
+    store = PrefixStore(host_bytes=64 << 20)
+    batcher = ContinuousBatcher(
+        mk_engine(), decode_block=3, prefix_store=store,
+        async_sched=async_sched,
+    )
+    if fault:
+        faults.arm(fault, exc=faults.FaultError)
+    try:
+        got = [_collect(batcher, j) for j in (JOB_A, JOB_B, JOB_C)]
+        assert got == want
+        s = store.stats()
+        if fault is None:
+            assert s["hits"] >= 2 and s["tokens_reused"] >= 2 * len(BASE)
+        elif fault == "cache.prefix_lookup":
+            assert s["lookup_faults"] >= 2 and s["hits"] == 0
+        elif fault == "cache.import":
+            assert s["import_faults"] >= 1
+        else:
+            assert s["demote_drops"] >= 1 and s["host_blocks"] == 0
+    finally:
+        faults.disarm()
+        batcher.close()
+        store.close()

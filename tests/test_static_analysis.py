@@ -43,6 +43,7 @@ EXPECTED = {
     "mst108_block_migration.py": ("MST108", 8, 10),
     "mst109_demand_import.py": ("MST109", 10, 13),
     "mst110_spawn_upload.py": ("MST110", 10, 15),
+    "mst111_prefix_import.py": ("MST111", 10, 13),
     "mst201_unlocked_attr.py": ("MST201", 15, 0),
     "mst202_check_then_act.py": ("MST202", 14, 0),
     "mst203_lock_cycle.py": ("MST203", 17, 0),
