@@ -365,6 +365,102 @@ def measure_cb(model, params, prompt, label: str, slots: int = 4) -> dict:
     return res
 
 
+def measure_trace_overhead(model, params, label: str, slots: int = 8) -> dict:
+    """Tracing cost contract (the other half of mstcheck MST112): the same
+    8-slot continuous-batching load under ``--trace off``, ``sample``, and
+    ``on``. Off-mode instrumentation is one attribute load and an
+    ``is None`` branch per site, so its aggregate tok/s must sit inside
+    run-to-run noise of a baseline off-mode run; sample/on quantify what a
+    traced request actually pays. There is no uninstrumented build to
+    compare against (the spans are always compiled in), so the baseline IS
+    a second off-mode run — it measures the noise floor the off/baseline
+    ratio is held to. Reports aggregate tok/s and p50 inter-token latency
+    per mode."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from mlx_sharding_tpu import tracing
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=1), microbatches=slots,
+        max_seq=256, cache_dtype=jnp.bfloat16, prefill_chunk=32,
+    )
+    batcher = ContinuousBatcher(eng, decode_block=8)
+    prompt = list(range(2, 34))
+    tokens = 48
+
+    def run_mode() -> dict:
+        done = [0] * slots
+        gaps: list[float] = []
+        gap_lock = threading.Lock()
+
+        def run(i):
+            mine = []
+            last = time.perf_counter()
+            for _ in batcher.generate_step(prompt, max_tokens=tokens):
+                now = time.perf_counter()
+                if done[i] > 0:
+                    mine.append(now - last)
+                last = now
+                done[i] += 1
+            with gap_lock:
+                gaps.extend(mine)
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(slots)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        total = sum(done)
+        gaps.sort()
+        p50 = gaps[len(gaps) // 2] if gaps else 0.0
+        # tokens surface in decode_block bursts, so p50 is the intra-block
+        # gap (~0) and p90 the block boundary — report both
+        p90 = gaps[int(len(gaps) * 0.9)] if gaps else 0.0
+        return dict(
+            aggregate_tps=round(total / dt, 2),
+            itl_p50_ms=round(p50 * 1e3, 3),
+            itl_p90_ms=round(p90 * 1e3, 3), tokens=total,
+        )
+
+    res: dict = dict(label=label, slots=slots)
+    try:
+        # two warm-up passes: the first compiles the prefill/decode graphs,
+        # the second the slot-reuse sampling variant
+        for _ in range(2):
+            for _ in batcher.generate_step(prompt, max_tokens=4):
+                pass
+        for name, mode in (("baseline", "off"), ("off", "off"),
+                           ("sample", "sample"), ("on", "on")):
+            tracing.configure(mode, buffer=64, sample_n=4)
+            res[name] = run_mode()
+            log(f"[{label}] {name} (--trace {mode}): "
+                f"{res[name]['aggregate_tps']} tok/s, "
+                f"p50 ITL {res[name]['itl_p50_ms']} ms")
+    finally:
+        tracing.configure("off")
+        batcher.close()
+    base = res["baseline"]["aggregate_tps"]
+    off = res["off"]["aggregate_tps"]
+    res["off_vs_baseline"] = round(off / base, 4) if base else None
+    # CPU smoke is jittery; 10% sits well above the off-mode cost (a None
+    # check per site) and well below any real per-token serialization leak
+    res["off_within_noise"] = bool(base) and abs(off / base - 1.0) <= 0.10
+    if not res["off_within_noise"]:
+        log(f"[{label}] WARNING: --trace off diverged from its own "
+            f"baseline ({off} vs {base} tok/s) — off-mode tracing is "
+            "supposed to be free; see mstcheck MST112")
+    return res
+
+
 def synth_packed_deepseek(model, key):
     """DeepSeek params in load_model(keep_quantized=True)'s exact layout,
     generated DIRECTLY in packed form on the default device — no dense
@@ -2191,6 +2287,13 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 detail["disagg_prefill_decode_cpu"] = dict(error=repr(e)[:300])
                 log(f"[disagg_prefill_decode_cpu] FAILED: {e!r}")
+            try:
+                detail["trace_overhead_cpu"] = measure_trace_overhead(
+                    m2, p2, "trace_overhead_cpu"
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["trace_overhead_cpu"] = dict(error=repr(e)[:300])
+                log(f"[trace_overhead_cpu] FAILED: {e!r}")
             # the 0.28B fallback model, not tiny2: the A/B needs decode
             # blocks whose device time is non-trivial next to the host work,
             # or there is nothing for the async loop to overlap

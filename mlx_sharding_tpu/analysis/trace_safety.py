@@ -92,6 +92,18 @@
   (the store's builder does the one real upload, off the per-spawn path).
   Only a call whose argument subtree names param-ish data (param / weight /
   state_dict / checkpoint) fires, so KV staging in a factory stays clean.
+- **MST112 unguarded-trace-in-tick** — request-lifecycle tracing work
+  (span construction / serialization: a call through a trace-ish receiver
+  such as ``tr.add(...)``, ``req._trace.point(...)``, ``tracing.bind(...)``)
+  or ``time.time()`` timestamping inside a tick-hot function, outside the
+  tracing no-op guard. The tracing contract is near-zero cost when off:
+  hot paths bind the handle once (``tr = req._trace``) and gate every span
+  on ``if tr is not None:`` (an attribute/None test that branches on a
+  trace-ish identifier counts as the guard; ``time.perf_counter()`` is the
+  sanctioned timestamp and is never flagged). An unguarded call runs its
+  argument marshalling and lock traffic on every decode block even with
+  ``--trace off`` — exactly the regression the ``trace_overhead`` bench
+  phase exists to catch, caught here statically instead.
 - **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
   timeout arithmetic (an expression whose identifiers mention deadline /
   timeout / expiry / until / budget / ttft / retry_after / lease). The wall
@@ -748,6 +760,88 @@ def _check_recompile_hazards(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+# MST112: receivers that mark a call as tracing work, and the guard test —
+# a hot function may touch the tracer only behind a no-op check that
+# branches on one of these identifiers (the `if tr is not None:` pattern)
+TRACE_RECEIVER_NAMES = {"tr", "_tr", "tracer", "_tracer", "tracing"}
+
+
+def _trace_ident(ident: str) -> bool:
+    low = ident.lower()
+    return low in TRACE_RECEIVER_NAMES or "trace" in low
+
+
+def _is_trace_guard(test: ast.AST) -> bool:
+    """Does this If/IfExp test branch on a trace-ish identifier?"""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and _trace_ident(n.id):
+            return True
+        if isinstance(n, ast.Attribute) and _trace_ident(n.attr):
+            return True
+    return False
+
+
+def _is_trace_call(node: ast.Call) -> bool:
+    """A call whose RECEIVER path is trace-ish: ``tr.add(...)``,
+    ``req._trace.point(...)``, ``tracing.bind(...)`` — but not a bare
+    function that merely mentions trace in its own name."""
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    return len(parts) > 1 and any(_trace_ident(p) for p in parts[:-1])
+
+
+def _check_hot_trace_overhead(mod: ModuleInfo) -> list[Finding]:
+    """MST112: tracing work in a tick-hot function outside the no-op
+    guard. Walks each hot function with a guarded flag that turns on
+    inside any If/IfExp whose test branches on a trace-ish identifier
+    (both branches count — ``if tr is None: ... else: record`` is as valid
+    as the positive form). ``time.perf_counter()`` is never flagged; the
+    wall clock (``time.time()``) is, as hot-path timestamping."""
+    findings = []
+
+    def flag(node: ast.Call, what: str, fname: str):
+        findings.append(Finding(
+            "MST112", mod.display_path, node.lineno, node.col_offset,
+            f"unguarded trace work in hot path {fname}(): {what} runs its "
+            "marshalling and lock traffic on every decode block even with "
+            "tracing off — bind the handle once (tr = req._trace) and gate "
+            "span construction behind its `if tr is not None:` no-op check "
+            "(timestamp with time.perf_counter, not time.time)",
+            context=qualname_for_line(mod.tree, node.lineno),
+        ))
+
+    def scan(node: ast.AST, fn: ast.AST, guarded: bool):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not fn):
+            return  # nested defs are jit bodies; not host hot-path code
+        if isinstance(node, ast.Call) and not guarded:
+            name = dotted_name(node.func)
+            if name == "time.time":
+                flag(node, "time.time()", fn.name)
+            elif _is_trace_call(node):
+                flag(node, f"{name}(...)", fn.name)
+        if isinstance(node, (ast.If, ast.IfExp)):
+            g = guarded or _is_trace_guard(node.test)
+            # the test expression itself still runs unconditionally — a
+            # call there is not protected by its own branch
+            scan(node.test, fn, guarded)
+            body = node.body if isinstance(node, ast.If) else [node.body]
+            orelse = (node.orelse if isinstance(node, ast.If)
+                      else [node.orelse])
+            for child in body + orelse:
+                scan(child, fn, g)
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child, fn, guarded)
+
+    for fn in _hot_functions(mod):
+        for child in ast.iter_child_nodes(fn):
+            scan(child, fn, False)
+    return findings
+
+
 # MST107: the wall clock spellings that must never feed a deadline, and the
 # identifier fragments that mark an expression as deadline/timeout math
 WALL_CLOCK_CALLS = {"time.time", "_time.time"}
@@ -809,6 +903,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_block_migration(mod)
     findings += _check_sync_import(mod)
     findings += _check_store_import(mod)
+    findings += _check_hot_trace_overhead(mod)
     findings += _check_spawn_weight_upload(mod)
     findings += _check_recompile_hazards(mod)
     findings += _check_dense_dequant(mod, table)

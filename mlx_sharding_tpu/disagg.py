@@ -68,6 +68,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.resilience import (
     HandoffReadyError,
@@ -76,6 +77,7 @@ from mlx_sharding_tpu.resilience import (
     ResumeState,
 )
 from mlx_sharding_tpu.testing.faults import inject
+from mlx_sharding_tpu.utils.observability import HANDOFF_BUCKETS_MS, Histogram
 
 
 def _pct(sorted_ms: list, q: float) -> Optional[float]:
@@ -129,8 +131,21 @@ class DisaggCoordinator:
         self.store_skips = 0       # full store hits that skipped phase 1
         self.fallbacks: dict = {}  # degradation counts by kind
         self._ms: deque = deque(maxlen=handoff_window)  # DMA+control ms
+        # cumulative handoff-latency histogram: unlike the windowed deque
+        # above, never resets, so /metrics can render a Prometheus-grade
+        # ``mst_disagg_handoff_ms_bucket`` family that survives scrapes
+        self._ms_hist = Histogram(HANDOFF_BUCKETS_MS,
+                                  "DisaggCoordinator._ms_hist")
 
     # ---------------------------------------------------------- serving
+    @property
+    def supports_trace(self) -> bool:
+        """``_trace`` is forwarded verbatim to both pools, so one request
+        timeline spans the prefill leg, the handoff, and the decode leg —
+        advertise it only when every leg will honor it."""
+        return (getattr(self.prefill, "supports_trace", False)
+                and getattr(self.decode, "supports_trace", False))
+
     @property
     def supports_deadlines(self) -> bool:
         return (getattr(self.prefill, "supports_deadlines", False)
@@ -240,30 +255,40 @@ class DisaggCoordinator:
         # ---- phase 2: handoff (or fallback re-placement)
         if state is not None:
             target = self.decode
+            tr = kw.get("_trace")
             t0 = time.monotonic()
-            try:
-                inject("disagg.handoff",
-                       n_bytes=getattr(state.block, "nbytes", 0))
-            except Exception:
-                # handoff-control failure: serve in place — the prefill
-                # pool finishes the stream it started
-                self._count("handoff_fault")
-                target = self.prefill
-            if state.block is not None:
+            tp0 = time.perf_counter()
+            with tracing.bind(tr):
                 try:
-                    # the export was dispatch-only on the prefill tick;
-                    # THIS is the device→host DMA, on the request's own
-                    # consumer thread so both pools keep ticking under it
-                    state.block.to_host()
+                    inject("disagg.handoff",
+                           n_bytes=getattr(state.block, "nbytes", 0))
                 except Exception:
-                    state.block = None  # fold re-prefill stays token-exact
-                    self._count("block_dropped")
+                    # handoff-control failure: serve in place — the prefill
+                    # pool finishes the stream it started
+                    self._count("handoff_fault")
+                    target = self.prefill
+                if state.block is not None:
+                    try:
+                        # the export was dispatch-only on the prefill tick;
+                        # THIS is the device→host DMA, on the request's own
+                        # consumer thread so both pools keep ticking under it
+                        state.block.to_host()
+                    except Exception:
+                        state.block = None  # fold re-prefill stays token-exact
+                        self._count("block_dropped")
             if target is self.decode:
                 nbytes = getattr(state.block, "nbytes", 0) or 0
+                ms = (time.monotonic() - t0) * 1000.0
                 with self._lock:
                     self.handoffs += 1
                     self.handoff_bytes += int(nbytes)
-                    self._ms.append((time.monotonic() - t0) * 1000.0)
+                    self._ms.append(ms)
+                self._ms_hist.observe(ms)
+                if tr is not None:
+                    tr.add("handoff_transfer", tp0, time.perf_counter(),
+                           bytes=int(nbytes))
+            elif tr is not None:
+                tr.point("handoff_fault")
             plan = [target, self.decode if target is self.prefill
                     else self.prefill]
             fwd = resume_kw
@@ -318,7 +343,20 @@ class DisaggCoordinator:
                 "ms_p50": _pct(ms, 50),
                 "ms_p99": _pct(ms, 99),
                 "window": len(ms),
+                "ms_hist": self._ms_hist.to_dict(),
             }
+
+    def latency_stats(self) -> Optional[dict]:
+        """Pool batchers' cumulative latency histograms (ITL, queue-wait)
+        merged across both roles — same shape as a single batcher's."""
+        per = [s for s in (
+            getattr(self.prefill, "latency_stats", lambda: None)(),
+            getattr(self.decode, "latency_stats", lambda: None)(),
+        ) if s]
+        if not per:
+            return None
+        return {k: Histogram.merge_dicts([s[k] for s in per if k in s])
+                for k in set().union(*per)}
 
     def stats(self):
         """(slots, active, queued) summed over both pools."""

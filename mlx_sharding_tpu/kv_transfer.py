@@ -55,6 +55,7 @@ import hashlib
 import logging
 import queue
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
@@ -62,6 +63,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.cache import export_pool_pages, import_pool_pages
 from mlx_sharding_tpu.testing.faults import inject
@@ -178,6 +180,11 @@ class KVPageBlock:
         and a drain both may race to flush the same block. This is the
         only place the export's device→host copy blocks — never call it
         from a tick-hot function (MST106)."""
+        # the one blocking device→host copy: span it when the caller bound
+        # a trace (disagg handoff, drain); the tier's flusher thread has no
+        # binding, so steady-state spills record nothing here
+        tr = tracing.current()
+        t0 = time.perf_counter() if tr is not None else 0.0
         with self._lock:
             if self._host:
                 return self
@@ -190,6 +197,8 @@ class KVPageBlock:
                 self.resume_recent = np.asarray(self.resume_recent)
             self.checksum = self._fingerprint()
             self._host = True
+        if tr is not None:
+            tr.add("kv_to_host", t0, time.perf_counter(), bytes=self.nbytes)
         return self
 
     def _fingerprint(self) -> str:
@@ -282,7 +291,15 @@ def export_block(
     if put is not None:
         ids = put(ids)
     fn = gather if gather is not None else export_pool_pages
-    k_pages, v_pages = fn(cache, ids)
+    # self-instrumentation on the caller-bound trace (tracing.bind in the
+    # scheduler/coordinator): the gather DISPATCH cost, not the DMA — the
+    # copy itself lands in to_host on whoever pulls the block
+    tr = tracing.current()
+    if tr is not None:
+        with tr.timed("kv_export", pages=len(page_ids), tokens=n_tokens):
+            k_pages, v_pages = fn(cache, ids)
+    else:
+        k_pages, v_pages = fn(cache, ids)
     history = [int(t) for t in history]
     return KVPageBlock(
         k_pages=k_pages,
@@ -322,6 +339,11 @@ def import_block(cache, block: KVPageBlock, page_ids, *, scatter=None, put=None)
     # prefetch-staged device copies when present (the overlapped path);
     # otherwise the raw payload — host numpy here IS the demand import
     k_pages, v_pages = block.payload()
+    tr = tracing.current()
+    if tr is not None:
+        with tr.timed("kv_import", pages=len(page_ids),
+                      tokens=block.n_tokens):
+            return fn(cache, k_pages, v_pages, ids)
     return fn(cache, k_pages, v_pages, ids)
 
 

@@ -50,6 +50,7 @@ from typing import Optional
 
 import numpy as np
 
+from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.kv_transfer import KVPageBlock, KVSpillTier
 from mlx_sharding_tpu.testing.faults import inject
@@ -193,6 +194,16 @@ class PrefixStore:
         ``cache.prefix_lookup`` fires first — callers degrade to plain
         prefill and count via :meth:`count_lookup_fault`."""
         inject("cache.prefix_lookup", engine=id(owner))
+        # self-instrumentation: the scheduler binds the admitting request's
+        # trace (tracing.bind) around this call, so the LPM probe lands on
+        # the right timeline without a signature change
+        tr = tracing.current()
+        if tr is None:
+            return self._lookup(owner, digests)
+        with tr.timed("prefix_lookup", chain=len(digests)):
+            return self._lookup(owner, digests)
+
+    def _lookup(self, owner, digests: list) -> Optional[tuple]:
         if not digests:
             return None
         oid = id(owner)
@@ -244,7 +255,13 @@ class PrefixStore:
             entry.hits += 1
             self.cow_forks += 1
             self.tokens_reused += n_tokens
-            return PrefixLease(self, entry, cover, n_tokens)
+            lease = PrefixLease(self, entry, cover, n_tokens)
+        tr = tracing.current()
+        if tr is not None:
+            # the COW fork on the admitting request's timeline: how many
+            # prefill tokens the store just deleted from its TTFT
+            tr.point("prefix_lease", cover=cover, tokens=n_tokens)
+        return lease
 
     def host_block(self, digest: bytes) -> Optional[KVPageBlock]:
         """The host tier's block for ``digest`` (shared — NOT removed; any

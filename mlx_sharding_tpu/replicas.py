@@ -58,8 +58,10 @@ import time
 from collections import OrderedDict
 from typing import Optional
 
+from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.utils.digests import chunk_digests
+from mlx_sharding_tpu.utils.observability import Histogram
 from mlx_sharding_tpu.resilience import (
     HandoffReadyError,
     QueueFullError,
@@ -186,6 +188,14 @@ class ReplicaSet:
         with self._lock:
             reps = list(self.replicas)
         return all(getattr(r, "supports_deadlines", False) for r in reps)
+
+    @property
+    def supports_trace(self) -> bool:
+        """A ``_trace`` handle is forwarded verbatim to the picked replica;
+        advertise it only when every replica accepts the kwarg."""
+        with self._lock:
+            reps = list(self.replicas)
+        return all(getattr(r, "supports_trace", False) for r in reps)
 
     # ------------------------------------------------------------- routing
     def _breaker_state(self, j: int, now: float) -> str:
@@ -347,6 +357,7 @@ class ReplicaSet:
             self._probing[i] = False
 
     def _record_failure(self, i: int):
+        opened = False
         with self._lock:
             self.failures[i] += 1
             self._fails_consec[i] += 1
@@ -358,6 +369,12 @@ class ReplicaSet:
             elif self._fails_consec[i] >= self.breaker_threshold:
                 self._open_until[i] = now + self.probe_interval
                 self.breaker_opens[i] += 1
+                opened = True
+        if opened:
+            # flight recorder: freeze the recent request timelines at the
+            # moment a replica is circuit-broken out of routing (outside
+            # _lock — the tracer takes its own lock)
+            tracing.auto_snapshot(f"breaker_open:replica{i}")
 
     @staticmethod
     def _note_token(emitted: list, item) -> bool:
@@ -424,6 +441,10 @@ class ReplicaSet:
                         raise _ResumeUnsupported()
                     fwd = dict(kw, _resume=resume)
                 inject("replica.dispatch", replica=i)
+                tr = kw.get("_trace")
+                if tr is not None:
+                    tr.point("dispatch", replica=i, probe=probe,
+                             resumed=resume is not None)
                 if serial is not None:
                     with serial:
                         for item in rep.generate_step(prompt_tokens, **fwd):
@@ -477,6 +498,9 @@ class ReplicaSet:
                 replaced = True
                 excluded.add(i)
                 last_exc = exc
+                tr = kw.get("_trace")
+                if tr is not None:
+                    tr.point("drain_migrate", replica=i)
             except QueueFullError as exc:
                 # saturation (or ReplicaDrainingError, its drain-time
                 # subtype), not sickness: no breaker penalty, but try the
@@ -510,6 +534,10 @@ class ReplicaSet:
                     replaced = True
                 excluded.add(i)
                 last_exc = exc
+                tr = kw.get("_trace")
+                if tr is not None:
+                    tr.point("failover", replica=i,
+                             resumed=started and replaced)
             finally:
                 self._done(i, probe)
 
@@ -775,6 +803,26 @@ class ReplicaSet:
             agg["drains"] = self.drains
             agg["migrated_streams"] = self.migrated_streams
         return agg
+
+    def latency_stats(self) -> Optional[dict]:
+        """Cumulative latency histograms (ITL, queue-wait) merged across
+        replica batchers — the /metrics renderer sees ONE fleet-wide
+        histogram per family, not per-replica fragments. None when no
+        replica keeps them (plain engines)."""
+        with self._lock:
+            reps = list(self.replicas)
+        per = []
+        for r in reps:
+            fn = getattr(r, "latency_stats", None)
+            if fn is None:
+                continue
+            s = fn()
+            if s:
+                per.append(s)
+        if not per:
+            return None
+        return {k: Histogram.merge_dicts([s[k] for s in per if k in s])
+                for k in set().union(*per)}
 
     def spill_stats(self) -> Optional[dict]:
         """KV spill/migration counters summed across replica batchers (the

@@ -60,6 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.cache import (
     KVCache,
@@ -79,6 +80,11 @@ from mlx_sharding_tpu.resilience import (
     ResumeState,
 )
 from mlx_sharding_tpu.testing.faults import inject
+from mlx_sharding_tpu.utils.observability import (
+    Histogram,
+    ITL_BUCKETS_S,
+    LATENCY_BUCKETS_S,
+)
 from mlx_sharding_tpu.sample import (
     SamplerParams,
     make_sampler_params,
@@ -149,6 +155,15 @@ class _Request:
     # keeps decoding for nobody
     _consumed_seen: int = 0
     _cold_ticks: int = 0
+    # request-lifecycle tracing (tracing.py): the bound RequestTrace (None
+    # when tracing is off or the request is unsampled — every hot-path site
+    # guards on that), whether THIS batcher began the trace (and so must
+    # retire it into the flight recorder at finish), and perf_counter
+    # stamps feeding the queue-wait / inter-token histograms
+    _trace: Optional[object] = None
+    _trace_own: bool = False
+    _t_submit: float = 0.0
+    _t_last_emit: float = 0.0
 
 
 @dataclass
@@ -217,6 +232,11 @@ class ContinuousBatcher:
     # the stream delivers the first token, then ends with
     # HandoffReadyError carrying the request's ResumeState
     supports_prefill_only = True
+    # generate_step accepts _trace=RequestTrace (tracing.py): the server
+    # (or disagg coordinator) binds one span timeline through the whole
+    # request path; without one the scheduler self-begins on the process
+    # tracer when tracing is enabled
+    supports_trace = True
 
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
                  policy: str = "fifo", prefix_cache: bool = False,
@@ -561,6 +581,20 @@ class ContinuousBatcher:
         self._tick_host_s_total = 0.0
         self._tick_blocked_s_total = 0.0
         self._tick_count = 0  # ticks that harvested a block
+        # always-on latency histograms (/metrics): inter-token latency at
+        # the emit path, admission queue wait at slot assignment. These are
+        # the metric itself (a lock + bisect per observation, same grade as
+        # the tick-timing counters), distinct from per-request tracing —
+        # which stays behind the `if tr is not None` no-op guard (MST112)
+        self._h_itl = Histogram(ITL_BUCKETS_S, "ContinuousBatcher._h_itl")
+        self._h_queue_wait = Histogram(
+            LATENCY_BUCKETS_S, "ContinuousBatcher._h_queue_wait"
+        )
+        # --trace-profile resolved once at construction (serving configures
+        # tracing before building engines): True wraps each dispatched
+        # decode block in jax.profiler.TraceAnnotation so host spans line
+        # up with the XLA timeline in an on-chip profile capture
+        self._trace_profile = tracing.profile_enabled()
         # time the tick spent inside import_block (device blocked on the
         # resume path): ~0 when prefetch staged the pages, the full
         # host→device marshal on a demand import — the number that makes
@@ -674,6 +708,7 @@ class ContinuousBatcher:
         stall_timeout: Optional[float] = None,    # inter-token watchdog
         _resume: Optional[ResumeState] = None,    # dispatcher-internal
         _prefill_only: bool = False,              # disagg-coordinator-internal
+        _trace=None,                              # tracing.RequestTrace or None
     ):
         # Eager validation/admission, lazy consumption: every rejection
         # (bad params, queue full) raises on the CALLING thread before any
@@ -791,6 +826,23 @@ class ContinuousBatcher:
                 req.resume_recent = np.asarray(resume_recent)
             with self._admission_lock:
                 self.migrations_in += 1
+        # Bind (or self-begin) the request's span timeline. The server and
+        # disagg coordinator pass _trace so one timeline spans the whole
+        # path; direct scheduler users (bench, tests) get a trace from the
+        # process tracer when one is configured — begin() returns None when
+        # tracing is off or this request falls outside the sample.
+        tr = _trace
+        if tr is None:
+            tr = tracing.begin()
+            req._trace_own = tr is not None
+        req._trace = tr
+        req._t_submit = time.perf_counter()
+        if tr is not None:
+            tr.note(
+                prompt_tokens=int(prompt.size), max_tokens=int(max_tokens),
+                prefill_only=bool(_prefill_only), resumed=_resume is not None,
+            )
+            tr.point("submit")
         self._ensure_running()
         if self.max_queue is not None:
             with self._admission_lock:
@@ -803,6 +855,13 @@ class ContinuousBatcher:
                     bound = max(1, bound // 2)
                 if depth >= bound:
                     self.shed_queue_full += 1
+                    if tr is not None:
+                        # the shed is the request's whole story: stamp it
+                        # and retire a self-begun trace so it can't leak
+                        # in the recorder's live table
+                        tr.point("shed", depth=depth, bound=bound)
+                        if req._trace_own:
+                            tracing.finish(tr)
                     raise QueueFullError(
                         depth, bound,
                         retry_after_s=estimate_retry_after(
@@ -1045,6 +1104,17 @@ class ContinuousBatcher:
             "kv_import_s_total": self._tick_kv_import_s_total,
         }
 
+    def latency_stats(self) -> dict:
+        """Bucketed latency snapshots for /metrics: inter-token latency
+        (observed at the emit path) and admission queue wait (submit →
+        slot assignment), as :meth:`Histogram.to_dict` snapshots — the
+        mergeable currency ReplicaSet/DisaggCoordinator aggregate across
+        replicas with :meth:`Histogram.merge_dicts`."""
+        return {
+            "itl": self._h_itl.to_dict(),
+            "queue_wait": self._h_queue_wait.to_dict(),
+        }
+
     def reset_tick_timing(self):
         """Zero the tick-timing accumulators. The first ticks after
         construction pay jit compilation (dispatch-side, so it lands in
@@ -1202,7 +1272,10 @@ class ContinuousBatcher:
         if not digests:
             return None
         try:
-            return self.prefix_store.lookup(self, digests)
+            # bind the request's trace for the store's self-instrumented
+            # prefix_lookup span (tracing.current() inside the store)
+            with tracing.bind(req._trace):
+                return self.prefix_store.lookup(self, digests)
         except Exception as e:
             self.prefix_store.count_lookup_fault()
             logging.getLogger(__name__).debug(
@@ -1264,13 +1337,18 @@ class ContinuousBatcher:
         try:
             was_staged = block.is_prefetched
             t0 = time.perf_counter()
-            self.cache = import_block(
-                self.cache, block, pages[:cover],
-                scatter=self._import_pages, put=self._put,
-            )
+            with tracing.bind(req._trace):
+                self.cache = import_block(
+                    self.cache, block, pages[:cover],
+                    scatter=self._import_pages, put=self._put,
+                )
             dt = time.perf_counter() - t0
             self.tick_kv_import_ms_last = dt * 1e3
             self._tick_kv_import_s_total += dt
+            tr = req._trace
+            if tr is not None:
+                tr.add("handoff_import", t0, t0 + dt, kind="prefix_store",
+                       pages=cover, staged=was_staged)
             store.count_import(staged=was_staged, n_tokens=cover * page)
             with self._admission_lock:
                 if was_staged:
@@ -1416,6 +1494,9 @@ class ContinuousBatcher:
                 # drops to 0 instead of pretending the close succeeded
                 with self._start_lock:
                     self.thread_wedged = True
+                # post-mortem: freeze the flight recorder so the wedged
+                # tick's victims keep their timelines after the ring cycles
+                tracing.auto_snapshot("wedge:scheduler")
                 logging.getLogger(__name__).error(
                     "scheduler thread failed to exit within %.0fs — a tick "
                     "is wedged; the thread is abandoned (daemon) and /health "
@@ -1481,6 +1562,14 @@ class ContinuousBatcher:
         per scheduler tick — so active slots keep decoding during admission."""
         prompt = req.prompt
         slot_arr = self._put(jnp.asarray(slot, jnp.int32))
+        # queue wait ends here: submit (or re-queue after preempt/wake) →
+        # slot assignment. Histogram always; span only when traced.
+        now = time.perf_counter()
+        if req._t_submit:
+            self._h_queue_wait.observe(max(0.0, now - req._t_submit))
+        tr = req._trace
+        if tr is not None:
+            tr.add("queue_wait", req._t_submit or now, now, slot=slot)
         reused_tokens = 0
         req.admit_seq = self._admit_counter
         self._admit_counter += 1
@@ -1636,13 +1725,18 @@ class ContinuousBatcher:
             was_host = block.is_host
             was_staged = block.is_prefetched
             t0 = time.perf_counter()
-            self.cache = import_block(
-                self.cache, block, pages[:data_pages],
-                scatter=self._import_pages, put=self._put,
-            )
+            with tracing.bind(req._trace):
+                self.cache = import_block(
+                    self.cache, block, pages[:data_pages],
+                    scatter=self._import_pages, put=self._put,
+                )
             dt = time.perf_counter() - t0
             self.tick_kv_import_ms_last = dt * 1e3
             self._tick_kv_import_s_total += dt
+            tr = req._trace
+            if tr is not None:
+                tr.add("handoff_import", t0, t0 + dt, pages=data_pages,
+                       staged=was_staged)
             if was_host:
                 with self._admission_lock:
                     if was_staged:
@@ -1722,6 +1816,8 @@ class ContinuousBatcher:
         eng = self.engine
         c = eng.prefill_chunk
         slot_arr = self._put(jnp.asarray(req.slot, jnp.int32))
+        tr = req._trace
+        t0 = time.perf_counter() if tr is not None else 0.0
         if req.prefill_pos < req.prompt.size:
             chunk, n_valid = self._chunk_at(req.prompt, req.prefill_pos, c)
             logits, self.cache = eng.prefill_slot()(
@@ -1743,6 +1839,9 @@ class ContinuousBatcher:
                 self._put(jnp.asarray(n_valid, jnp.int32)), None,
             )
             req.draft_pos += n_valid
+        if tr is not None:
+            tr.add("prefill", t0, time.perf_counter(), slot=req.slot,
+                   pos=req.prefill_pos, chunk=c)
         if not self._prefill_done(req):
             return
         logits = req._last_logits
@@ -1819,6 +1918,19 @@ class ContinuousBatcher:
             self._handoff_ready.append(req)
 
     def _emit(self, req: _Request, token: int, logprobs):
+        now = time.perf_counter()
+        if req.produced == 0:
+            # first token leaves the scheduler: the TTFT stamp on a traced
+            # timeline (the TTFT histogram itself is recorded server-side,
+            # where the client-visible first write happens)
+            tr = req._trace
+            if tr is not None:
+                tr.point("first_token", slot=req.slot)
+        elif req._t_last_emit:
+            # inter-token latency: the gap between consecutive emits of one
+            # stream — always-on metric, same grade as the tick counters
+            self._h_itl.observe(now - req._t_last_emit)
+        req._t_last_emit = now
         req.produced += 1
         # history is the tokens emitted since the last prompt fold — the
         # overcommit preempt/resume bookkeeping, and (always, since drain
@@ -1876,6 +1988,13 @@ class ContinuousBatcher:
         # reaps count too — they free queue capacity all the same
         with self._admission_lock:
             self._finish_times.append(time.monotonic())
+        tr = req._trace
+        if tr is not None:
+            tr.point("finish", produced=req.produced)
+            if req._trace_own:
+                # a self-begun trace retires here; a server-owned one is
+                # finished by the server after its last SSE write
+                tracing.finish(tr)
         req.out.put(None)
 
     def _reap_cancelled(self):
@@ -1994,6 +2113,8 @@ class ContinuousBatcher:
         Shared by overcommit preemption and cold-slot spill; the caller
         decides where the request goes (waiting line vs parked list)."""
         slot = req.slot
+        tr = req._trace
+        t0 = time.perf_counter() if tr is not None else 0.0
         if self._prefill_done(req):
             # one transfer for both sampler rows; runs only quiesced (no
             # in-flight block) in async mode, so this sync is off the
@@ -2001,8 +2122,9 @@ class ContinuousBatcher:
             keys_h, recent_h = jax.device_get((self.keys, self.recent))
             req.resume_keys = np.asarray(keys_h[slot])
             req.resume_recent = np.asarray(recent_h[slot])
-            if not self._spill_block(req):
-                self._fold_history(req)
+            with tracing.bind(tr):  # kv_transfer export self-instruments
+                if not self._spill_block(req):
+                    self._fold_history(req)
         req._chain = None
         req._splan = None
         req._last_logits = None
@@ -2018,13 +2140,21 @@ class ContinuousBatcher:
         self._drop_prefix_lease(req)
         self._slots[slot] = None
         req.slot = -1
+        if tr is not None:
+            tr.add("spill", t0, time.perf_counter(), slot=slot,
+                   spilled=req.spilled)
 
     def _preempt(self, req: _Request):
         """Evict an admitted request back to the head of the waiting line,
         releasing its pages (over-commit pool exhaustion)."""
         with self._admission_lock:
             self.preemptions += 1
+        tr = req._trace
+        if tr is not None:
+            tr.point("preempt", slot=req.slot)
         self._suspend_slot(req)
+        # back on the line: the queue-wait clock restarts for re-admission
+        req._t_submit = time.perf_counter()
         # head of the waiting line: preemption goes newest-first, so
         # repeated inserts at 0 restore admission order among the victims
         self._waiting.insert(0, req)
@@ -2069,6 +2199,9 @@ class ContinuousBatcher:
         for req in cold:
             with self._admission_lock:
                 self.cold_spills += 1
+            tr = req._trace
+            if tr is not None:
+                tr.point("cold_spill", slot=req.slot)
             self._suspend_slot(req)
             req._cold_ticks = 0
             self._parked.append(req)
@@ -2096,8 +2229,15 @@ class ContinuousBatcher:
         self._parked = keep
         if not woken:
             return
+        now = time.perf_counter()
         for req in woken:
             req._cold_ticks = 0
+            # re-queued at the head: the queue-wait clock restarts, and a
+            # traced timeline gets its wake point
+            req._t_submit = now
+            tr = req._trace
+            if tr is not None:
+                tr.point("wake")
             self._prefetch_block(req)
             with self._admission_lock:
                 self.cold_wakes += 1
@@ -2120,7 +2260,12 @@ class ContinuousBatcher:
         if not block.is_host or block.is_prefetched:
             return
         try:
+            tr = req._trace
+            t0 = time.perf_counter() if tr is not None else 0.0
             block.prefetch(put=self._put)
+            if tr is not None:
+                tr.add("prefetch", t0, time.perf_counter(),
+                       pages=block.n_pages)
             with self._admission_lock:
                 self.prefetches += 1
         except Exception as e:
@@ -2205,7 +2350,13 @@ class ContinuousBatcher:
                 self._drop_spill(req)
                 req.out.put(None)
                 continue
-            state = self._export_resume_state(req, slot, keys_h, recent_h)
+            tr = req._trace
+            t0 = time.perf_counter() if tr is not None else 0.0
+            with tracing.bind(tr):
+                state = self._export_resume_state(req, slot, keys_h, recent_h)
+            if tr is not None:
+                tr.add("migration", t0, time.perf_counter(), slot=slot,
+                       block=state.block is not None)
             self._release_pages(slot)
             self._drop_prefix_lease(req)
             req.out.put(RequestMigratedError(state))
@@ -2222,7 +2373,12 @@ class ContinuousBatcher:
                 self._drop_spill(req)
                 req.out.put(None)
                 continue
-            state = self._export_resume_state(req, -1, None, None)
+            tr = req._trace
+            t0 = time.perf_counter() if tr is not None else 0.0
+            with tracing.bind(tr):
+                state = self._export_resume_state(req, -1, None, None)
+            if tr is not None:
+                tr.add("migration", t0, time.perf_counter(), queued=True)
             req.out.put(RequestMigratedError(state))
             with self._admission_lock:
                 self.migrations_out += 1
@@ -2323,9 +2479,16 @@ class ContinuousBatcher:
             if req.cancelled:
                 self._finish(req)
                 continue
-            state = self._export_resume_state(
-                req, slot, keys_h, recent_h, host=False
-            )
+            tr = req._trace
+            t0 = time.perf_counter() if tr is not None else 0.0
+            with tracing.bind(tr):
+                state = self._export_resume_state(
+                    req, slot, keys_h, recent_h, host=False
+                )
+            if tr is not None:
+                # phase 1 of the disagg handoff (export dispatch on the
+                # prefill replica); the coordinator records transfer/import
+                tr.add("handoff_export", t0, time.perf_counter(), slot=slot)
             self.active = self._row_set(
                 self.active, self._put(jnp.asarray(slot, jnp.int32)),
                 self._put(jnp.asarray(False)),
@@ -2427,11 +2590,21 @@ class ContinuousBatcher:
         # the exact chain the target consumed (sync/spec fallback only)
         prev_tok = self.last_tok if self.draft is not None else None
         block = self._decode_block_prog(want_lp)
-        outs, self.last_tok, self.cache, self.recent, self.keys = block(
-            eng.layer_params, eng.layer_masks, eng.vocab_parts,
-            eng.shared_params, self.last_tok, self.cache, self.active,
-            self.recent, self.keys, self.sp, self.rep_sizes, self.table,
-        )
+        if self._trace_profile:
+            # --trace-profile: annotate the dispatched block so the host
+            # span lines up with the XLA timeline in a profiler capture
+            with tracing.profile_span("mst.decode_block"):
+                outs, self.last_tok, self.cache, self.recent, self.keys = block(
+                    eng.layer_params, eng.layer_masks, eng.vocab_parts,
+                    eng.shared_params, self.last_tok, self.cache, self.active,
+                    self.recent, self.keys, self.sp, self.rep_sizes, self.table,
+                )
+        else:
+            outs, self.last_tok, self.cache, self.recent, self.keys = block(
+                eng.layer_params, eng.layer_masks, eng.vocab_parts,
+                eng.shared_params, self.last_tok, self.cache, self.active,
+                self.recent, self.keys, self.sp, self.rep_sizes, self.table,
+            )
         return _InflightBlock(outs=outs, live=live, want_lp=want_lp,
                               prev_tok=prev_tok)
 
@@ -2454,6 +2627,13 @@ class ContinuousBatcher:
         self._tick_count += 1
         toks = outs[0]  # (K, M, 1)
         live = inf.live
+        # per-tick spans for traced requests, reusing the tick-timing
+        # stamps above (t0/blocked) — no extra clock reads on this path
+        for _, _req in live:
+            _tr = _req._trace
+            if _tr is not None:
+                _tr.add("decode_tick", t0, t0 + blocked, slot=_req.slot,
+                        block=self.decode_block)
         if self.draft is not None and live:
             # This tick fell back to plain decode (spec paused — logprobs
             # wanted, or a slot within K of max_seq): the target just
@@ -2876,6 +3056,9 @@ class ContinuousBatcher:
             self._admit_waiting()
 
     def _fail_all(self, exc: BaseException):
+        # a scheduler-thread failure is an incident: snapshot the flight
+        # recorder before the streams die so their timelines survive
+        tracing.auto_snapshot("scheduler_fail")
         # drop the lookahead block's futures (host-side); the wholesale
         # pool reset below reclaims whatever it was still writing
         self._inflight = None

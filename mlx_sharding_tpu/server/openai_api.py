@@ -34,6 +34,7 @@ from typing import Optional
 
 import numpy as np
 
+from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.generate import TokenLogprobs
 from mlx_sharding_tpu.resilience import (
@@ -884,6 +885,24 @@ class APIHandler(BaseHTTPRequestHandler):
                     payload["status"] = "degraded"
                     serving = False
             return self._json(200 if serving else 503, payload)
+        elif path == "/admin/trace" or path.startswith("/admin/trace/"):
+            # flight-recorder readout: /admin/trace/dump is the whole ring
+            # (+ incident snapshots) as ONE chrome://tracing JSON document;
+            # /admin/trace/<request_id> is one request's timeline (live,
+            # retired, or preserved in a snapshot)
+            tracer = tracing.get_tracer()
+            if tracer is None or not tracer.enabled:
+                return self._error(
+                    404, "tracing is off — start the server with "
+                         "--trace sample|on"
+                )
+            rest = path[len("/admin/trace"):].strip("/")
+            if rest in ("", "dump"):
+                return self._json(200, tracer.export_dump())
+            payload = tracer.export_request(rest)
+            if payload is None:
+                return self._error(404, f"no trace recorded for {rest!r}")
+            return self._json(200, payload)
         elif path == "/metrics":
             body = self.metrics.render().encode()
             self.send_response(200)
@@ -1187,6 +1206,9 @@ class APIHandler(BaseHTTPRequestHandler):
     # ----------------------------------------------------------- execution
     def _run(self, body, params, generator, tokenizer, prompt_ids, chat: bool):
         rid = self._response_id()
+        # the trace key the operator curls /admin/trace/<id> with — echoed
+        # on EVERY response (traced or not) so clients can always correlate
+        self._resp_headers["X-MST-Request-Id"] = rid
         model_name = body.get("model", "default_model")
         stop_id_sequences = [_encode_plain(tokenizer, s) for s in params["stop_words"]]
         eos = getattr(tokenizer, "eos_token_id", None)
@@ -1267,24 +1289,38 @@ class APIHandler(BaseHTTPRequestHandler):
             if getattr(generator, "concurrent", False)
             else self.gen_lock
         )
-        with lock:
-            if params["stream"]:
-                self._stream(
-                    rid, obj + ".chunk", model_name, generator, tokenizer,
-                    prompt_ids, stop_id_sequences, eos, chat, gen_kwargs,
-                    soft_timeout,
-                )
-            else:
-                self._complete(
-                    rid, obj, model_name, generator, tokenizer, prompt_ids,
-                    stop_id_sequences, eos, chat, params["logprobs"], gen_kwargs,
-                    soft_timeout,
-                )
+        # request-lifecycle tracing: begin a timeline under the client-
+        # visible request id and hand it down the stack — the scheduler,
+        # disagg coordinator, replica router and KV paths all stamp spans
+        # onto it. The server owns the handle, so it (not the scheduler)
+        # retires it into the flight-recorder ring when the response ends.
+        trace = (
+            tracing.begin(rid)
+            if getattr(generator, "supports_trace", False) else None
+        )
+        if trace is not None:
+            gen_kwargs["_trace"] = trace
+        try:
+            with lock:
+                if params["stream"]:
+                    self._stream(
+                        rid, obj + ".chunk", model_name, generator, tokenizer,
+                        prompt_ids, stop_id_sequences, eos, chat, gen_kwargs,
+                        soft_timeout, trace=trace,
+                    )
+                else:
+                    self._complete(
+                        rid, obj, model_name, generator, tokenizer, prompt_ids,
+                        stop_id_sequences, eos, chat, params["logprobs"],
+                        gen_kwargs, soft_timeout, trace=trace,
+                    )
+        finally:
+            tracing.finish(trace)
 
     def _complete(
         self, rid, obj, model_name, generator, tokenizer, prompt_ids,
         stop_id_sequences, eos, chat, want_logprobs, gen_kwargs,
-        soft_timeout=None,
+        soft_timeout=None, trace=None,
     ):
         # non-streaming path (ref handle_completion shard/openai_api.py:357-434)
         tokens: list[int] = []
@@ -1361,6 +1397,7 @@ class APIHandler(BaseHTTPRequestHandler):
     def _stream(
         self, rid, obj, model_name, generator, tokenizer, prompt_ids,
         stop_id_sequences, eos, chat, gen_kwargs, soft_timeout=None,
+        trace=None,
     ):
         # SSE with partial-stop-word buffering (ref handle_stream
         # shard/openai_api.py:436-505): if the current token tail could still
@@ -1392,9 +1429,19 @@ class APIHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
         def emit(payload: dict):
-            inject("server.sse_write")  # fault harness: kill a live stream
-            self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
-            self.wfile.flush()
+            with tracing.bind(trace):
+                inject("server.sse_write")  # fault harness: kill a live
+                # stream (record_fault stamps the bound timeline first)
+            buf = f"data: {json.dumps(payload)}\n\n".encode()
+            if trace is not None:
+                t0 = time.perf_counter()
+                self.wfile.write(buf)
+                self.wfile.flush()
+                trace.add("sse_write", t0, time.perf_counter(),
+                          bytes=len(buf))
+            else:
+                self.wfile.write(buf)
+                self.wfile.flush()
 
         if chat:
             emit(
@@ -1796,6 +1843,29 @@ def main(argv=None):
     parser.add_argument("--log-level", default="INFO")
     parser.add_argument("--profile-dir", default=None,
                         help="write JAX profiler traces per request here")
+    parser.add_argument("--trace", choices=("off", "sample", "on"),
+                        default="off",
+                        help="request-lifecycle tracing: record per-request "
+                             "span timelines (queue wait, prefill, handoff, "
+                             "decode ticks, spill/wake, SSE writes) into a "
+                             "bounded flight-recorder ring, exported as "
+                             "chrome://tracing JSON via GET /admin/trace/"
+                             "{request_id} and /admin/trace/dump. 'sample' "
+                             "traces every --trace-sample-th request; 'on' "
+                             "traces all; 'off' (default) compiles to "
+                             "None-check no-ops on the hot paths")
+    parser.add_argument("--trace-buffer", type=int, default=256,
+                        help="flight-recorder capacity: completed request "
+                             "timelines kept in the ring (oldest evicted); "
+                             "incident snapshots (breaker trip, wedge, "
+                             "injected fault) preserve theirs separately")
+    parser.add_argument("--trace-sample", type=int, default=8,
+                        help="with --trace sample: trace every Nth request")
+    parser.add_argument("--trace-profile", action="store_true",
+                        help="with --trace: wrap traced decode blocks in "
+                             "jax.profiler.TraceAnnotation so host spans "
+                             "line up with the XLA timeline under "
+                             "--profile-dir")
     parser.add_argument("--chat-template", default=None,
                         help="jinja chat template (inline, or @/path/to/file) "
                         "overriding the tokenizer's")
@@ -1819,7 +1889,18 @@ def main(argv=None):
         if not args.stage_bounds and (args.num_stages or 1) <= 1:
             parser.error("multi-host serving requires a pipeline "
                          "(--num-stages > 1 or --stage-bounds)")
+    if args.trace_buffer < 1:
+        parser.error("--trace-buffer must be >= 1")
+    if args.trace_sample < 1:
+        parser.error("--trace-sample must be >= 1")
+    if args.trace_profile and args.trace == "off":
+        parser.error("--trace-profile requires --trace sample|on")
     logging.basicConfig(level=args.log_level.upper())
+    # before the provider builds any engine: batchers resolve the profile
+    # bridge once at construction, so the tracer must exist first
+    tracing.configure(args.trace, buffer=args.trace_buffer,
+                      sample_n=args.trace_sample,
+                      profile=args.trace_profile)
     if args.coordinator:
         import jax
 

@@ -171,6 +171,17 @@ def inject(site: str, **ctx):
         return
     for f in _ARMED.get(site, ()):
         if f._applies(ctx):
+            try:
+                # stamp the degradation on the victim request's timeline
+                # and snapshot the flight recorder BEFORE the fault fires —
+                # after trigger() the stack is already unwinding. Lazy
+                # import: faults must stay importable from anywhere without
+                # dragging the tracing module in at arm time.
+                from mlx_sharding_tpu import tracing
+
+                tracing.record_fault(site)
+            except Exception:  # noqa: BLE001 — tracing never blocks a fault
+                pass
             f.trigger()
 
 

@@ -1,0 +1,421 @@
+"""Per-request span tracing: flight recorder + Chrome ``trace_event`` export.
+
+Aggregate counters (``/metrics``) say *that* p99 TTFT regressed; they
+cannot say *which hop* cost what for *which request*. This module is the
+per-request instrument: a :class:`RequestTrace` records typed spans —
+``queue_wait``, ``prefix_lookup``, ``prefill``, ``handoff_export`` /
+``handoff_transfer`` / ``handoff_import``, ``decode_tick``, ``spill``,
+``wake``, ``prefetch``, ``migration``, ``sse_write`` — into a bounded,
+lock-correct structure, and finished traces land in a ring buffer (the
+"flight recorder", ``--trace-buffer N`` requests) that serves
+``GET /admin/trace/{request_id}`` and ``GET /admin/trace/dump`` as Chrome
+``chrome://tracing`` JSON.
+
+Cost contract: with ``--trace off`` (the default — the module-level tracer
+starts unconfigured) every instrumentation site is one attribute load and
+one ``is None`` branch; no span object, no timestamp, no lock is ever
+touched. The mstcheck rule MST112 enforces exactly this shape inside
+tick-hot scheduler functions: any ``tracing.``/span call there must sit
+under an ``if tr is not None:``-style guard.
+
+Sampling: ``--trace sample`` traces one request in ``sample_n`` (counter-
+based, deterministic — no wall clock, no RNG); ``--trace on`` traces all.
+
+Post-mortems: :func:`auto_snapshot` freezes the live + ring traces into a
+bounded snapshot list. It is called on breaker trip
+(``ReplicaSet._record_failure``), wedge detection
+(``ContinuousBatcher.close`` join timeout), and every fault-site firing
+(``testing.faults.inject``), so the victim request's timeline survives the
+incident even after the ring cycles.
+
+Timebase: ``time.perf_counter()`` throughout (never ``time.time()`` —
+wall clock steps under NTP and is banned from hot paths by MST107/MST112).
+Chrome ``ts`` values are microseconds relative to the tracer's epoch, so
+every trace in a dump shares one timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from mlx_sharding_tpu.analysis.runtime import make_lock
+
+# the typed span vocabulary — one lane per type in the Chrome export
+SPAN_TYPES = (
+    "queue_wait",
+    "prefix_lookup",
+    "prefill",
+    "handoff_export",
+    "handoff_transfer",
+    "handoff_import",
+    "decode_tick",
+    "spill",
+    "wake",
+    "prefetch",
+    "migration",
+    "sse_write",
+)
+
+# hard bound per trace: a runaway stream degrades to a truncated timeline
+# (with a drop counter), never to unbounded memory
+MAX_SPANS_PER_TRACE = 4096
+# snapshots kept (each is a frozen copy of live+ring at incident time)
+MAX_SNAPSHOTS = 8
+
+
+class RequestTrace:
+    """One request's span timeline. All mutation is under a leaf lock —
+    spans arrive from the scheduler tick thread while the server thread
+    may be exporting — and every recording method is cheap enough that
+    call sites only need the ``if tr is not None:`` no-op guard."""
+
+    __slots__ = ("request_id", "t0", "_lock", "_spans", "_marks", "_meta",
+                 "_dropped", "done")
+
+    def __init__(self, request_id: str, t0: Optional[float] = None):
+        self.request_id = str(request_id)
+        self.t0 = time.perf_counter() if t0 is None else float(t0)
+        self._lock = make_lock("RequestTrace._lock")
+        self._spans: list = []   # (name, t0, t1, args) perf_counter seconds
+        self._marks: list = []   # (name, t, args) instant events
+        self._meta: dict = {}
+        self._dropped = 0
+        self.done = False
+
+    # ------------------------------------------------------------ recording
+    def add(self, name: str, t0: float, t1: float, **args):
+        """Record a completed span with caller-measured endpoints. The
+        caller takes the two ``perf_counter()`` stamps so the lock is held
+        for the append only, never across the timed work."""
+        with self._lock:
+            if len(self._spans) >= MAX_SPANS_PER_TRACE:
+                self._dropped += 1
+                return
+            self._spans.append((name, float(t0), float(t1), args or None))
+
+    def point(self, name: str, **args):
+        """Record an instant event (first token, fault firing, failover)."""
+        t = time.perf_counter()
+        with self._lock:
+            if len(self._marks) >= MAX_SPANS_PER_TRACE:
+                self._dropped += 1
+                return
+            self._marks.append((name, t, args or None))
+
+    @contextlib.contextmanager
+    def timed(self, name: str, **args):
+        """Span context manager for non-hot call sites (store lookups,
+        handoff phases, SSE writes). Hot paths use :meth:`add` directly."""
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self.add(name, t0, time.perf_counter(), **args)
+
+    def note(self, **meta):
+        """Attach request metadata (prompt tokens, replica, role...)."""
+        with self._lock:
+            self._meta.update(meta)
+
+    # ------------------------------------------------------------- reading
+    def freeze(self) -> dict:
+        """A consistent, immutable copy for snapshots and export."""
+        with self._lock:
+            return {
+                "request_id": self.request_id,
+                "t0": self.t0,
+                "spans": list(self._spans),
+                "marks": list(self._marks),
+                "meta": dict(self._meta),
+                "dropped": self._dropped,
+                "done": self.done,
+            }
+
+    def span_names(self) -> list:
+        with self._lock:
+            return [s[0] for s in self._spans]
+
+    def mark_names(self) -> list:
+        with self._lock:
+            return [m[0] for m in self._marks]
+
+
+class Tracer:
+    """The flight recorder: live traces by request id, a bounded ring of
+    finished traces, and frozen incident snapshots."""
+
+    def __init__(self, *, mode: str = "off", buffer: int = 256,
+                 sample_n: int = 8, profile: bool = False):
+        if mode not in ("off", "sample", "on"):
+            raise ValueError(f"trace mode must be off/sample/on, got {mode!r}")
+        if buffer < 1:
+            raise ValueError(f"trace buffer must be >= 1, got {buffer}")
+        if sample_n < 1:
+            raise ValueError(f"sample_n must be >= 1, got {sample_n}")
+        self.mode = mode
+        self.buffer = int(buffer)
+        self.sample_n = int(sample_n)
+        self.profile = bool(profile)
+        self.epoch = time.perf_counter()  # shared timebase for dumps
+        self._lock = make_lock("Tracer._lock")
+        self._live: dict = {}                 # request_id -> RequestTrace
+        self._ring: deque = deque(maxlen=self.buffer)
+        self._snapshots: list = []            # (reason, [frozen trace, ...])
+        self._seq = 0                         # begin() calls (sampling base)
+        self._started = 0                     # traces actually created
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    # ----------------------------------------------------------- lifecycle
+    def begin(self, request_id: Optional[str] = None) -> Optional[RequestTrace]:
+        """Start tracing one request. Returns None when off or unsampled —
+        every downstream site then short-circuits on the None check."""
+        if self.mode == "off":
+            return None
+        with self._lock:
+            self._seq += 1
+            if self.mode == "sample" and (self._seq - 1) % self.sample_n:
+                return None
+            if request_id is None:
+                request_id = f"req-{self._seq}"
+            tr = RequestTrace(request_id)
+            self._live[tr.request_id] = tr
+            self._started += 1
+            return tr
+
+    def finish(self, tr: Optional[RequestTrace]):
+        """Retire a trace into the ring. Accepts None so call sites don't
+        need their own guard at request teardown."""
+        if tr is None:
+            return
+        with tr._lock:
+            tr.done = True
+        with self._lock:
+            self._live.pop(tr.request_id, None)
+            self._ring.append(tr)
+
+    # ------------------------------------------------------------- reading
+    def get(self, request_id: str) -> Optional[dict]:
+        """Frozen trace for ``request_id`` from live, ring, or snapshots
+        (newest first)."""
+        with self._lock:
+            tr = self._live.get(request_id)
+            ring = list(self._ring)
+            snaps = list(self._snapshots)
+        if tr is not None:
+            return tr.freeze()
+        for cand in reversed(ring):
+            if cand.request_id == request_id:
+                return cand.freeze()
+        for _, frozen in reversed(snaps):
+            for f in frozen:
+                if f["request_id"] == request_id:
+                    return f
+        return None
+
+    def dump(self) -> list:
+        """Frozen copies of every live + ring trace (oldest first)."""
+        with self._lock:
+            traces = list(self._ring) + list(self._live.values())
+        return [t.freeze() for t in traces]
+
+    def snapshot(self, reason: str) -> dict:
+        """Freeze the recorder for a post-mortem: live and ring traces are
+        copied (the originals keep recording) into a bounded snapshot list
+        keyed by ``reason`` (``fault:<site>``, ``breaker_open``, ``wedge``)."""
+        frozen = self.dump()
+        with self._lock:
+            self._snapshots.append((reason, frozen))
+            while len(self._snapshots) > MAX_SNAPSHOTS:
+                self._snapshots.pop(0)
+        return {"reason": reason, "traces": frozen}
+
+    def snapshots(self) -> list:
+        with self._lock:
+            return [{"reason": r, "traces": f} for r, f in self._snapshots]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "buffer": self.buffer,
+                "sample_n": self.sample_n,
+                "profile": self.profile,
+                "live": len(self._live),
+                "ring": len(self._ring),
+                "snapshots": len(self._snapshots),
+                "begun": self._seq,
+                "sampled": self._started,
+            }
+
+    # -------------------------------------------------------------- export
+    def export_request(self, request_id: str) -> Optional[dict]:
+        frozen = self.get(request_id)
+        if frozen is None:
+            return None
+        return chrome_trace([frozen], epoch=self.epoch)
+
+    def export_dump(self) -> dict:
+        out = chrome_trace(self.dump(), epoch=self.epoch)
+        with self._lock:
+            snaps = list(self._snapshots)
+        out["snapshots"] = [
+            {"reason": r, "requests": [f["request_id"] for f in frozen]}
+            for r, frozen in snaps
+        ]
+        return out
+
+
+# --------------------------------------------------------- chrome export
+def _lane(name: str) -> int:
+    """Stable tid per span type so every request renders the same lanes."""
+    try:
+        return SPAN_TYPES.index(name) + 1
+    except ValueError:
+        return len(SPAN_TYPES) + 1
+
+
+def chrome_trace(frozen_traces: list, *, epoch: float) -> dict:
+    """Chrome ``trace_event`` JSON (the ``chrome://tracing`` / Perfetto
+    format): one process per request, one thread lane per span type,
+    ``ts``/``dur`` in microseconds relative to ``epoch``."""
+    events = []
+    for pid, f in enumerate(frozen_traces, start=1):
+        rid = f["request_id"]
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"request {rid}"},
+        })
+        for lane_name in SPAN_TYPES:
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": _lane(lane_name), "args": {"name": lane_name},
+            })
+        for name, t0, t1, args in f["spans"]:
+            events.append({
+                "name": name, "ph": "X", "cat": "request",
+                "ts": round((t0 - epoch) * 1e6, 1),
+                "dur": round(max(0.0, t1 - t0) * 1e6, 1),
+                "pid": pid, "tid": _lane(name),
+                "args": dict(args or {}, request_id=rid),
+            })
+        for name, t, args in f["marks"]:
+            events.append({
+                "name": name, "ph": "i", "s": "p", "cat": "request",
+                "ts": round((t - epoch) * 1e6, 1),
+                "pid": pid, "tid": _lane(name.split(":", 1)[0]),
+                "args": dict(args or {}, request_id=rid),
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ----------------------------------------------------- module-level wiring
+_TRACER: Optional[Tracer] = None
+_TRACER_LOCK = threading.Lock()
+_TLS = threading.local()
+
+
+def configure(mode: str = "off", *, buffer: int = 256, sample_n: int = 8,
+              profile: bool = False) -> Tracer:
+    """Install the process-wide tracer (``--trace``/``--trace-buffer``/
+    ``--trace-profile``). Replaces any previous tracer wholesale so tests
+    can reconfigure; serving configures once at startup."""
+    global _TRACER
+    t = Tracer(mode=mode, buffer=buffer, sample_n=sample_n, profile=profile)
+    with _TRACER_LOCK:
+        _TRACER = t
+    return t
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _TRACER
+
+
+def begin(request_id: Optional[str] = None) -> Optional[RequestTrace]:
+    """Convenience: start a trace on the process tracer (None when off)."""
+    t = _TRACER
+    if t is None:
+        return None
+    return t.begin(request_id)
+
+
+def finish(tr: Optional[RequestTrace]):
+    t = _TRACER
+    if t is not None:
+        t.finish(tr)
+
+
+# ------------------------------------------------------ thread-local bind
+def current() -> Optional[RequestTrace]:
+    """The trace bound to the calling thread (see :class:`bind`) — how
+    leaf modules (prefix_store, kv_transfer) and the fault harness stamp
+    the right request without signature changes."""
+    return getattr(_TLS, "trace", None)
+
+
+class bind:
+    """Bind ``tr`` (possibly None) to the calling thread for a region::
+
+        with tracing.bind(req._trace):
+            store.lookup(owner, digests)   # lookup self-instruments
+    """
+
+    __slots__ = ("_tr", "_prev")
+
+    def __init__(self, tr: Optional[RequestTrace]):
+        self._tr = tr
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "trace", None)
+        _TLS.trace = self._tr
+        return self._tr
+
+    def __exit__(self, *exc):
+        _TLS.trace = self._prev
+        return False
+
+
+# ------------------------------------------------------------ post-mortem
+def auto_snapshot(reason: str):
+    """Freeze the flight recorder on an incident (breaker trip, wedge,
+    fault firing). Near-free no-op when tracing is off."""
+    t = _TRACER
+    if t is not None and t.enabled:
+        try:
+            t.snapshot(reason)
+        except Exception:  # a sick recorder must never worsen an incident
+            pass
+
+
+def record_fault(site: str):
+    """Called by ``testing.faults.inject`` when an armed fault actually
+    fires: stamp the bound request's timeline with the degradation event,
+    then snapshot so the victim's trace survives the ring."""
+    tr = current()
+    if tr is not None:
+        tr.point(f"fault:{site}", site=site)
+    auto_snapshot(f"fault:{site}")
+
+
+# -------------------------------------------------- XLA profiler bridging
+def profile_enabled() -> bool:
+    t = _TRACER
+    return bool(t is not None and t.enabled and t.profile)
+
+
+def profile_span(name: str):
+    """``jax.profiler.TraceAnnotation`` context for a sampled decode block
+    (``--trace-profile``), so host spans line up with the XLA timeline in
+    an on-chip ``profile_trace`` capture. Null context when jax's profiler
+    is unavailable — tracing must not create a jax dependency."""
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()

@@ -13,12 +13,23 @@ tok/s printout in the CLI are its entire observability story (SURVEY §5
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import random
 import threading
 from dataclasses import dataclass, field
 
 from mlx_sharding_tpu.analysis.runtime import make_lock
+
+# Shared bucket boundaries. Chosen to straddle both the CPU smoke rig
+# (ms-scale ticks) and real-chip serving points; the +Inf bucket is
+# implicit (the histogram's last slot).
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+ITL_BUCKETS_S = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0)
+HANDOFF_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0)
 
 
 @contextlib.contextmanager
@@ -59,6 +70,86 @@ class _Reservoir:
         return s[idx]
 
 
+class Histogram:
+    """Cumulative bucketed histogram — the Prometheus ``_bucket{le=}`` /
+    ``_sum`` / ``_count`` exposition shape. Unlike the reservoir summaries
+    (whose quantiles cannot be combined), bucket counts aggregate exactly:
+    merging replicas or successive scrapes is elementwise addition, which
+    is why the latency families that matter (TTFT, ITL, queue wait,
+    handoff) live here and not in :class:`_Reservoir`."""
+
+    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, bounds, lock_name: str = "Histogram._lock"):
+        self._bounds = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(self._bounds) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = make_lock(lock_name)
+
+    def observe(self, value: float):
+        v = float(value)
+        with self._lock:
+            # bisect_left: first bound >= v, i.e. the smallest le bucket
+            # containing v; beyond every bound lands in the +Inf slot
+            self._counts[bisect.bisect_left(self._bounds, v)] += 1
+            self._sum += v
+            self._count += 1
+
+    def to_dict(self) -> dict:
+        """Serializable snapshot — the cross-replica aggregation currency
+        (``latency_stats()`` contracts pass these, never live objects)."""
+        with self._lock:
+            return {
+                "bounds": list(self._bounds),
+                "counts": list(self._counts),
+                "sum": self._sum,
+                "count": self._count,
+            }
+
+    @staticmethod
+    def merge_dicts(dicts) -> dict | None:
+        """Elementwise merge of :meth:`to_dict` snapshots. Snapshots with
+        mismatched bounds are skipped (a mixed-version fleet must degrade,
+        not crash a scrape)."""
+        out = None
+        for d in dicts:
+            if not d or "counts" not in d:
+                continue
+            if out is None:
+                out = {
+                    "bounds": list(d["bounds"]),
+                    "counts": list(d["counts"]),
+                    "sum": float(d["sum"]),
+                    "count": int(d["count"]),
+                }
+            elif list(d["bounds"]) == out["bounds"]:
+                out["counts"] = [a + b for a, b in
+                                 zip(out["counts"], d["counts"])]
+                out["sum"] += float(d["sum"])
+                out["count"] += int(d["count"])
+        return out
+
+    @staticmethod
+    def render_into(lines: list, family: str, snap: dict | None,
+                    help_text: str = ""):
+        """Append one family's exposition block from a :meth:`to_dict`
+        snapshot (no-op when the snapshot is absent/malformed)."""
+        if not snap or "counts" not in snap:
+            return
+        if help_text:
+            lines.append(f"# HELP {family} {help_text}")
+        lines.append(f"# TYPE {family} histogram")
+        acc = 0
+        for bound, n in zip(snap["bounds"], snap["counts"]):
+            acc += n
+            lines.append(f'{family}_bucket{{le="{bound:g}"}} {acc}')
+        acc += snap["counts"][-1]
+        lines.append(f'{family}_bucket{{le="+Inf"}} {acc}')
+        lines.append(f"{family}_sum {snap['sum']:.6f}")
+        lines.append(f"{family}_count {snap['count']}")
+
+
 @dataclass
 class ServingMetrics:
     # named lock (ordering: ServingMetrics.lock is taken BEFORE any engine
@@ -72,6 +163,13 @@ class ServingMetrics:
     generation_tokens_total: int = 0
     ttft_s: _Reservoir = field(default_factory=_Reservoir)
     decode_tps: _Reservoir = field(default_factory=_Reservoir)
+    # bucketed TTFT (the reservoir stays for operator-facing quantiles in
+    # logs; the histogram is what aggregates across replicas and scrapes)
+    ttft_hist: Histogram = field(
+        default_factory=lambda: Histogram(
+            LATENCY_BUCKETS_S, "ServingMetrics.ttft_hist"
+        )
+    )
     # zero-arg callable returning the live ContinuousBatcher (or None) —
     # a callable so model hot-swaps can never leave a stale reference
     batcher_fn: object = None
@@ -102,6 +200,7 @@ class ServingMetrics:
             self.generation_tokens_total += generation_tokens
             if ttft_s > 0:
                 self.ttft_s.add(ttft_s)
+                self.ttft_hist.observe(ttft_s)
             if decode_tps > 0:
                 self.decode_tps.add(decode_tps)
 
@@ -122,327 +221,360 @@ class ServingMetrics:
                 f"mst_prompt_tokens_total {self.prompt_tokens_total}",
                 "# TYPE mst_generation_tokens_total counter",
                 f"mst_generation_tokens_total {self.generation_tokens_total}",
-                "# TYPE mst_ttft_seconds summary",
-                f'mst_ttft_seconds{{quantile="0.5"}} {self.ttft_s.percentile(50):.6f}',
-                f'mst_ttft_seconds{{quantile="0.95"}} {self.ttft_s.percentile(95):.6f}',
                 "# TYPE mst_decode_tokens_per_second summary",
                 f'mst_decode_tokens_per_second{{quantile="0.5"}} {self.decode_tps.percentile(50):.3f}',
                 f'mst_decode_tokens_per_second{{quantile="0.95"}} {self.decode_tps.percentile(95):.3f}',
             ]
-            b = self.batcher_fn() if self.batcher_fn is not None else None
-            if b is not None:
-                slots, active, queued = b.stats()
-                lines += [
-                    "# TYPE mst_batch_slots gauge",
-                    f"mst_batch_slots {slots}",
-                    "# TYPE mst_batch_slots_active gauge",
-                    f"mst_batch_slots_active {active}",
-                    "# TYPE mst_batch_queue_depth gauge",
-                    f"mst_batch_queue_depth {queued}",
-                ]
-                pages = getattr(b, "page_stats", lambda: None)()
-                if pages is not None:
-                    total, in_use, high = pages
+            # TTFT as a cumulative histogram (was a two-point summary):
+            # bucket counts sum across replicas; quantiles never did
+            Histogram.render_into(
+                lines, "mst_ttft_seconds", self.ttft_hist.to_dict()
+            )
+            # any engine accessor can die mid-scrape (replica torn
+            # down, pool closing); drop the whole engine section
+            # cleanly rather than 500 or emit a half-rendered family
+            mark = len(lines)
+            try:
+                b = self.batcher_fn() if self.batcher_fn is not None else None
+                if b is not None:
+                    slots, active, queued = b.stats()
                     lines += [
-                        "# TYPE mst_kv_pool_pages gauge",
-                        f"mst_kv_pool_pages {total}",
-                        "# TYPE mst_kv_pool_pages_in_use gauge",
-                        f"mst_kv_pool_pages_in_use {in_use}",
-                        "# TYPE mst_kv_pool_pages_high_water gauge",
-                        f"mst_kv_pool_pages_high_water {high}",
+                        "# TYPE mst_batch_slots gauge",
+                        f"mst_batch_slots {slots}",
+                        "# TYPE mst_batch_slots_active gauge",
+                        f"mst_batch_slots_active {active}",
+                        "# TYPE mst_batch_queue_depth gauge",
+                        f"mst_batch_queue_depth {queued}",
                     ]
-                if pages is not None and getattr(b, "overcommit", False):
-                    lines += [
-                        "# TYPE mst_preemptions_total counter",
-                        f"mst_preemptions_total {b.preemptions}",
-                    ]
-                spill = getattr(b, "spill_stats", lambda: None)()
-                if spill is not None:
-                    # KV migration story: how often memory pressure / drain
-                    # moved page blocks instead of discarding them, and how
-                    # much host DRAM the spill tier is holding
-                    lines += [
-                        "# TYPE mst_kv_spill_enabled gauge",
-                        f"mst_kv_spill_enabled {int(bool(spill['enabled']))}",
-                        "# TYPE mst_kv_spill_total counter",
-                        f"mst_kv_spill_total {spill['spills']}",
-                        "# TYPE mst_kv_spill_hits_total counter",
-                        f"mst_kv_spill_hits_total {spill['spill_hits']}",
-                        "# TYPE mst_kv_spill_fallbacks_total counter",
-                        f"mst_kv_spill_fallbacks_total "
-                        f"{spill['spill_fallbacks']}",
-                        "# TYPE mst_kv_spill_evictions_total counter",
-                        f"mst_kv_spill_evictions_total {spill['evictions']}",
-                        "# TYPE mst_kv_spill_bytes gauge",
-                        f"mst_kv_spill_bytes {spill['bytes_in_use']}",
-                        "# TYPE mst_kv_spill_budget_bytes gauge",
-                        f"mst_kv_spill_budget_bytes {spill['budget_bytes']}",
-                        "# TYPE mst_kv_migration_out_total counter",
-                        f"mst_kv_migration_out_total "
-                        f"{spill['migrations_out']}",
-                        "# TYPE mst_kv_migration_in_total counter",
-                        f"mst_kv_migration_in_total {spill['migrations_in']}",
-                        "# TYPE mst_kv_reprefill_tokens_total counter",
-                        f"mst_kv_reprefill_tokens_total "
-                        f"{spill['reprefill_tokens']}",
-                        # proactive residency: cold-policy activity, tier
-                        # lookup quality, and the overlapped-vs-demand
-                        # resume split (.get: ReplicaSet aggregation may
-                        # predate these keys)
-                        "# TYPE mst_kv_spill_cold_total counter",
-                        f"mst_kv_spill_cold_total "
-                        f"{spill.get('cold_spills', 0)}",
-                        "# TYPE mst_kv_spill_wakes_total counter",
-                        f"mst_kv_spill_wakes_total "
-                        f"{spill.get('cold_wakes', 0)}",
-                        "# TYPE mst_kv_spill_parked gauge",
-                        f"mst_kv_spill_parked {spill.get('parked', 0)}",
-                        "# TYPE mst_kv_spill_hit_rate gauge",
-                        f"mst_kv_spill_hit_rate "
-                        f"{spill.get('hit_rate', 0.0):.4f}",
-                        "# TYPE mst_kv_spill_rejects_total counter",
-                        f'mst_kv_spill_rejects_total{{reason="oversize"}} '
-                        f"{spill.get('rejects_oversize', 0)}",
-                        f'mst_kv_spill_rejects_total{{reason="closed"}} '
-                        f"{spill.get('rejects_closed', 0)}",
-                        "# TYPE mst_kv_prefetch_enabled gauge",
-                        f"mst_kv_prefetch_enabled "
-                        f"{int(bool(spill.get('prefetch_enabled', False)))}",
-                        "# TYPE mst_kv_prefetch_total counter",
-                        f"mst_kv_prefetch_total "
-                        f"{spill.get('prefetches', 0)}",
-                        "# TYPE mst_kv_prefetch_hits_total counter",
-                        f"mst_kv_prefetch_hits_total "
-                        f"{spill.get('prefetch_hits', 0)}",
-                        "# TYPE mst_kv_prefetch_demand_total counter",
-                        f"mst_kv_prefetch_demand_total "
-                        f"{spill.get('demand_imports', 0)}",
-                        "# TYPE mst_kv_prefetch_faults_total counter",
-                        f"mst_kv_prefetch_faults_total "
-                        f"{spill.get('prefetch_faults', 0)}",
-                    ]
-                    if "migrated_streams" in spill:
-                        # ReplicaSet-level: streams re-placed across
-                        # replicas after a drain or mid-stream crash
+                    pages = getattr(b, "page_stats", lambda: None)()
+                    if pages is not None:
+                        total, in_use, high = pages
                         lines += [
-                            "# TYPE mst_kv_migration_streams_total counter",
-                            f"mst_kv_migration_streams_total "
-                            f"{spill['migrated_streams']}",
+                            "# TYPE mst_kv_pool_pages gauge",
+                            f"mst_kv_pool_pages {total}",
+                            "# TYPE mst_kv_pool_pages_in_use gauge",
+                            f"mst_kv_pool_pages_in_use {in_use}",
+                            "# TYPE mst_kv_pool_pages_high_water gauge",
+                            f"mst_kv_pool_pages_high_water {high}",
                         ]
-                kv = getattr(b, "kv_read_stats", lambda: None)()
-                if kv is not None:
-                    path, last_tick, total_bytes = kv
-                    lines += [
-                        # 1 = ragged in-place paged attention, 0 = the
-                        # gather/scatter path — which kernel decode is on
-                        "# TYPE mst_paged_attention_ragged gauge",
-                        f"mst_paged_attention_ragged {int(path == 'ragged')}",
-                        "# TYPE mst_kv_bytes_read_last_tick gauge",
-                        f"mst_kv_bytes_read_last_tick {last_tick}",
-                        "# TYPE mst_kv_bytes_read_total counter",
-                        f"mst_kv_bytes_read_total {total_bytes}",
-                    ]
-                hbm = getattr(b, "hbm_bytes_per_token_stats", lambda: None)()
-                if hbm is not None:
-                    lines += [
-                        "# TYPE mst_decode_hbm_bytes_per_token gauge",
-                        'mst_decode_hbm_bytes_per_token{kind="weights"} '
-                        f"{hbm['weights']:.1f}",
-                        'mst_decode_hbm_bytes_per_token{kind="kv"} '
-                        f"{hbm['kv']:.1f}",
-                    ]
-                tick = getattr(b, "tick_timing_stats", lambda: None)()
-                if tick is not None:
-                    # which run-loop the batcher is on (1 = double-buffered
-                    # async pipeline, 0 = classic dispatch-then-harvest) and
-                    # where each tick's wall time went: blocked on the
-                    # harvest device_get vs. doing host-side scheduling work
-                    path = tick["path"]
-                    lines += [
-                        "# TYPE mst_sched_async gauge",
-                        f"mst_sched_async {int(path == 'async')}",
-                        "# TYPE mst_tick_host_ms gauge",
-                        f'mst_tick_host_ms{{path="{path}"}} '
-                        f"{tick['host_ms_last']:.3f}",
-                        "# TYPE mst_tick_device_blocked_ms gauge",
-                        f'mst_tick_device_blocked_ms{{path="{path}"}} '
-                        f"{tick['device_blocked_ms_last']:.3f}",
-                        # resume-path import stall: ~0 when prefetch staged
-                        # the pages, the full host→device marshal on demand
-                        f'mst_tick_device_blocked_ms{{path="kv_import"}} '
-                        f"{tick.get('kv_import_ms_last', 0.0):.3f}",
-                    ]
-                res = getattr(b, "resilience_stats", lambda: None)()
-                if res is not None:
-                    lines += [
-                        "# TYPE mst_requests_timeout_total counter",
-                        f"mst_requests_timeout_total {res['timeouts']}",
-                        # shed = rejected before any engine work was spent:
-                        # queue_full at admission (429), deadline while queued
-                        "# TYPE mst_requests_shed_total counter",
-                        f'mst_requests_shed_total{{reason="queue_full"}} '
-                        f"{res['shed_queue_full']}",
-                        f'mst_requests_shed_total{{reason="deadline"}} '
-                        f"{res['shed_deadline']}",
-                        "# TYPE mst_scheduler_thread_live gauge",
-                        "mst_scheduler_thread_live "
-                        f"{int(bool(res['scheduler_thread_live']))}",
-                    ]
-                    if res.get("max_queue") is not None:
+                    if pages is not None and getattr(b, "overcommit", False):
                         lines += [
-                            "# TYPE mst_max_queue gauge",
-                            f"mst_max_queue {res['max_queue']}",
+                            "# TYPE mst_preemptions_total counter",
+                            f"mst_preemptions_total {b.preemptions}",
                         ]
-                health = getattr(b, "health", lambda: None)()
-                if health is not None and "replicas_total" in health:
-                    lines += [
-                        "# TYPE mst_replicas_total gauge",
-                        f"mst_replicas_total {health['replicas_total']}",
-                        "# TYPE mst_replicas_live gauge",
-                        f"mst_replicas_live {health['replicas_live']}",
-                    ]
-                    lines.append("# TYPE mst_replica_breaker_open gauge")
-                    for rep in health["replicas"]:
+                    spill = getattr(b, "spill_stats", lambda: None)()
+                    if spill is not None:
+                        # KV migration story: how often memory pressure / drain
+                        # moved page blocks instead of discarding them, and how
+                        # much host DRAM the spill tier is holding
                         lines += [
-                            f'mst_replica_breaker_open{{replica="{rep["replica"]}"}} '
-                            f"{int(rep['breaker'] != 'closed')}",
+                            "# TYPE mst_kv_spill_enabled gauge",
+                            f"mst_kv_spill_enabled {int(bool(spill['enabled']))}",
+                            "# TYPE mst_kv_spill_total counter",
+                            f"mst_kv_spill_total {spill['spills']}",
+                            "# TYPE mst_kv_spill_hits_total counter",
+                            f"mst_kv_spill_hits_total {spill['spill_hits']}",
+                            "# TYPE mst_kv_spill_fallbacks_total counter",
+                            f"mst_kv_spill_fallbacks_total "
+                            f"{spill['spill_fallbacks']}",
+                            "# TYPE mst_kv_spill_evictions_total counter",
+                            f"mst_kv_spill_evictions_total {spill['evictions']}",
+                            "# TYPE mst_kv_spill_bytes gauge",
+                            f"mst_kv_spill_bytes {spill['bytes_in_use']}",
+                            "# TYPE mst_kv_spill_budget_bytes gauge",
+                            f"mst_kv_spill_budget_bytes {spill['budget_bytes']}",
+                            "# TYPE mst_kv_migration_out_total counter",
+                            f"mst_kv_migration_out_total "
+                            f"{spill['migrations_out']}",
+                            "# TYPE mst_kv_migration_in_total counter",
+                            f"mst_kv_migration_in_total {spill['migrations_in']}",
+                            "# TYPE mst_kv_reprefill_tokens_total counter",
+                            f"mst_kv_reprefill_tokens_total "
+                            f"{spill['reprefill_tokens']}",
+                            # proactive residency: cold-policy activity, tier
+                            # lookup quality, and the overlapped-vs-demand
+                            # resume split (.get: ReplicaSet aggregation may
+                            # predate these keys)
+                            "# TYPE mst_kv_spill_cold_total counter",
+                            f"mst_kv_spill_cold_total "
+                            f"{spill.get('cold_spills', 0)}",
+                            "# TYPE mst_kv_spill_wakes_total counter",
+                            f"mst_kv_spill_wakes_total "
+                            f"{spill.get('cold_wakes', 0)}",
+                            "# TYPE mst_kv_spill_parked gauge",
+                            f"mst_kv_spill_parked {spill.get('parked', 0)}",
+                            "# TYPE mst_kv_spill_hit_rate gauge",
+                            f"mst_kv_spill_hit_rate "
+                            f"{spill.get('hit_rate', 0.0):.4f}",
+                            "# TYPE mst_kv_spill_rejects_total counter",
+                            f'mst_kv_spill_rejects_total{{reason="oversize"}} '
+                            f"{spill.get('rejects_oversize', 0)}",
+                            f'mst_kv_spill_rejects_total{{reason="closed"}} '
+                            f"{spill.get('rejects_closed', 0)}",
+                            "# TYPE mst_kv_prefetch_enabled gauge",
+                            f"mst_kv_prefetch_enabled "
+                            f"{int(bool(spill.get('prefetch_enabled', False)))}",
+                            "# TYPE mst_kv_prefetch_total counter",
+                            f"mst_kv_prefetch_total "
+                            f"{spill.get('prefetches', 0)}",
+                            "# TYPE mst_kv_prefetch_hits_total counter",
+                            f"mst_kv_prefetch_hits_total "
+                            f"{spill.get('prefetch_hits', 0)}",
+                            "# TYPE mst_kv_prefetch_demand_total counter",
+                            f"mst_kv_prefetch_demand_total "
+                            f"{spill.get('demand_imports', 0)}",
+                            "# TYPE mst_kv_prefetch_faults_total counter",
+                            f"mst_kv_prefetch_faults_total "
+                            f"{spill.get('prefetch_faults', 0)}",
                         ]
-                    lines.append("# TYPE mst_replica_failures_total counter")
-                    for rep in health["replicas"]:
+                        if "migrated_streams" in spill:
+                            # ReplicaSet-level: streams re-placed across
+                            # replicas after a drain or mid-stream crash
+                            lines += [
+                                "# TYPE mst_kv_migration_streams_total counter",
+                                f"mst_kv_migration_streams_total "
+                                f"{spill['migrated_streams']}",
+                            ]
+                    kv = getattr(b, "kv_read_stats", lambda: None)()
+                    if kv is not None:
+                        path, last_tick, total_bytes = kv
                         lines += [
-                            f'mst_replica_failures_total{{replica="{rep["replica"]}"}} '
-                            f"{rep['failures']}",
+                            # 1 = ragged in-place paged attention, 0 = the
+                            # gather/scatter path — which kernel decode is on
+                            "# TYPE mst_paged_attention_ragged gauge",
+                            f"mst_paged_attention_ragged {int(path == 'ragged')}",
+                            "# TYPE mst_kv_bytes_read_last_tick gauge",
+                            f"mst_kv_bytes_read_last_tick {last_tick}",
+                            "# TYPE mst_kv_bytes_read_total counter",
+                            f"mst_kv_bytes_read_total {total_bytes}",
                         ]
-                # per-replica routing load + fleet elasticity (replicas.py /
-                # fleet.py); breaker_state: 0 closed, 1 half-open, 2 open
-                per_rep = getattr(b, "replica_stats", lambda: None)()
-                if per_rep is not None:
-                    # disaggregated pools tag entries with a role; indices
-                    # repeat across pools, so the role label is what keeps
-                    # the gauge lines distinct (monolithic sets stay
-                    # unlabeled — role is None there)
-                    def _rl(rep):
-                        role = rep.get("role")
-                        return (
-                            f'replica="{rep["replica"]}",role="{role}"'
-                            if role else f'replica="{rep["replica"]}"'
+                    hbm = getattr(b, "hbm_bytes_per_token_stats", lambda: None)()
+                    if hbm is not None:
+                        lines += [
+                            "# TYPE mst_decode_hbm_bytes_per_token gauge",
+                            'mst_decode_hbm_bytes_per_token{kind="weights"} '
+                            f"{hbm['weights']:.1f}",
+                            'mst_decode_hbm_bytes_per_token{kind="kv"} '
+                            f"{hbm['kv']:.1f}",
+                        ]
+                    lat = getattr(b, "latency_stats", lambda: None)()
+                    if lat is not None:
+                        # scheduler-side per-token latency: inter-token gaps
+                        # from the emit path, queue wait from submit→slot.
+                        # Histograms so ReplicaSet/Disagg merges stay exact.
+                        Histogram.render_into(
+                            lines, "mst_itl_seconds", lat.get("itl")
                         )
-                    lines.append("# TYPE mst_replica_inflight gauge")
-                    for rep in per_rep:
-                        lines.append(
-                            f"mst_replica_inflight{{{_rl(rep)}}} "
-                            f"{rep['inflight']}"
+                        Histogram.render_into(
+                            lines, "mst_queue_wait_seconds",
+                            lat.get("queue_wait")
                         )
-                    lines.append("# TYPE mst_replica_queue_depth gauge")
-                    for rep in per_rep:
-                        lines.append(
-                            f"mst_replica_queue_depth{{{_rl(rep)}}} "
-                            f"{rep['queue_depth']}"
-                        )
-                    lines.append("# TYPE mst_replica_breaker_state gauge")
-                    for rep in per_rep:
-                        lines.append(
-                            f"mst_replica_breaker_state{{{_rl(rep)}}} "
-                            f"{rep['breaker_state']}"
-                        )
-                    # 1 = this replica aliases the host's resident weight
-                    # tree (weights.WeightStore), 0 = private upload
-                    lines.append("# TYPE mst_replica_weights_shared gauge")
-                    for rep in per_rep:
-                        lines.append(
-                            f"mst_replica_weights_shared{{{_rl(rep)}}} "
-                            f"{int(bool(rep.get('weights_shared')))}"
-                        )
-                fleet = getattr(b, "fleet_stats", lambda: None)()
-                if fleet is not None:
-                    lines += [
-                        "# TYPE mst_fleet_size gauge",
-                        f"mst_fleet_size {fleet['size']}",
-                    ]
-                    for pool in fleet.get("pools", []):
-                        # per-role pool sizes under the disagg coordinator
-                        if pool.get("role"):
-                            lines.append(
-                                f'mst_fleet_size{{role="{pool["role"]}"}} '
-                                f"{pool['size']}"
+                    tick = getattr(b, "tick_timing_stats", lambda: None)()
+                    if tick is not None:
+                        # which run-loop the batcher is on (1 = double-buffered
+                        # async pipeline, 0 = classic dispatch-then-harvest) and
+                        # where each tick's wall time went: blocked on the
+                        # harvest device_get vs. doing host-side scheduling work
+                        path = tick["path"]
+                        lines += [
+                            "# TYPE mst_sched_async gauge",
+                            f"mst_sched_async {int(path == 'async')}",
+                            "# TYPE mst_tick_host_ms gauge",
+                            f'mst_tick_host_ms{{path="{path}"}} '
+                            f"{tick['host_ms_last']:.3f}",
+                            "# TYPE mst_tick_device_blocked_ms gauge",
+                            f'mst_tick_device_blocked_ms{{path="{path}"}} '
+                            f"{tick['device_blocked_ms_last']:.3f}",
+                            # resume-path import stall: ~0 when prefetch staged
+                            # the pages, the full host→device marshal on demand
+                            f'mst_tick_device_blocked_ms{{path="kv_import"}} '
+                            f"{tick.get('kv_import_ms_last', 0.0):.3f}",
+                        ]
+                    res = getattr(b, "resilience_stats", lambda: None)()
+                    if res is not None:
+                        lines += [
+                            "# TYPE mst_requests_timeout_total counter",
+                            f"mst_requests_timeout_total {res['timeouts']}",
+                            # shed = rejected before any engine work was spent:
+                            # queue_full at admission (429), deadline while queued
+                            "# TYPE mst_requests_shed_total counter",
+                            f'mst_requests_shed_total{{reason="queue_full"}} '
+                            f"{res['shed_queue_full']}",
+                            f'mst_requests_shed_total{{reason="deadline"}} '
+                            f"{res['shed_deadline']}",
+                            "# TYPE mst_scheduler_thread_live gauge",
+                            "mst_scheduler_thread_live "
+                            f"{int(bool(res['scheduler_thread_live']))}",
+                        ]
+                        if res.get("max_queue") is not None:
+                            lines += [
+                                "# TYPE mst_max_queue gauge",
+                                f"mst_max_queue {res['max_queue']}",
+                            ]
+                    health = getattr(b, "health", lambda: None)()
+                    if health is not None and "replicas_total" in health:
+                        lines += [
+                            "# TYPE mst_replicas_total gauge",
+                            f"mst_replicas_total {health['replicas_total']}",
+                            "# TYPE mst_replicas_live gauge",
+                            f"mst_replicas_live {health['replicas_live']}",
+                        ]
+                        lines.append("# TYPE mst_replica_breaker_open gauge")
+                        for rep in health["replicas"]:
+                            lines += [
+                                f'mst_replica_breaker_open{{replica="{rep["replica"]}"}} '
+                                f"{int(rep['breaker'] != 'closed')}",
+                            ]
+                        lines.append("# TYPE mst_replica_failures_total counter")
+                        for rep in health["replicas"]:
+                            lines += [
+                                f'mst_replica_failures_total{{replica="{rep["replica"]}"}} '
+                                f"{rep['failures']}",
+                            ]
+                    # per-replica routing load + fleet elasticity (replicas.py /
+                    # fleet.py); breaker_state: 0 closed, 1 half-open, 2 open
+                    per_rep = getattr(b, "replica_stats", lambda: None)()
+                    if per_rep is not None:
+                        # disaggregated pools tag entries with a role; indices
+                        # repeat across pools, so the role label is what keeps
+                        # the gauge lines distinct (monolithic sets stay
+                        # unlabeled — role is None there)
+                        def _rl(rep):
+                            role = rep.get("role")
+                            return (
+                                f'replica="{rep["replica"]}",role="{role}"'
+                                if role else f'replica="{rep["replica"]}"'
                             )
-                    lines += [
-                        "# TYPE mst_autoscale_events_total counter",
-                    ]
-                    for kind in sorted(fleet.get("autoscale_events", {})):
-                        lines.append(
-                            f'mst_autoscale_events_total{{kind="{kind}"}} '
-                            f"{fleet['autoscale_events'][kind]}"
-                        )
-                    if "sticky_hits" in fleet:
+                        lines.append("# TYPE mst_replica_inflight gauge")
+                        for rep in per_rep:
+                            lines.append(
+                                f"mst_replica_inflight{{{_rl(rep)}}} "
+                                f"{rep['inflight']}"
+                            )
+                        lines.append("# TYPE mst_replica_queue_depth gauge")
+                        for rep in per_rep:
+                            lines.append(
+                                f"mst_replica_queue_depth{{{_rl(rep)}}} "
+                                f"{rep['queue_depth']}"
+                            )
+                        lines.append("# TYPE mst_replica_breaker_state gauge")
+                        for rep in per_rep:
+                            lines.append(
+                                f"mst_replica_breaker_state{{{_rl(rep)}}} "
+                                f"{rep['breaker_state']}"
+                            )
+                        # 1 = this replica aliases the host's resident weight
+                        # tree (weights.WeightStore), 0 = private upload
+                        lines.append("# TYPE mst_replica_weights_shared gauge")
+                        for rep in per_rep:
+                            lines.append(
+                                f"mst_replica_weights_shared{{{_rl(rep)}}} "
+                                f"{int(bool(rep.get('weights_shared')))}"
+                            )
+                    fleet = getattr(b, "fleet_stats", lambda: None)()
+                    if fleet is not None:
                         lines += [
-                            "# TYPE mst_route_sticky_hits_total counter",
-                            f"mst_route_sticky_hits_total "
-                            f"{fleet['sticky_hits']}",
-                            "# TYPE mst_route_affinity_hits_total counter",
-                            f"mst_route_affinity_hits_total "
-                            f"{fleet['affinity_hits']}",
+                            "# TYPE mst_fleet_size gauge",
+                            f"mst_fleet_size {fleet['size']}",
                         ]
-                    if "store_hits" in fleet:
-                        # routed to the replica already holding the prefix
-                        # resident in the fleet-wide store
+                        for pool in fleet.get("pools", []):
+                            # per-role pool sizes under the disagg coordinator
+                            if pool.get("role"):
+                                lines.append(
+                                    f'mst_fleet_size{{role="{pool["role"]}"}} '
+                                    f"{pool['size']}"
+                                )
                         lines += [
-                            "# TYPE mst_route_store_hits_total counter",
-                            f"mst_route_store_hits_total "
-                            f"{fleet['store_hits']}",
+                            "# TYPE mst_autoscale_events_total counter",
                         ]
-                hand = getattr(b, "handoff_stats", lambda: None)()
-                if hand is not None:
-                    # disaggregated serving: prefill→decode KV handoffs —
-                    # volume, shipped bytes, DMA+control latency, and how
-                    # often the degradation ladder fired (by kind)
-                    lines += [
-                        "# TYPE mst_disagg_handoff_total counter",
-                        f"mst_disagg_handoff_total {hand['handoffs']}",
-                        "# TYPE mst_disagg_handoff_bytes_total counter",
-                        f"mst_disagg_handoff_bytes_total "
-                        f"{hand['bytes_total']}",
-                        "# TYPE mst_disagg_handoff_ms summary",
-                        'mst_disagg_handoff_ms{quantile="0.5"} '
-                        f"{hand['ms_p50'] or 0.0:.3f}",
-                        'mst_disagg_handoff_ms{quantile="0.99"} '
-                        f"{hand['ms_p99'] or 0.0:.3f}",
-                        "# TYPE mst_disagg_fallbacks_total counter",
-                    ]
-                    for kind in sorted(hand.get("fallbacks", {})):
-                        lines.append(
-                            f'mst_disagg_fallbacks_total{{kind="{kind}"}} '
-                            f"{hand['fallbacks'][kind]}"
-                        )
-                    if "store_skips" in hand:
-                        # full-prefix store hits that skipped the prefill
-                        # pool entirely (no phase-1 dispatch, no handoff)
+                        for kind in sorted(fleet.get("autoscale_events", {})):
+                            lines.append(
+                                f'mst_autoscale_events_total{{kind="{kind}"}} '
+                                f"{fleet['autoscale_events'][kind]}"
+                            )
+                        if "sticky_hits" in fleet:
+                            lines += [
+                                "# TYPE mst_route_sticky_hits_total counter",
+                                f"mst_route_sticky_hits_total "
+                                f"{fleet['sticky_hits']}",
+                                "# TYPE mst_route_affinity_hits_total counter",
+                                f"mst_route_affinity_hits_total "
+                                f"{fleet['affinity_hits']}",
+                            ]
+                        if "store_hits" in fleet:
+                            # routed to the replica already holding the prefix
+                            # resident in the fleet-wide store
+                            lines += [
+                                "# TYPE mst_route_store_hits_total counter",
+                                f"mst_route_store_hits_total "
+                                f"{fleet['store_hits']}",
+                            ]
+                    hand = getattr(b, "handoff_stats", lambda: None)()
+                    if hand is not None:
+                        # disaggregated serving: prefill→decode KV handoffs —
+                        # volume, shipped bytes, DMA+control latency, and how
+                        # often the degradation ladder fired (by kind)
                         lines += [
-                            "# TYPE mst_disagg_store_skips_total counter",
-                            f"mst_disagg_store_skips_total "
-                            f"{hand['store_skips']}",
+                            "# TYPE mst_disagg_handoff_total counter",
+                            f"mst_disagg_handoff_total {hand['handoffs']}",
+                            "# TYPE mst_disagg_handoff_bytes_total counter",
+                            f"mst_disagg_handoff_bytes_total "
+                            f"{hand['bytes_total']}",
                         ]
-                bro = getattr(b, "brownout", None)
-                if bro is not None:
-                    lines += [
-                        "# TYPE mst_brownout_level gauge",
-                        f"mst_brownout_level {bro.level()}",
-                    ]
-                prefix = getattr(b, "prefix_stats", lambda: None)()
-                if prefix is not None:
-                    queries, hits, reused, evictions, cached = prefix
-                    lines += [
-                        "# TYPE mst_prefix_cache_queries_total counter",
-                        f"mst_prefix_cache_queries_total {queries}",
-                        "# TYPE mst_prefix_cache_hits_total counter",
-                        f"mst_prefix_cache_hits_total {hits}",
-                        "# TYPE mst_prefix_cache_tokens_reused_total counter",
-                        f"mst_prefix_cache_tokens_reused_total {reused}",
-                        "# TYPE mst_prefix_cache_evictions_total counter",
-                        f"mst_prefix_cache_evictions_total {evictions}",
-                        "# TYPE mst_prefix_cache_pages gauge",
-                        f"mst_prefix_cache_pages {cached}",
-                    ]
+                        if hand.get("ms_hist"):
+                            # handoff latency as a histogram (bucket counts
+                            # aggregate across coordinators and scrapes)
+                            Histogram.render_into(
+                                lines, "mst_disagg_handoff_ms", hand["ms_hist"]
+                            )
+                        else:
+                            # a pre-histogram aggregation: keep the summary
+                            lines += [
+                                "# TYPE mst_disagg_handoff_ms summary",
+                                'mst_disagg_handoff_ms{quantile="0.5"} '
+                                f"{hand.get('ms_p50') or 0.0:.3f}",
+                                'mst_disagg_handoff_ms{quantile="0.99"} '
+                                f"{hand.get('ms_p99') or 0.0:.3f}",
+                            ]
+                        lines += [
+                            "# TYPE mst_disagg_fallbacks_total counter",
+                        ]
+                        for kind in sorted(hand.get("fallbacks", {})):
+                            lines.append(
+                                f'mst_disagg_fallbacks_total{{kind="{kind}"}} '
+                                f"{hand['fallbacks'][kind]}"
+                            )
+                        if "store_skips" in hand:
+                            # full-prefix store hits that skipped the prefill
+                            # pool entirely (no phase-1 dispatch, no handoff)
+                            lines += [
+                                "# TYPE mst_disagg_store_skips_total counter",
+                                f"mst_disagg_store_skips_total "
+                                f"{hand['store_skips']}",
+                            ]
+                    bro = getattr(b, "brownout", None)
+                    if bro is not None:
+                        lines += [
+                            "# TYPE mst_brownout_level gauge",
+                            f"mst_brownout_level {bro.level()}",
+                        ]
+                    prefix = getattr(b, "prefix_stats", lambda: None)()
+                    if prefix is not None:
+                        queries, hits, reused, evictions, cached = prefix
+                        lines += [
+                            "# TYPE mst_prefix_cache_queries_total counter",
+                            f"mst_prefix_cache_queries_total {queries}",
+                            "# TYPE mst_prefix_cache_hits_total counter",
+                            f"mst_prefix_cache_hits_total {hits}",
+                            "# TYPE mst_prefix_cache_tokens_reused_total counter",
+                            f"mst_prefix_cache_tokens_reused_total {reused}",
+                            "# TYPE mst_prefix_cache_evictions_total counter",
+                            f"mst_prefix_cache_evictions_total {evictions}",
+                            "# TYPE mst_prefix_cache_pages gauge",
+                            f"mst_prefix_cache_pages {cached}",
+                        ]
+            except Exception:  # noqa: BLE001 — scrapes must never 500
+                del lines[mark:]
             spec = self.spec_fn() if self.spec_fn is not None else None
             if spec is not None:
                 # accepted/round ∈ [1, spec_k]: the draft-quality dial the
@@ -565,4 +697,82 @@ class ServingMetrics:
                     f'mst_prefix_store_faults_total{{kind="import"}} '
                     f"{pstats['import_faults']}",
                 ]
-        return "\n".join(lines) + "\n"
+        return "\n".join(_finalize(lines)) + "\n"
+
+
+# explicit HELP strings for the families whose meaning is not readable off
+# the name; everything else gets a generated one-liner (coverage contract:
+# EVERY emitted family carries # HELP and # TYPE — test_metrics_help_type)
+_HELP = {
+    "mst_requests_total": "Requests served (including failures).",
+    "mst_requests_failed_total": "Requests that ended in an error.",
+    "mst_ttft_seconds": "Time to first token, seconds (histogram).",
+    "mst_itl_seconds":
+        "Inter-token latency from the scheduler emit path, seconds.",
+    "mst_queue_wait_seconds":
+        "Admission queue wait, submit to slot assignment, seconds.",
+    "mst_disagg_handoff_ms":
+        "Prefill-to-decode KV handoff latency, milliseconds.",
+    "mst_decode_tokens_per_second": "Per-request decode rate summary.",
+    "mst_tick_host_ms": "Host-side scheduler work per tick, ms.",
+    "mst_tick_device_blocked_ms":
+        "Per-tick wall time blocked on the device, ms.",
+}
+
+
+def _help_text(family: str) -> str:
+    return _HELP.get(
+        family, family.removeprefix("mst_").replace("_", " ") + "."
+    )
+
+
+def _infer_type(family: str) -> str:
+    return "counter" if family.endswith("_total") else "gauge"
+
+
+def _family_of(sample: str, histograms: set) -> str:
+    name = sample.split("{", 1)[0].split(" ", 1)[0]
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and name[: -len(suffix)] in histograms:
+            return name[: -len(suffix)]
+    return name
+
+
+def _finalize(lines: list) -> list:
+    """Exposition post-pass: every family gets a ``# HELP`` ahead of its
+    ``# TYPE``, and any sample whose family never declared a ``# TYPE``
+    (ad-hoc gauges added over ten PRs) gets both synthesized in front of
+    its first sample. Keeps the per-block rendering code append-only."""
+    typed = set()
+    histograms = set()
+    for ln in lines:
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            typed.add(parts[2])
+            if parts[3] == "histogram":
+                histograms.add(parts[2])
+    out: list = []
+    helped: set = set()
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            helped.add(ln.split()[2])
+            out.append(ln)
+            continue
+        if ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            if fam not in helped:
+                out.append(f"# HELP {fam} {_help_text(fam)}")
+                helped.add(fam)
+            out.append(ln)
+            continue
+        if not ln or ln.startswith("#"):
+            out.append(ln)
+            continue
+        fam = _family_of(ln, histograms)
+        if fam not in typed:
+            out.append(f"# HELP {fam} {_help_text(fam)}")
+            out.append(f"# TYPE {fam} {_infer_type(fam)}")
+            helped.add(fam)
+            typed.add(fam)
+        out.append(ln)
+    return out

@@ -1,0 +1,17 @@
+"""MST112 fixture: span construction on a tick-hot path outside the
+tracing no-op guard — the `tr.add` on line 11 runs its marshalling on
+every decode block even with --trace off."""
+import time
+
+
+# mst: hot-path
+def _decode_once(req):
+    tr = req._trace
+    _work(req)
+    tr.add("decode_tick", 0.0, time.perf_counter())
+    if tr is not None:
+        tr.point("guarded")  # clean: behind the no-op check
+
+
+def _work(req):
+    pass

@@ -329,7 +329,11 @@ def test_metrics_render_role_labels_and_handoff_counters(disagg_setup):
     assert 'mst_replica_inflight{replica="0",role="decode"} 0' in text
     assert "mst_disagg_handoff_total " in text
     assert "mst_disagg_handoff_bytes_total " in text
-    assert 'mst_disagg_handoff_ms{quantile="0.5"}' in text
+    # cumulative histogram form (the windowed quantile summary was
+    # superseded by Histogram in the coordinator's handoff_stats)
+    assert 'mst_disagg_handoff_ms_bucket{le="' in text
+    assert "mst_disagg_handoff_ms_sum " in text
+    assert "mst_disagg_handoff_ms_count " in text
     assert 'mst_disagg_fallbacks_total{kind="handoff_fault"} ' in text
 
 

@@ -209,6 +209,172 @@ def test_metrics_expose_kv_residency_and_prefetch():
     assert 'mst_tick_device_blocked_ms{path="kv_import"} 0.000' in text
 
 
+def _rich_metrics():
+    """A ServingMetrics wired with every accessor the renderer reads,
+    all returning data — the widest exposition we can produce offline."""
+    from mlx_sharding_tpu.prefix_store import PrefixStore
+    from mlx_sharding_tpu.utils.observability import (
+        HANDOFF_BUCKETS_MS, ITL_BUCKETS_S, LATENCY_BUCKETS_S, Histogram,
+        ServingMetrics,
+    )
+
+    itl = Histogram(ITL_BUCKETS_S)
+    itl.observe(0.01)
+    qw = Histogram(LATENCY_BUCKETS_S)
+    qw.observe(0.2)
+    hand = Histogram(HANDOFF_BUCKETS_MS)
+    hand.observe(3.0)
+
+    class _Batcher:
+        def stats(self):
+            return (2, 1, 3)
+
+        def tick_timing_stats(self):
+            return {"path": "async", "host_ms_last": 1.0,
+                    "device_blocked_ms_last": 0.5, "host_ms_avg": 1.0,
+                    "device_blocked_ms_avg": 0.5, "ticks": 3,
+                    "kv_import_ms_last": 2.0}
+
+        def spill_stats(self):
+            return {"enabled": True, "spills": 4, "spill_hits": 3,
+                    "spill_fallbacks": 1, "evictions": 0,
+                    "bytes_in_use": 1024, "budget_bytes": 4096,
+                    "migrations_out": 1, "migrations_in": 1,
+                    "reprefill_tokens": 7, "cold_spills": 5,
+                    "cold_wakes": 4, "parked": 2, "hit_rate": 0.875,
+                    "rejects_oversize": 1, "rejects_closed": 2,
+                    "prefetch_enabled": True, "prefetches": 4,
+                    "prefetch_hits": 3, "demand_imports": 1,
+                    "prefetch_faults": 1}
+
+        def latency_stats(self):
+            return {"itl": itl.to_dict(), "queue_wait": qw.to_dict()}
+
+        def fleet_stats(self):
+            return {"size": 2, "sticky_hits": 1, "affinity_hits": 2,
+                    "store_hits": 3}
+
+        def handoff_stats(self):
+            return {"handoffs": 4, "bytes_total": 100, "ms_p50": 1.0,
+                    "ms_p99": 2.0, "fallbacks": {"handoff_fault": 1},
+                    "store_skips": 5, "ms_hist": hand.to_dict()}
+
+    store = PrefixStore(host_bytes=1 << 20)
+    m = ServingMetrics(batcher_fn=lambda: _Batcher(),
+                       prefix_store_fn=lambda: store)
+    m.record_request(prompt_tokens=10, generation_tokens=20, ttft_s=0.5,
+                     decode_tps=40.0)
+    m.record_failure()
+    return m, store
+
+
+def test_metrics_help_type():
+    """Exposition coverage contract: EVERY sample family in the widest
+    render carries ``# HELP`` and ``# TYPE`` ahead of its first sample,
+    histogram suffixes (_bucket/_sum/_count) resolve to a family declared
+    ``histogram``, and the latency families render as real cumulative
+    histograms."""
+    m, store = _rich_metrics()
+    try:
+        text = m.render()
+    finally:
+        store.close()
+    helped, typed, hist = set(), {}, set()
+    for ln in text.splitlines():
+        if ln.startswith("# HELP "):
+            helped.add(ln.split()[2])
+            continue
+        if ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            assert fam in helped, f"# TYPE {fam} without a preceding # HELP"
+            assert fam not in typed, f"duplicate # TYPE for {fam}"
+            typed[fam] = ln.split()[3]
+            if typed[fam] == "histogram":
+                hist.add(fam)
+            continue
+        if not ln or ln.startswith("#"):
+            continue
+        name = ln.split("{", 1)[0].split(" ", 1)[0]
+        fam = name
+        for sfx in ("_bucket", "_sum", "_count"):
+            if name.endswith(sfx) and name[: -len(sfx)] in hist:
+                fam = name[: -len(sfx)]
+        assert fam in typed, f"sample {name} has no # TYPE"
+        assert fam in helped, f"sample {name} has no # HELP"
+    # the histogram-grade latency families are really histograms
+    for fam in ("mst_ttft_seconds", "mst_itl_seconds",
+                "mst_queue_wait_seconds", "mst_disagg_handoff_ms"):
+        assert typed.get(fam) == "histogram", f"{fam} should be a histogram"
+        assert f'{fam}_bucket{{le="+Inf"}}' in text
+        assert f"{fam}_sum " in text and f"{fam}_count " in text
+    # counters follow the Prometheus naming/type convention
+    for fam, ty in typed.items():
+        if fam.endswith("_total"):
+            assert ty == "counter", f"{fam} typed {ty}, want counter"
+
+
+def test_metrics_render_never_500():
+    """Every accessor raising at scrape time still yields a parseable
+    exposition with the core request counters — a sick engine must not
+    take down the monitoring that would diagnose it."""
+    from mlx_sharding_tpu.utils.observability import ServingMetrics
+
+    def _boom():
+        raise RuntimeError("accessor gone")
+
+    class _BrokenBatcher:
+        def __getattr__(self, name):
+            def method(*a, **kw):
+                raise RuntimeError("batcher gone")
+            return method
+
+    for m in (
+        ServingMetrics(batcher_fn=_boom, prefix_store_fn=_boom),
+        ServingMetrics(batcher_fn=lambda: _BrokenBatcher()),
+    ):
+        m.record_request(prompt_tokens=1, generation_tokens=1, ttft_s=0.1,
+                         decode_tps=1.0)
+        text = m.render()
+        assert "mst_requests_total 1" in text
+
+
+def test_metrics_expose_itl_and_queue_wait_histograms():
+    """The scheduler's latency_stats() contract flows to /metrics as
+    cumulative bucketed histograms; a batcher without the accessor (or a
+    fleet with nothing recorded) emits neither family."""
+    from mlx_sharding_tpu.utils.observability import (
+        ITL_BUCKETS_S, LATENCY_BUCKETS_S, Histogram, ServingMetrics,
+    )
+
+    itl = Histogram(ITL_BUCKETS_S)
+    for v in (0.004, 0.009, 2.0):
+        itl.observe(v)
+    qw = Histogram(LATENCY_BUCKETS_S)
+    qw.observe(0.03)
+
+    class _B:
+        def stats(self):
+            return (2, 1, 0)
+
+        def latency_stats(self):
+            return {"itl": itl.to_dict(), "queue_wait": qw.to_dict()}
+
+    text = ServingMetrics(batcher_fn=lambda: _B()).render()
+    assert 'mst_itl_seconds_bucket{le="0.005"} 1' in text
+    assert 'mst_itl_seconds_bucket{le="+Inf"} 3' in text
+    assert "mst_itl_seconds_count 3" in text
+    assert 'mst_queue_wait_seconds_bucket{le="' in text
+    assert "mst_queue_wait_seconds_count 1" in text
+
+    class _NoLat:
+        def stats(self):
+            return (2, 1, 0)
+
+    text = ServingMetrics(batcher_fn=lambda: _NoLat()).render()
+    assert "mst_itl_seconds" not in text
+    assert "mst_queue_wait_seconds" not in text
+
+
 def test_metrics_expose_prefix_store():
     """/metrics reports the fleet-wide prefix store family — residency by
     tier, lookup quality, COW forks, insertion damping, eviction reasons —

@@ -51,6 +51,7 @@ EXPECTED = {
     "mst302_alloc_leak.py": ("MST302", 11, 12),
     "mst303_unknown_fault_site.py": ("MST303", 6, 4),
     "mst304/scheduler.py": ("MST304", 1, 0),
+    "mst112_trace_hot_path.py": ("MST112", 11, 4),
 }
 
 
