@@ -1,0 +1,406 @@
+"""Per-function control-flow graphs for the MST40x lifecycle verifier.
+
+Builds a statement-level CFG from the Python AST with the edges that
+matter for must-release analysis:
+
+- branches (``if``/``while``/``for`` tests carry ``true``/``false`` edge
+  kinds so the interpreter can refine ``x is None`` checks per arm);
+- loops (back edges; bodies are traversed 0 or 1 times by the path
+  enumerator — a bounded unrolling that catches acquire/release pairing
+  without fixpoint iteration);
+- ``try``/``except``/``finally`` with real unwind semantics: exception
+  edges from raising statements dispatch to handler entries; ``finally``
+  bodies are *inlined* per abrupt exit (return / raise / break /
+  continue / fall-through each get their own instantiation, exactly like
+  the bytecode compiler duplicates FINALLY blocks), so a release inside a
+  ``finally`` is visible on every path that crosses it;
+- ``with`` blocks as try/finally sugar: a synthetic ``with_exit`` node
+  releases the ``as`` target on every exit path, including unwinds;
+- ``return``/``raise`` edges to the function's normal/exceptional exits;
+- generator semantics: every ``yield`` gets a ``genexit`` edge — the
+  consumer may ``close()`` the generator there, raising ``GeneratorExit``
+  at the yield point, which only bare / ``BaseException`` /
+  ``GeneratorExit`` handlers (or a ``finally``) intercept.
+
+Nodes hold references to the original AST statements; the same AST node
+may back several CFG nodes (finally inlining). The graph is pure
+structure — which calls can raise is the caller's policy, injected via
+the ``may_raise`` predicate.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+# edge kinds
+NEXT = "next"          # sequential flow
+TRUE = "true"          # branch taken
+FALSE = "false"        # branch not taken
+EXC = "exc"            # exception unwind
+GENEXIT = "genexit"    # GeneratorExit raised at a yield
+BACK = "back"          # loop back edge
+
+
+@dataclass
+class Node:
+    idx: int
+    kind: str            # "entry","exit","raise","stmt","branch","loop",
+    #                      "with_exit","dispatch","yield"
+    stmt: Optional[ast.AST] = None   # backing AST node (stmt or expr)
+    line: int = 0
+    succ: list = field(default_factory=list)   # [(dst_idx, edge_kind)]
+
+    def __repr__(self):  # debugging aid only
+        return f"<{self.idx}:{self.kind}@{self.line}>"
+
+
+@dataclass
+class CFG:
+    nodes: list
+    entry: int
+    exit: int          # normal exit (fall-off / return)
+    raise_exit: int    # exception leaves the function
+    is_generator: bool = False
+
+
+@dataclass
+class _Frame:
+    kind: str                       # "try" | "finally" | "with" | "loop"
+    # try:
+    dispatch: Optional[int] = None  # exception dispatch node
+    catches_all: bool = False       # bare / BaseException / Exception
+    catches_genexit: bool = False   # bare / BaseException / GeneratorExit
+    # finally:
+    stmts: Optional[list] = None
+    # with: the withitem whose __exit__ runs on unwind
+    item: Optional[ast.withitem] = None
+    # loop:
+    head: Optional[int] = None
+    breaks: Optional[list] = None   # frontier entries collected by break
+    # try: whether the dispatch node already has its outward unwind route
+    escalated: bool = False
+
+
+_BROAD = {"BaseException", "Exception"}
+_GENEXIT_OK = {"BaseException", "GeneratorExit"}
+
+
+def _handler_names(h: ast.ExceptHandler) -> list:
+    if h.type is None:
+        return ["*"]
+    names = []
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for t in types:
+        parts = []
+        n = t
+        while isinstance(n, ast.Attribute):
+            parts.append(n.attr)
+            n = n.value
+        if isinstance(n, ast.Name):
+            parts.append(n.id)
+        names.append(".".join(reversed(parts)) if parts else "?")
+    return names
+
+
+class _Builder:
+    def __init__(self, may_raise: Callable[[ast.AST], bool]):
+        self.nodes: list[Node] = []
+        self.frames: list[_Frame] = []
+        self.may_raise = may_raise
+        self.is_generator = False
+        self._budget = 4000  # node cap: give up on pathological functions
+
+    # ------------------------------------------------------------ helpers
+    def new(self, kind: str, stmt: Optional[ast.AST] = None) -> int:
+        if len(self.nodes) >= self._budget:
+            raise _Overflow()
+        line = getattr(stmt, "lineno", 0) if stmt is not None else 0
+        if not line and isinstance(stmt, ast.withitem):
+            line = stmt.context_expr.lineno
+        n = Node(len(self.nodes), kind, stmt, line)
+        self.nodes.append(n)
+        return n.idx
+
+    def edge(self, src: int, dst: int, kind: str = NEXT):
+        self.nodes[src].succ.append((dst, kind))
+
+    def connect(self, frontier: list, dst: int):
+        for src, kind in frontier:
+            self.edge(src, dst, kind)
+
+    # --------------------------------------------------- abrupt transfers
+    def _unwind(self, frontier: list, *, stop: Callable[[_Frame], bool],
+                on_stop: Callable[[list, _Frame, int], Optional[list]],
+                at_bottom: Callable[[list], None]):
+        """Route ``frontier`` outward through the frame stack: inline every
+        ``finally``/``with`` crossed; at the first frame where ``stop`` is
+        true hand the frontier to ``on_stop`` (which may consume it or
+        return a remainder to keep propagating); falling off the stack
+        calls ``at_bottom``."""
+        i = len(self.frames) - 1
+        while i >= 0 and frontier:
+            fr = self.frames[i]
+            if fr.kind in ("finally", "with"):
+                frontier = self._inline_cleanup(frontier, i)
+            elif stop(fr):
+                frontier = on_stop(frontier, fr, i) or []
+            i -= 1
+        if frontier:
+            at_bottom(frontier)
+
+    def _inline_cleanup(self, frontier: list, frame_idx: int) -> list:
+        """Instantiate the finally body (or with __exit__) at ``frame_idx``
+        for this abrupt edge; returns the cleanup's own exit frontier."""
+        fr = self.frames[frame_idx]
+        saved = self.frames
+        self.frames = self.frames[:frame_idx]  # cleanup runs OUTSIDE itself
+        try:
+            if fr.kind == "with":
+                node = self.new("with_exit", fr.item)
+                self.connect(frontier, node)
+                out = [(node, NEXT)]
+            else:
+                out = self.block(fr.stmts or [], frontier)
+        finally:
+            self.frames = saved
+        return out
+
+    def do_raise(self, frontier: list, *, genexit: bool = False):
+        """Exception (or GeneratorExit) leaves ``frontier`` statements."""
+
+        def stop(fr: _Frame) -> bool:
+            return fr.kind == "try"
+
+        def on_stop(front: list, fr: _Frame, i: int):
+            if genexit:
+                # GeneratorExit is BaseException: narrow handlers never see
+                # it, so either this try catches it or it keeps unwinding
+                if fr.catches_genexit:
+                    self.connect(front, fr.dispatch)
+                    return None
+                return front
+            self.connect(front, fr.dispatch)
+            if fr.catches_all:
+                return None
+            # maybe-uncaught: dispatch also unwinds outward — route it once
+            if fr.escalated:
+                return None
+            fr.escalated = True
+            return [(fr.dispatch, EXC)]
+
+        def at_bottom(front: list):
+            self.connect(front, self.raise_exit)
+
+        self._unwind(frontier, stop=stop, on_stop=on_stop,
+                     at_bottom=at_bottom)
+
+    def do_return(self, frontier: list):
+        self._unwind(
+            frontier, stop=lambda fr: False,
+            on_stop=lambda f, fr, i: f,
+            at_bottom=lambda front: self.connect(front, self.exit),
+        )
+
+    def do_loop_jump(self, frontier: list, *, is_break: bool):
+        def stop(fr: _Frame) -> bool:
+            return fr.kind == "loop"
+
+        def on_stop(front: list, fr: _Frame, i: int):
+            if is_break:
+                fr.breaks.extend(front)
+            else:
+                for src, kind in front:
+                    self.edge(src, fr.head, BACK)
+            return None
+
+        self._unwind(frontier, stop=stop, on_stop=on_stop,
+                     at_bottom=lambda front: self.connect(front, self.exit))
+
+    # ------------------------------------------------------------- blocks
+    def block(self, stmts: list, frontier: list) -> list:
+        for stmt in stmts:
+            if not frontier:
+                return []  # unreachable tail
+            frontier = self.statement(stmt, frontier)
+        return frontier
+
+    def statement(self, stmt: ast.AST, frontier: list) -> list:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return frontier  # nested defs are opaque
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, frontier)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, frontier)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frontier)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, frontier)
+        if isinstance(stmt, ast.Return):
+            node = self.new("stmt", stmt)
+            self.connect(frontier, node)
+            self._maybe_exc(node, stmt)
+            self.do_return([(node, NEXT)])
+            return []
+        if isinstance(stmt, ast.Raise):
+            node = self.new("stmt", stmt)
+            self.connect(frontier, node)
+            self.do_raise([(node, NEXT)])
+            return []
+        if isinstance(stmt, ast.Break):
+            node = self.new("stmt", stmt)
+            self.connect(frontier, node)
+            self.do_loop_jump([(node, NEXT)], is_break=True)
+            return []
+        if isinstance(stmt, ast.Continue):
+            node = self.new("stmt", stmt)
+            self.connect(frontier, node)
+            self.do_loop_jump([(node, NEXT)], is_break=False)
+            return []
+        # simple statement (assign/expr/assert/del/...)
+        has_yield = any(isinstance(n, (ast.Yield, ast.YieldFrom))
+                        for n in ast.walk(stmt)
+                        if not isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.Lambda)))
+        node = self.new("yield" if has_yield else "stmt", stmt)
+        self.connect(frontier, node)
+        if has_yield:
+            self.is_generator = True
+            # the consumer may close() us here: GeneratorExit at the yield
+            self.do_raise([(node, GENEXIT)], genexit=True)
+        self._maybe_exc(node, stmt)
+        if isinstance(stmt, ast.Assert):
+            # a failing assert raises; the pass-through edge continues
+            self.do_raise([(node, EXC)])
+        return [(node, NEXT)]
+
+    def _maybe_exc(self, node_idx: int, stmt: ast.AST):
+        if self.may_raise(stmt):
+            self.do_raise([(node_idx, EXC)])
+
+    def _if(self, stmt: ast.If, frontier: list) -> list:
+        test = self.new("branch", stmt)
+        self.connect(frontier, test)
+        self._maybe_exc(test, stmt.test)
+        body_out = self.block(stmt.body, [(test, TRUE)])
+        else_out = self.block(stmt.orelse, [(test, FALSE)])
+        return body_out + else_out
+
+    def _loop(self, stmt, frontier: list) -> list:
+        head = self.new("loop", stmt)
+        self.connect(frontier, head)
+        self._maybe_exc(head, stmt)  # iterator / test can raise
+        fr = _Frame(kind="loop", head=head, breaks=[])
+        self.frames.append(fr)
+        try:
+            body_out = self.block(stmt.body, [(head, TRUE)])
+        finally:
+            self.frames.pop()
+        for src, kind in body_out:
+            self.edge(src, head, BACK)
+        # loop exhausts (or while-test false) → orelse → after
+        after = self.block(stmt.orelse, [(head, FALSE)])
+        return after + fr.breaks
+
+    def _try(self, stmt: ast.Try, frontier: list) -> list:
+        dispatch = self.new("dispatch", stmt)
+        catches_all = False
+        catches_genexit = False
+        for h in stmt.handlers:
+            names = _handler_names(h)
+            if "*" in names or any(n.split(".")[-1] in _BROAD for n in names):
+                catches_all = True
+            if "*" in names or any(n.split(".")[-1] in _GENEXIT_OK
+                                   for n in names):
+                catches_genexit = True
+
+        fin = _Frame(kind="finally", stmts=stmt.finalbody) \
+            if stmt.finalbody else None
+        if fin is not None:
+            self.frames.append(fin)
+        tryf = _Frame(kind="try", dispatch=dispatch,
+                      catches_all=catches_all,
+                      catches_genexit=catches_genexit)
+        self.frames.append(tryf)
+        try:
+            body_out = self.block(stmt.body, frontier)
+            body_out = self.block(stmt.orelse, body_out)
+        finally:
+            self.frames.pop()  # try frame: handlers run OUTSIDE it
+
+        # handler bodies: their own exceptions propagate outward (and
+        # through this try's finally, which is still on the stack)
+        handler_out: list = []
+        for h in stmt.handlers:
+            entry = self.new("stmt", h)
+            self.edge(dispatch, entry, EXC)
+            handler_out += self.block(h.body, [(entry, NEXT)])
+        if not stmt.handlers:
+            # try/finally with no handlers: dispatched exceptions keep
+            # unwinding (through the finally frame still on the stack)
+            self.do_raise([(dispatch, EXC)])
+
+        out = body_out + handler_out
+        if fin is not None:
+            self.frames.pop()  # finally frame
+            # normal completion runs the finally once, outside itself
+            saved = self.frames
+            out2 = self.block(stmt.finalbody, out) if out else []
+            self.frames = saved
+            return out2
+        return out
+
+    def _with(self, stmt, frontier: list) -> list:
+        # context expressions evaluate before any __exit__ is registered
+        inner_frames = 0
+        for item in stmt.items:
+            node = self.new("stmt", item)
+            self.connect(frontier, node)
+            self._maybe_exc(node, item.context_expr)
+            frontier = [(node, NEXT)]
+            self.frames.append(_Frame(kind="with", item=item))
+            inner_frames += 1
+        try:
+            out = self.block(stmt.body, frontier)
+        finally:
+            for _ in range(inner_frames):
+                fr = self.frames.pop()
+                # normal exit also runs __exit__
+                if out:
+                    node = self.new("with_exit", fr.item)
+                    self.connect(out, node)
+                    out = [(node, NEXT)]
+        return out
+
+
+class _Overflow(Exception):
+    pass
+
+
+def build_cfg(fn: ast.AST,
+              may_raise: Optional[Callable[[ast.AST], bool]] = None
+              ) -> Optional[CFG]:
+    """CFG for a FunctionDef/AsyncFunctionDef; None when the function is
+    too large/pathological to model (the caller skips it — best-effort).
+
+    ``may_raise(stmt)`` decides which statements get exception edges;
+    the default gives one to every statement containing a call.
+    """
+    if may_raise is None:
+        def may_raise(stmt):
+            return any(isinstance(n, ast.Call) for n in ast.walk(stmt))
+
+    b = _Builder(may_raise)
+    try:
+        entry = b.new("entry")
+        b.exit = b.new("exit")
+        b.raise_exit = b.new("raise")
+        out = b.block(fn.body, [(entry, NEXT)])
+        b.connect(out, b.exit)  # fall off the end
+    except (_Overflow, RecursionError):
+        return None
+    return CFG(nodes=b.nodes, entry=entry, exit=b.exit,
+               raise_exit=b.raise_exit, is_generator=b.is_generator)

@@ -13,12 +13,23 @@ Workflow pieces living here:
 - **Baseline** — ``analysis/baseline.json`` holds grandfathered findings
   keyed by (rule, path, enclosing symbol, message); matching findings are
   reported as baselined and do not fail the run. ``--write-baseline``
-  regenerates the file from the current findings.
+  regenerates the file from the current findings. A baseline entry that no
+  longer matches anything is itself a hard error (MST003) so the file can
+  only shrink toward empty, never silently rot.
+- **Dead suppressions** — an ``allow(...)`` comment whose rule no longer
+  fires on that line is reported as MST002: suppressions must be deleted
+  when the finding they silenced is fixed.
+- **Incremental cache** — per-file facts (findings, suppression table,
+  lock facts) keyed by content hash + a digest of the checker's own
+  sources; unchanged files skip parsing and every rule. Only the cheap
+  cross-module lock pass (method resolution + cycle hunt) reruns each
+  scan. ``--no-cache`` / ``--cache PATH`` control it.
 """
 
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import re
 import sys
@@ -111,9 +122,26 @@ def dotted_name(node: ast.AST) -> Optional[str]:
     return None
 
 
-def parse_module(path: Path, display_path: str) -> tuple[Optional[ModuleInfo], list[Finding]]:
+def _comments(source: str):
+    """(line, text) for real ``#`` comments only — a docstring that *shows*
+    the suppression syntax must not register as a suppression."""
+    import io
+    import tokenize
+
     try:
-        source = path.read_text(encoding="utf-8")
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return  # ast already accepted the file; partial comments suffice
+
+
+def parse_module(path: Path, display_path: str,
+                 source: Optional[str] = None
+                 ) -> tuple[Optional[ModuleInfo], list[Finding]]:
+    try:
+        if source is None:
+            source = path.read_text(encoding="utf-8")
         tree = ast.parse(source, filename=str(path))
     except (OSError, SyntaxError, ValueError) as e:
         line = getattr(e, "lineno", 1) or 1
@@ -122,7 +150,7 @@ def parse_module(path: Path, display_path: str) -> tuple[Optional[ModuleInfo], l
         ]
     mod = ModuleInfo(path=path, display_path=display_path, tree=tree,
                      source_lines=source.splitlines())
-    for i, text in enumerate(mod.source_lines, start=1):
+    for i, text in _comments(source):
         if "mst:" not in text:
             continue
         if HOT_PATH_RE.search(text):
@@ -162,44 +190,186 @@ class Report:
     baselined: list[Finding] = field(default_factory=list)
     lock_edges: list = field(default_factory=list)  # locks.LockEdge
     files_scanned: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
 
 
-def analyze_paths(paths: list[str], baseline: Optional[set] = None) -> Report:
-    """Run every rule family over ``paths``; returns the triaged report."""
-    from mlx_sharding_tpu.analysis import lifecycle, locks, trace_safety
+# ------------------------------------------------------- per-file facts
+# the cache key includes a digest of the checker's own sources: any edit
+# to analysis/*.py invalidates every entry, so there is no manual
+# version constant to forget to bump
+CACHE_VERSION = 2
+
+_checker_digest_memo: Optional[str] = None
+
+
+def _checker_digest() -> str:
+    global _checker_digest_memo
+    if _checker_digest_memo is None:
+        h = hashlib.sha256()
+        for f in sorted(Path(__file__).parent.glob("*.py")):
+            h.update(f.name.encode())
+            h.update(f.read_bytes())
+        _checker_digest_memo = h.hexdigest()[:16]
+    return _checker_digest_memo
+
+
+def file_facts(mod: ModuleInfo) -> dict:
+    """Everything the triage pass needs from one file, JSON-safe: the
+    module-local findings of every rule family, the suppression table,
+    and the per-file lock facts for the cross-module pass."""
+    from mlx_sharding_tpu.analysis import (
+        lifecycle,
+        locks,
+        resource_lifecycle,
+        trace_safety,
+    )
+
+    findings: list[Finding] = []
+    for line in mod.bad_suppressions:
+        findings.append(Finding(
+            "MST001", mod.display_path, line, 0,
+            "suppression without a reason — write "
+            "'# mst: allow(<rule>): <why this is safe>'",
+            context=qualname_for_line(mod.tree, line),
+        ))
+    findings.extend(trace_safety.check_module(mod))
+    findings.extend(lifecycle.check_module(mod))
+    findings.extend(resource_lifecycle.check_module(mod))
+    return {
+        "findings": [f.__dict__.copy() for f in findings],
+        "suppressions": {
+            str(line): sorted(rules)
+            for line, rules in mod.suppressions.items()
+        },
+        "lock": locks.module_facts(mod),
+    }
+
+
+def _error_facts(errors: list[Finding]) -> dict:
+    return {
+        "findings": [f.__dict__.copy() for f in errors],
+        "suppressions": {},
+        "lock": {"findings": [], "classes": []},
+    }
+
+
+def _load_cache(cache_path: Optional[Path]) -> dict:
+    if cache_path is not None and cache_path.exists():
+        try:
+            data = json.loads(cache_path.read_text())
+            if (data.get("version") == CACHE_VERSION
+                    and data.get("checker") == _checker_digest()):
+                return data
+        except (OSError, ValueError):
+            pass
+    return {"version": CACHE_VERSION, "checker": _checker_digest(),
+            "files": {}}
+
+
+REGEN_HINT = ("regenerate with `python -m mlx_sharding_tpu.analysis "
+              "mlx_sharding_tpu/ --write-baseline`")
+
+
+def analyze_paths(paths: list[str], baseline: Optional[set] = None,
+                  cache_path: Optional[Path] = None,
+                  baseline_path: Optional[Path] = None) -> Report:
+    """Run every rule family over ``paths``; returns the triaged report.
+
+    With ``cache_path``, per-file results are reused when the file's
+    content hash and the checker's own digest both match — self-scan
+    cost becomes proportional to what changed since the last run.
+    """
+    from mlx_sharding_tpu.analysis import locks
 
     report = Report()
-    raw: list[Finding] = []
-    modules: list[ModuleInfo] = []
+    cache = _load_cache(cache_path)
+    records: dict[str, dict] = {}  # display_path -> facts
     for f in collect_files(paths):
-        mod, errors = parse_module(f, f.as_posix())
-        raw.extend(errors)
-        if mod is None:
+        display = f.as_posix()
+        try:
+            data = f.read_bytes()
+        except OSError as e:
+            records[display] = _error_facts([Finding(
+                "MST000", display, 1, 0, f"unparseable file: {e}")])
+            report.files_scanned += 1
             continue
-        modules.append(mod)
+        digest = hashlib.sha256(data).hexdigest()
+        entry = cache["files"].get(display)
+        if entry is not None and entry.get("hash") == digest:
+            facts = entry["facts"]
+            report.cache_hits += 1
+        else:
+            mod, errors = parse_module(
+                f, display, source=data.decode("utf-8", errors="replace"))
+            facts = _error_facts(errors) if mod is None else file_facts(mod)
+            cache["files"][display] = {"hash": digest, "facts": facts}
+            report.cache_misses += 1
+        records[display] = facts
         report.files_scanned += 1
-        for line in mod.bad_suppressions:
-            raw.append(Finding(
-                "MST001", mod.display_path, line, 0,
-                "suppression without a reason — write "
-                "'# mst: allow(<rule>): <why this is safe>'",
-                context=qualname_for_line(mod.tree, line),
-            ))
-        raw.extend(trace_safety.check_module(mod))
-        raw.extend(lifecycle.check_module(mod))
-    lock_findings, edges = locks.check_modules(modules)
-    raw.extend(lock_findings)
+
+    if cache_path is not None and report.cache_misses:
+        try:
+            cache_path.write_text(json.dumps(cache))
+        except OSError:
+            pass  # the cache is an optimization, never a failure
+
+    # cross-module lock pass (cheap dict work; always recomputed)
+    lock_findings, edges = locks.global_check(
+        {p: r["lock"] for p, r in records.items()})
     report.lock_edges = edges
 
-    by_path = {m.display_path: m for m in modules}
+    raw: list[Finding] = [
+        Finding(**d)
+        for r in records.values()
+        for d in r["findings"] + r["lock"]["findings"]
+    ]
+    raw.extend(lock_findings)
+
+    # MST002: every suppression must still be earning its keep
+    fired_by_path: dict[str, set] = {}
+    for f in raw:
+        fired_by_path.setdefault(f.path, set()).update(
+            [(f.rule, f.line), (f.rule, f.line - 1)])
+    for path, r in records.items():
+        fired = fired_by_path.get(path, set())
+        for line_s, rules in sorted(r["suppressions"].items(),
+                                    key=lambda kv: int(kv[0])):
+            if any((rule, int(line_s)) in fired for rule in rules):
+                continue
+            listed = ",".join(sorted(rules))
+            raw.append(Finding(
+                "MST002", path, int(line_s), 0,
+                f"dead suppression: allow({listed}) no longer matches any "
+                "finding here — the bug it silenced is gone, delete the "
+                "comment",
+                context=f"allow({listed})",
+            ))
+
+    suppression_exempt = {"MST001", "MST002"}
     for finding in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
-        mod = by_path.get(finding.path)
-        if mod is not None and finding.rule != "MST001" and mod.is_suppressed(finding):
-            continue
+        r = records.get(finding.path)
+        if r is not None and finding.rule not in suppression_exempt:
+            sup = r["suppressions"]
+            if any(finding.rule in sup.get(str(line), ())
+                   for line in (finding.line, finding.line - 1)):
+                continue
         if baseline and finding.key() in baseline:
             report.baselined.append(finding)
         else:
             report.findings.append(finding)
+
+    # MST003: stale baseline entries are a hard error, not silent rot
+    if baseline:
+        matched = {f.key() for f in report.baselined}
+        for key in sorted(baseline - matched):
+            rule, path, context, message = key
+            report.findings.append(Finding(
+                "MST003", str(baseline_path or DEFAULT_BASELINE), 0, 0,
+                f"stale baseline entry ({rule} {path} {context!r}): the "
+                f"finding it grandfathers is gone — {REGEN_HINT}",
+                context=context,
+            ))
     return report
 
 
@@ -226,8 +396,12 @@ def write_baseline(path: Path, findings: list[Finding]):
 DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
 
 
+DEFAULT_CACHE = Path(".mstcheck-cache.json")
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     import argparse
+    import time
 
     parser = argparse.ArgumentParser(
         prog="python -m mlx_sharding_tpu.analysis",
@@ -245,6 +419,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--format", choices=("text", "json"), default="text")
     parser.add_argument("--lock-graph", action="store_true",
                         help="print the static lock-acquisition-order graph")
+    parser.add_argument("--cache", default=str(DEFAULT_CACHE),
+                        help="per-file incremental result cache "
+                        f"(default: {DEFAULT_CACHE})")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="reparse and recheck every file")
     args = parser.parse_args(argv)
 
     baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
@@ -252,7 +431,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not args.no_baseline and not args.write_baseline and baseline_path.exists():
         baseline = load_baseline(baseline_path)
 
-    report = analyze_paths(args.paths, baseline=baseline)
+    t0 = time.perf_counter()
+    report = analyze_paths(
+        args.paths, baseline=baseline,
+        cache_path=None if args.no_cache else Path(args.cache),
+        baseline_path=baseline_path,
+    )
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
 
     if args.write_baseline:
         write_baseline(baseline_path, report.findings)
@@ -260,10 +445,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 0
 
     if args.format == "json":
+        from mlx_sharding_tpu.analysis import resources
+
         print(json.dumps({
             "findings": [f.__dict__ for f in report.findings],
             "baselined": [f.__dict__ for f in report.baselined],
             "lock_edges": [e.as_dict() for e in report.lock_edges],
+            "files_scanned": report.files_scanned,
+            "cache_hits": report.cache_hits,
+            "cache_misses": report.cache_misses,
+            "elapsed_ms": round(elapsed_ms, 1),
+            "resource_registry": resources.registry_table(),
         }, indent=2))
     else:
         for f in report.findings:
@@ -275,6 +467,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(
             f"mstcheck: {len(report.findings)} finding(s), "
             f"{len(report.baselined)} baselined, "
-            f"{report.files_scanned} file(s) scanned"
+            f"{report.files_scanned} file(s) scanned "
+            f"({report.cache_hits} cached) in {elapsed_ms:.0f}ms"
         )
     return 1 if report.findings else 0

@@ -392,42 +392,82 @@ def _find_cycles(edges: list[LockEdge]) -> list[Finding]:
     return findings
 
 
-def check_modules(modules: list[ModuleInfo]) -> tuple[list[Finding], list[LockEdge]]:
-    classes: list[_ClassInfo] = []
-    for mod in modules:
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef):
-                classes.append(_analyze_class(mod, node))
+def module_facts(mod: ModuleInfo) -> dict:
+    """Per-file half of the lock analysis, as a JSON-safe dict.
 
-    # cross-class: method name -> locks that method acquires, any class
-    global_map: dict[str, set] = {}
-    for ci in classes:
-        for m, lks in ci.method_locks.items():
-            if lks:
-                global_map.setdefault(m, set()).update(lks)
-
-    edges: list[LockEdge] = []
-    for ci in classes:
-        edges.extend(ci.edges)
-        for hc in ci.held_calls:
-            callee_locks = (
-                ci.method_locks.get(hc.method_name, set()) if hc.recv_is_self
-                else global_map.get(hc.method_name, set())
-            )
-            for src in hc.held:
-                for dst in sorted(callee_locks):
-                    if src != dst:
-                        edges.append(LockEdge(
-                            src, dst, ci.mod.display_path, hc.line))
-
+    Everything derivable from this file alone lives here — MST201/202
+    findings, this file's nested-``with`` edges, and the held-call /
+    method-locks tables the cross-module pass resolves later. This split
+    is what makes the incremental cache sound: a cached file contributes
+    its facts without being reparsed, and only :func:`global_check`
+    (method-name resolution + cycle detection) reruns every time.
+    """
+    classes = [
+        _analyze_class(mod, node)
+        for node in ast.walk(mod.tree)
+        if isinstance(node, ast.ClassDef)
+    ]
     findings: list[Finding] = []
     for ci in classes:
         guarded = _guarded_attrs(ci)
         findings += _mst201(ci, guarded)
         findings += _mst202(ci, guarded)
-    findings += _find_cycles(edges)
+    return {
+        "findings": [f.__dict__.copy() for f in findings],
+        "classes": [
+            {
+                "edges": [e.as_dict() for e in ci.edges],
+                "method_locks": {m: sorted(lks)
+                                 for m, lks in ci.method_locks.items() if lks},
+                "held_calls": [
+                    {"held": list(hc.held), "callee": hc.method_name,
+                     "recv_is_self": hc.recv_is_self, "line": hc.line}
+                    for hc in ci.held_calls
+                ],
+            }
+            for ci in classes
+        ],
+    }
 
+
+def global_check(facts_by_path: dict) -> tuple[list[Finding], list[LockEdge]]:
+    """Cross-module half: resolve held calls through the fleet-wide
+    method-name → locks map, then hunt lock-order cycles. Cheap (pure
+    dict work), so it reruns on every scan even when all files hit the
+    cache."""
+    # method name -> locks that method acquires, in any class of any file
+    global_map: dict[str, set] = {}
+    for facts in facts_by_path.values():
+        for cls in facts["classes"]:
+            for m, lks in cls["method_locks"].items():
+                global_map.setdefault(m, set()).update(lks)
+
+    edges: list[LockEdge] = []
+    for path, facts in facts_by_path.items():
+        for cls in facts["classes"]:
+            edges.extend(LockEdge(**e) for e in cls["edges"])
+            for hc in cls["held_calls"]:
+                callee_locks = (
+                    set(cls["method_locks"].get(hc["callee"], ()))
+                    if hc["recv_is_self"]
+                    else global_map.get(hc["callee"], set())
+                )
+                for src in hc["held"]:
+                    for dst in sorted(callee_locks):
+                        if src != dst:
+                            edges.append(LockEdge(src, dst, path, hc["line"]))
+
+    findings = _find_cycles(edges)
     uniq: dict[tuple, LockEdge] = {}
     for e in edges:
         uniq.setdefault((e.src, e.dst), e)
     return findings, sorted(uniq.values(), key=lambda e: (e.src, e.dst))
+
+
+def check_modules(modules: list[ModuleInfo]) -> tuple[list[Finding], list[LockEdge]]:
+    facts = {mod.display_path: module_facts(mod) for mod in modules}
+    findings = [
+        Finding(**f) for fx in facts.values() for f in fx["findings"]
+    ]
+    cycle_findings, edges = global_check(facts)
+    return findings + cycle_findings, edges

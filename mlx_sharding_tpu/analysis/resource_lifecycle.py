@@ -1,0 +1,599 @@
+"""MST40x: path-sensitive must-release verification.
+
+Runs the resource registry (:mod:`.resources`) over per-function CFGs
+(:mod:`.cfg`): every path from an acquire to a function exit is walked
+with a tiny abstract interpreter tracking each handle variable through
+
+    LIVE → RELEASED            (lease.release() / self._done(i, probe))
+    LIVE → ESCAPED_STRONG      (stored on self/req/..., returned, yielded)
+    LIVE → ESCAPED_WEAK        (passed as a plain call argument)
+
+plus *None-refinement*: ``if lease is None: return`` kills the handle on
+the true arm, so Optional acquires (``PrefixStore.acquire`` → ``None`` on
+miss) don't flag their miss path.
+
+Rules:
+
+- **MST401 leak-on-exception-path** — a LIVE handle reaches the raise
+  exit: some call between acquire and release can raise (the non-raising
+  vocabulary in :mod:`.resources` filters counters/logging) and no
+  ``try/finally`` puts the release on that unwind. The PR-3 probe-ticket
+  bug, statically.
+- **MST402 double-release** — a path releases the same handle twice
+  ("released exactly once through drain/close/fault paths", PR 11).
+- **MST403 release-of-escaped** — releasing a handle after ownership
+  already transferred (stored on an object / returned): the new owner
+  will release it again. Release after a *weak* escape (handle passed to
+  a constructor that may or may not take ownership) is allowed — that is
+  the ``aliased_spawn`` fault-cleanup idiom.
+- **MST404 missing-release-arm** — a LIVE handle reaches the *normal*
+  exit: a conditional release misses this early-``return`` arm (or the
+  function simply never releases).
+
+Interprocedural layer (module-local, two-pass): a function whose every
+path either returns a freshly acquired handle or releases it becomes an
+acquire-alias at its call sites; a function that releases a parameter on
+all paths becomes a release-alias for the argument at that position.
+
+Bounded: loops are walked 0 or 1 times (each CFG node at most twice per
+path), with global path/step caps — best-effort on pathological
+functions, exact on the acquire/release shapes this repo actually has.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Optional
+
+from mlx_sharding_tpu.analysis import cfg as cfglib
+from mlx_sharding_tpu.analysis import resources
+from mlx_sharding_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    qualname_for_line,
+)
+
+# handle states
+LIVE = "live"
+RELEASED = "released"
+STRONG = "escaped"        # ownership transferred (attr store / return)
+WEAK = "escaped-weak"     # passed as a call argument
+
+MAX_STEPS = 60_000        # traversal-step safety valve per function
+
+
+@dataclass(frozen=True)
+class Handle:
+    kind: str       # resources kind ("weights.lease")
+    status: str
+    acq_line: int
+    event_line: int  # line of the last status transition
+
+
+@dataclass(frozen=True)
+class FnSummary:
+    """Module-local interprocedural facts for one function."""
+
+    name: str
+    returns_fresh: Optional[str] = None   # resource kind it hands out
+    releases_param: Optional[int] = None  # 0-based index (self excluded)
+    param_name: Optional[str] = None
+
+
+def _bare(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    return name.split(".")[-1] if name else None
+
+
+def _receiver(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return dotted_name(call.func.value)
+    return None
+
+
+def _may_raise(stmt: ast.AST) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            bare = _bare(n)
+            if bare is None or not resources.is_nonraising(bare):
+                return True
+    return False
+
+
+def _expr_calls(node: ast.AST) -> list:
+    calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+    return calls
+
+
+def _names_in(node: ast.AST) -> list:
+    return [n.id for n in ast.walk(node) if isinstance(n, ast.Name)]
+
+
+# --------------------------------------------------------------- node ops
+# op shapes:
+#   ("acquire", var, kind, ast_node)
+#   ("release", var, ast_node)          # receiver- or arg-style release
+#   ("release_cm", var, ast_node)       # with __exit__: silent, idempotent
+#   ("strong", var, ast_node)
+#   ("weak", var, ast_node)
+#   ("kill", var, None)
+class _Ops:
+    """Per-CFG-node effect extraction, with interprocedural extensions."""
+
+    def __init__(self, summaries: dict):
+        self.summaries = summaries  # bare fn name -> FnSummary
+        self._cache: dict = {}
+
+    def for_node(self, node) -> list:
+        ops = self._cache.get(node.idx)
+        if ops is None:
+            ops = self._compute(node)
+            self._cache[node.idx] = ops
+        return ops
+
+    # -- helpers -----------------------------------------------------
+    def _acquire_kind(self, call: ast.Call) -> Optional[str]:
+        bare = _bare(call)
+        if bare is None:
+            return None
+        spec = resources.match_acquire(bare, _receiver(call))
+        if spec is not None:
+            return spec.kind
+        s = self.summaries.get(bare)
+        if s is not None and s.returns_fresh:
+            return s.returns_fresh
+        return None
+
+    def _call_ops(self, call: ast.Call, ops: list, acquired_to: set):
+        """Release/weak-escape effects of one call (acquire handled by
+        the enclosing assignment, which knows the binding target)."""
+        bare = _bare(call)
+        released_here: set = set()
+        if bare is not None:
+            spec = resources.match_release(bare)
+            if spec is not None:
+                if spec.release_as_arg:
+                    for arg in list(call.args) + [k.value for k in call.keywords]:
+                        if isinstance(arg, ast.Name):
+                            ops.append(("release", arg.id, call))
+                            released_here.add(arg.id)
+                elif isinstance(call.func, ast.Attribute) and isinstance(
+                        call.func.value, ast.Name):
+                    ops.append(("release", call.func.value.id, call))
+                    released_here.add(call.func.value.id)
+            s = self.summaries.get(bare)
+            if s is not None and s.releases_param is not None:
+                args = [a for a in call.args]
+                if s.releases_param < len(args) and isinstance(
+                        args[s.releases_param], ast.Name):
+                    ops.append(("release", args[s.releases_param].id, call))
+                    released_here.add(args[s.releases_param].id)
+                for kw in call.keywords:
+                    if kw.arg == s.param_name and isinstance(kw.value, ast.Name):
+                        ops.append(("release", kw.value.id, call))
+                        released_here.add(kw.value.id)
+        # any other handle passed in is a weak escape
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            for name in _names_in(arg):
+                if name not in released_here and name not in acquired_to:
+                    ops.append(("weak", name, call))
+
+    def _compute(self, node) -> list:
+        ops: list = []
+        stmt = node.stmt
+        if stmt is None or node.kind == "dispatch":
+            # dispatch nodes reference the whole ast.Try for location only —
+            # the body/handler/finally statements are their own CFG nodes
+            return ops
+
+        if node.kind == "with_exit":
+            if isinstance(stmt, ast.withitem) and isinstance(
+                    stmt.optional_vars, ast.Name):
+                ops.append(("release_cm", stmt.optional_vars.id, stmt))
+            return ops
+
+        if isinstance(stmt, ast.withitem):
+            # the context-expression node of a `with`
+            acquired_to: set = set()
+            kind = (self._acquire_kind(stmt.context_expr)
+                    if isinstance(stmt.context_expr, ast.Call) else None)
+            if kind is not None and isinstance(stmt.optional_vars, ast.Name):
+                acquired_to.add(stmt.optional_vars.id)
+            for call in _expr_calls(stmt.context_expr):
+                self._call_ops(call, ops, acquired_to)
+            if kind is not None and isinstance(stmt.optional_vars, ast.Name):
+                ops.append(("acquire", stmt.optional_vars.id, kind,
+                            stmt.context_expr))
+            elif isinstance(stmt.optional_vars, ast.Name):
+                ops.append(("kill", stmt.optional_vars.id, None))
+            return ops
+
+        if isinstance(stmt, ast.ExceptHandler):
+            if stmt.name:
+                ops.append(("kill", stmt.name, None))
+            return ops
+
+        if isinstance(stmt, (ast.If, ast.While)):
+            for call in _expr_calls(stmt.test):
+                self._call_ops(call, ops, set())
+            return ops
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            for call in _expr_calls(stmt.iter):
+                self._call_ops(call, ops, set())
+            for name in _names_in(stmt.target):
+                ops.append(("kill", name, None))
+            return ops
+
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                for call in _expr_calls(stmt.value):
+                    self._call_ops(call, ops, set())
+                for name in _names_in(stmt.value):
+                    ops.append(("strong", name, stmt))
+            return ops
+
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    ops.append(("kill", t.id, None))
+            return ops
+
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            acquired_to: set = set()
+            kind = (self._acquire_kind(value)
+                    if isinstance(value, ast.Call) else None)
+            bind_var = None
+            if kind is not None:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        bind_var = t.id
+                    elif isinstance(t, ast.Tuple):
+                        bare = _bare(value)
+                        spec = resources.match_acquire(bare, _receiver(value)) \
+                            if bare else None
+                        pos = spec.handle_pos if spec else None
+                        if pos is not None and pos < len(t.elts) and \
+                                isinstance(t.elts[pos], ast.Name):
+                            bind_var = t.elts[pos].id
+                if bind_var is not None:
+                    acquired_to.add(bind_var)
+            if value is not None:
+                for call in _expr_calls(value):
+                    self._call_ops(call, ops, acquired_to)
+            # escapes / rebinds from the store targets
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    if value is not None:
+                        for name in _names_in(value):
+                            if name not in acquired_to:
+                                ops.append(("strong", name, stmt))
+                else:
+                    for name in _names_in(t):
+                        if name not in acquired_to:
+                            ops.append(("kill", name, None))
+            if bind_var is not None:
+                ops.append(("acquire", bind_var, kind, stmt))
+            return ops
+
+        # generic statement (Expr, Assert, ...): calls + yield escapes
+        has_yield = node.kind == "yield"
+        for call in _expr_calls(stmt):
+            self._call_ops(call, ops, set())
+        if has_yield:
+            for n in ast.walk(stmt):
+                if isinstance(n, (ast.Yield, ast.YieldFrom)) and \
+                        n.value is not None:
+                    for name in _names_in(n.value):
+                        ops.append(("strong", name, stmt))
+        return ops
+
+
+# ------------------------------------------------------ branch refinement
+def _refine(test: ast.AST, arm: bool) -> list:
+    """Variables that are known None/falsy (→ not a handle) on ``arm``."""
+    kills: list = []
+
+    def none_cmp(t) -> Optional[tuple]:
+        # returns (var, is_none_on_true) for `x is None` / `x is not None`
+        if isinstance(t, ast.Compare) and len(t.ops) == 1 and \
+                isinstance(t.left, ast.Name) and \
+                isinstance(t.comparators[0], ast.Constant) and \
+                t.comparators[0].value is None:
+            if isinstance(t.ops[0], ast.Is):
+                return (t.left.id, True)
+            if isinstance(t.ops[0], ast.IsNot):
+                return (t.left.id, False)
+        return None
+
+    def visit(t, polarity: bool):
+        # polarity: the value this subexpression is known to have on `arm`
+        if isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not):
+            visit(t.operand, not polarity)
+            return
+        if isinstance(t, ast.BoolOp):
+            # `a and b` true → both true; `a or b` false → both false
+            if isinstance(t.op, ast.And) and polarity:
+                for v in t.values:
+                    visit(v, True)
+            elif isinstance(t.op, ast.Or) and not polarity:
+                for v in t.values:
+                    visit(v, False)
+            return
+        nc = none_cmp(t)
+        if nc is not None:
+            var, none_when_true = nc
+            if none_when_true == polarity:
+                kills.append(var)
+            return
+        if isinstance(t, ast.Name) and not polarity:
+            kills.append(t.id)  # falsy branch: var is None/empty
+
+    visit(test, arm)
+    return kills
+
+
+# ------------------------------------------------------------ path engine
+class _Engine:
+    def __init__(self, fn, mod: ModuleInfo, ops: _Ops,
+                 seed_params: Optional[dict] = None):
+        self.fn = fn
+        self.mod = mod
+        self.ops = ops
+        self.seed_params = seed_params or {}
+        self.findings: dict = {}     # dedup key -> Finding
+        self.fresh_returns: set = set()   # resource kinds returned LIVE
+        self.seed_leaked = False     # a seeded param reached an exit LIVE
+        self.seed_released = False
+        self.truncated = False
+
+    # -- finding emission --------------------------------------------
+    def _emit(self, rule: str, line: int, col: int, msg: str, dedup: tuple):
+        if dedup in self.findings:
+            return
+        self.findings[dedup] = Finding(
+            rule, self.mod.display_path, line, col, msg,
+            context=qualname_for_line(self.mod.tree, line))
+
+    def _apply(self, op, state: dict, node) -> None:
+        tag = op[0]
+        var = op[1]
+        if tag == "acquire":
+            state[var] = Handle(op[2], LIVE, node.line, node.line)
+            return
+        h = state.get(var)
+        if tag == "kill":
+            state.pop(var, None)
+            return
+        if h is None:
+            return
+        line = getattr(op[2], "lineno", node.line) or node.line
+        col = getattr(op[2], "col_offset", 0)
+        if tag == "release":
+            if h.status == LIVE or h.status == WEAK:
+                state[var] = Handle(h.kind, RELEASED, h.acq_line, line)
+                if var in self.seed_params:
+                    self.seed_released = True
+            elif h.status == RELEASED:
+                self._emit(
+                    "MST402", line, col,
+                    f"double release of {h.kind} handle {var!r} "
+                    f"(acquired line {h.acq_line}, already released line "
+                    f"{h.event_line}) — a second owner frees it again",
+                    ("MST402", var, h.acq_line, line))
+            elif h.status == STRONG:
+                self._emit(
+                    "MST403", line, col,
+                    f"release of escaped {h.kind} handle {var!r} — "
+                    f"ownership transferred at line {h.event_line}, the "
+                    "new owner will release it again",
+                    ("MST403", var, h.acq_line, line))
+        elif tag == "release_cm":
+            if h.status in (LIVE, WEAK):
+                state[var] = Handle(h.kind, RELEASED, h.acq_line, line)
+        elif tag == "strong":
+            if h.status == LIVE or h.status == WEAK:
+                state[var] = Handle(h.kind, STRONG, h.acq_line, line)
+                if isinstance(node.stmt, ast.Return):
+                    self.fresh_returns.add(h.kind)
+        elif tag == "weak":
+            if h.status == LIVE:
+                state[var] = Handle(h.kind, WEAK, h.acq_line, line)
+
+    def _at_exit(self, state: dict, *, exceptional: bool, line: int,
+                 genexit: bool):
+        for var, h in state.items():
+            # WEAK still counts: passing a handle to a call does not
+            # discharge the release obligation (only store/return does)
+            if h.status not in (LIVE, WEAK):
+                continue
+            if var in self.seed_params:
+                self.seed_leaked = True
+                continue
+            if exceptional:
+                how = ("the consumer closes the generator here"
+                       if genexit else "an exception unwinds through here")
+                self._emit(
+                    "MST401", line, 0,
+                    f"{h.kind} handle {var!r} (acquired line {h.acq_line}) "
+                    f"leaks when {how} — no release on the unwind path; "
+                    "wrap in try/finally",
+                    ("MST401", var, h.acq_line))
+            else:
+                self._emit(
+                    "MST404", line, 0,
+                    f"{h.kind} handle {var!r} (acquired line {h.acq_line}) "
+                    "is still live at this return — a conditional release "
+                    "misses this exit arm",
+                    ("MST404", var, h.acq_line))
+
+    # -- traversal ----------------------------------------------------
+    def run(self, graph: cfglib.CFG):
+        """Worklist exploration of (node, handle-state) pairs.
+
+        Not naive path enumeration: two paths reaching the same node with
+        the same abstract state are indistinguishable from there on, so
+        the second is cut. Branch diamonds that never touch a handle
+        collapse to one state; loops terminate because the state space is
+        finite. Path-sensitivity is fully preserved — distinct states are
+        explored separately, never joined.
+        """
+        nodes = graph.nodes
+        init = dict(self.seed_params)
+        stack = [(graph.entry, init, 0, False)]
+        seen: set = set()
+        steps = 0
+        while stack:
+            steps += 1
+            if steps > MAX_STEPS:
+                self.truncated = True
+                return
+            idx, state, line, genexit = stack.pop()
+            key = (idx, genexit, tuple(sorted(state.items())))
+            if key in seen:
+                continue
+            seen.add(key)
+            node = nodes[idx]
+            if idx == graph.exit:
+                self._at_exit(state, exceptional=False,
+                              line=line or node.line, genexit=False)
+                continue
+            if idx == graph.raise_exit:
+                self._at_exit(state, exceptional=True,
+                              line=line or node.line, genexit=genexit)
+                continue
+
+            pre = state
+            post = dict(state)
+            for op in self.ops.for_node(node):
+                self._apply(op, post, node)
+            # exception mid-statement: effects may not have happened —
+            # roll acquires back (the acquire itself is what raised), keep
+            # releases (treating a raising release as done avoids noise)
+            exc_state = None
+
+            for dst, kind in node.succ:
+                if kind == cfglib.EXC or kind == cfglib.GENEXIT:
+                    if exc_state is None:
+                        # mid-statement unwind: the acquire (probably what
+                        # raised) didn't complete, and a return/yield/store
+                        # escape didn't happen either. Releases and weak
+                        # call-arg handoffs are kept — treating a raising
+                        # release as done avoids pure noise.
+                        exc_state = dict(pre)
+                        for op in self.ops.for_node(node):
+                            if op[0] not in ("acquire", "strong"):
+                                self._apply(op, exc_state, node)
+                    st = exc_state
+                elif kind in (cfglib.TRUE, cfglib.FALSE) and \
+                        node.kind in ("branch", "loop") and \
+                        isinstance(node.stmt, (ast.If, ast.While)):
+                    st = dict(post)
+                    for var in _refine(node.stmt.test, kind == cfglib.TRUE):
+                        st.pop(var, None)
+                else:
+                    st = post
+                stack.append((dst, dict(st), node.line or line,
+                              genexit or kind == cfglib.GENEXIT))
+
+
+# ------------------------------------------------------------- module API
+def _functions(tree: ast.Module):
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n
+
+
+def _has_static_acquire(fn, summaries: dict) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+                and n is not fn:
+            continue
+        if isinstance(n, ast.Call):
+            bare = _bare(n)
+            if bare is None:
+                continue
+            if resources.match_acquire(bare, _receiver(n)) is not None:
+                return True
+            s = summaries.get(bare)
+            if s is not None and s.returns_fresh:
+                return True
+    return False
+
+
+def _param_names(fn) -> list:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _summarize(fn, mod: ModuleInfo, base_ops: _Ops) -> Optional[FnSummary]:
+    """Pass-1 facts: does ``fn`` hand out fresh handles / consume a param?"""
+    graph = cfglib.build_cfg(fn, may_raise=_may_raise)
+    if graph is None:
+        return None
+    returns_fresh = None
+    if _has_static_acquire(fn, {}):
+        eng = _Engine(fn, mod, base_ops)
+        eng.run(graph)
+        if len(eng.fresh_returns) == 1 and not eng.findings:
+            returns_fresh = next(iter(eng.fresh_returns))
+
+    releases_param = param_name = None
+    params = _param_names(fn)
+    released = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            bare = _bare(n)
+            if bare and resources.match_release(bare) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id in params:
+                released.add(n.func.value.id)
+    if len(released) == 1:
+        var = next(iter(released))
+        seed = {var: Handle("param", LIVE, fn.lineno, fn.lineno)}
+        eng = _Engine(fn, mod, base_ops, seed_params=seed)
+        eng.findings = {}
+        eng.run(graph)
+        if eng.seed_released and not eng.seed_leaked and not eng.truncated:
+            releases_param = params.index(var)
+            param_name = var
+    if returns_fresh is None and releases_param is None:
+        return None
+    return FnSummary(fn.name, returns_fresh, releases_param, param_name)
+
+
+def check_module(mod: ModuleInfo) -> list:
+    """MST401–MST404 findings for one module."""
+    base_ops = _Ops({})
+    summaries: dict = {}
+    for fn in _functions(mod.tree):
+        if _has_static_acquire(fn, {}) or any(
+                resources.match_release(_bare(n) or "")
+                for n in ast.walk(fn) if isinstance(n, ast.Call)):
+            s = _summarize(fn, mod, _Ops({}))
+            if s is not None:
+                summaries[s.name] = s
+
+    findings: list = []
+    for fn in _functions(mod.tree):
+        if not _has_static_acquire(fn, summaries):
+            continue
+        graph = cfglib.build_cfg(fn, may_raise=_may_raise)
+        if graph is None:
+            continue
+        eng = _Engine(fn, mod, _Ops(summaries))
+        eng.run(graph)
+        findings.extend(eng.findings.values())
+    return findings

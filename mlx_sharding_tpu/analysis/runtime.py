@@ -141,3 +141,119 @@ def enable_tracing() -> LockOrderRecorder:
 def disable_tracing():
     global _TRACE
     _TRACE = None
+
+
+# --------------------------------------------------------- leak ledger
+# Runtime cross-check for the static MST40x verifier, in the same shape
+# as make_lock/_TRACE: a module global that is None in production (the
+# note_* hooks are a single global read, then return) and a live
+# ResourceLedger under test. Serving modules report acquire/release of
+# every registry handle kind (analysis/resources.py); a test drives the
+# real composed stack, then asserts zero live handles at teardown —
+# mirroring how test_lock_order_dynamic.py validates the static lock
+# graph with a dynamically recorded one.
+
+_RESOURCES: Optional["ResourceLedger"] = None
+
+
+class ResourceLedger:
+    """Live-handle shadow ledger: every acquire must meet its release.
+
+    Keys are (kind, key) where ``kind`` comes from the resource registry
+    and ``key`` identifies one handle (``id(lease)``, ``(id(batcher),
+    slot)``, ...). Anomalies — release of a handle that isn't live, or a
+    second acquire of a live key — are recorded, never raised, so the
+    workload runs to completion and the test reports everything at once.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._live: dict[tuple, dict] = {}
+        self._acquired: dict[str, int] = {}
+        self._released: dict[str, int] = {}
+        self._anomalies: list[str] = []
+
+    def note_acquire(self, kind: str, key, **meta):
+        with self._mu:
+            k = (kind, key)
+            if k in self._live:
+                self._anomalies.append(
+                    f"double acquire of live handle {kind}:{key!r} {meta!r}")
+            self._live[k] = meta
+            self._acquired[kind] = self._acquired.get(kind, 0) + 1
+
+    def note_release(self, kind: str, key):
+        with self._mu:
+            if self._live.pop((kind, key), None) is None:
+                self._anomalies.append(
+                    f"release of non-live handle {kind}:{key!r} "
+                    "(double release, or release without acquire)")
+            self._released[kind] = self._released.get(kind, 0) + 1
+
+    def note_reset(self, kind: str, match=None):
+        """Bulk release: a container discarded its handles wholesale
+        (tier ``clear()``/``close()``, store ``drop_owner``). ``match``
+        filters on the handle key (callable key -> bool)."""
+        with self._mu:
+            for k in [k for k in self._live
+                      if k[0] == kind and (match is None or match(k[1]))]:
+                del self._live[k]
+                self._released[kind] = self._released.get(kind, 0) + 1
+
+    def live(self) -> dict:
+        with self._mu:
+            return dict(self._live)
+
+    def counts(self) -> dict:
+        with self._mu:
+            kinds = set(self._acquired) | set(self._released)
+            return {k: (self._acquired.get(k, 0), self._released.get(k, 0))
+                    for k in sorted(kinds)}
+
+    def anomalies(self) -> list:
+        with self._mu:
+            return list(self._anomalies)
+
+    def assert_clean(self, ignore: tuple = ()):
+        """Raise AssertionError naming every live handle and anomaly."""
+        live = [f"  live {kind}:{key!r} {meta!r}"
+                for (kind, key), meta in sorted(
+                    self.live().items(), key=lambda kv: str(kv[0]))
+                if kind not in ignore]
+        problems = live + [f"  anomaly: {a}" for a in self.anomalies()]
+        if problems:
+            counts = ", ".join(f"{k}={a}/{r}"
+                               for k, (a, r) in self.counts().items())
+            raise AssertionError(
+                f"leak ledger not clean at teardown ({counts}):\n"
+                + "\n".join(problems))
+
+
+def instrument_resources() -> ResourceLedger:
+    """Track handle acquire/release from here on; returns the ledger."""
+    global _RESOURCES
+    _RESOURCES = ResourceLedger()
+    return _RESOURCES
+
+
+def deinstrument_resources():
+    global _RESOURCES
+    _RESOURCES = None
+
+
+def note_acquire(kind: str, key, **meta):
+    led = _RESOURCES
+    if led is not None:
+        led.note_acquire(kind, key, **meta)
+
+
+def note_release(kind: str, key):
+    led = _RESOURCES
+    if led is not None:
+        led.note_release(kind, key)
+
+
+def note_reset(kind: str, match=None):
+    led = _RESOURCES
+    if led is not None:
+        led.note_reset(kind, match)

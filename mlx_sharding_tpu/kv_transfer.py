@@ -64,7 +64,12 @@ import jax
 import numpy as np
 
 from mlx_sharding_tpu import tracing
-from mlx_sharding_tpu.analysis.runtime import make_lock
+from mlx_sharding_tpu.analysis.runtime import (
+    make_lock,
+    note_acquire,
+    note_release,
+    note_reset,
+)
 from mlx_sharding_tpu.cache import export_pool_pages, import_pool_pages
 from mlx_sharding_tpu.testing.faults import inject
 
@@ -427,12 +432,15 @@ class KVSpillTier:
             old = self._blocks.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
+                note_release("tier.block", (id(self), key))
             while self._bytes + nb > self.budget_bytes and self._blocks:
-                _, evicted = self._blocks.popitem(last=False)
+                ek, evicted = self._blocks.popitem(last=False)
                 self._bytes -= evicted.nbytes
                 self.evictions += 1
+                note_release("tier.block", (id(self), ek))
             self._blocks[key] = block
             self._bytes += nb
+            note_acquire("tier.block", (id(self), key), nbytes=nb)
             self.bytes_spilled_total += nb
             if self._flush_async:
                 self._ensure_flusher()
@@ -449,6 +457,7 @@ class KVSpillTier:
             blk = self._blocks.pop(key, None)
             if blk is not None:
                 self._bytes -= blk.nbytes
+                note_release("tier.block", (id(self), key))
             return blk
 
     def take(self, key) -> Optional[KVPageBlock]:
@@ -486,6 +495,8 @@ class KVSpillTier:
         with self._lock:
             self._blocks.clear()
             self._bytes = 0
+            tid = id(self)
+            note_reset("tier.block", lambda k: k[0] == tid)
 
     def stats(self) -> dict:
         with self._lock:

@@ -51,7 +51,7 @@ from typing import Optional
 import numpy as np
 
 from mlx_sharding_tpu import tracing
-from mlx_sharding_tpu.analysis.runtime import make_lock
+from mlx_sharding_tpu.analysis.runtime import make_lock, note_acquire, note_release
 from mlx_sharding_tpu.kv_transfer import KVPageBlock, KVSpillTier
 from mlx_sharding_tpu.testing.faults import inject
 from mlx_sharding_tpu.utils.digests import chunk_digests
@@ -256,6 +256,7 @@ class PrefixStore:
             self.cow_forks += 1
             self.tokens_reused += n_tokens
             lease = PrefixLease(self, entry, cover, n_tokens)
+            note_acquire("prefix.lease", id(lease), cover=cover)
         tr = tracing.current()
         if tr is not None:
             # the COW fork on the admitting request's timeline: how many
@@ -324,7 +325,9 @@ class PrefixStore:
             entry.refs = 1
             self.inserts += 1
             self._seen.pop(full, None)
-            return PrefixLease(self, entry, len(digests), n_tok)
+            lease = PrefixLease(self, entry, len(digests), n_tok)
+            note_acquire("prefix.lease", id(lease), cover=len(digests))
+            return lease
 
     # ------------------------------------------------------------- release
     def _release(self, lease: PrefixLease) -> Optional[_DeviceEntry]:
@@ -335,6 +338,7 @@ class PrefixStore:
                     "discipline is broken (double-free of shared KV pages)"
                 )
             lease._released = True
+            note_release("prefix.lease", id(lease))
             entry = lease._entry
             if entry.dropped:
                 return None  # drop_owner already reclaimed it wholesale

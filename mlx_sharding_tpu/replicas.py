@@ -59,7 +59,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from mlx_sharding_tpu import tracing
-from mlx_sharding_tpu.analysis.runtime import make_lock
+from mlx_sharding_tpu.analysis.runtime import make_lock, note_acquire, note_release
 from mlx_sharding_tpu.utils.digests import chunk_digests
 from mlx_sharding_tpu.utils.observability import Histogram
 from mlx_sharding_tpu.resilience import (
@@ -328,6 +328,7 @@ class ReplicaSet:
                 i = half_open[0]
                 self._probing[i] = True
                 probe = True
+                note_acquire("replica.probe", (id(self), i))
             elif closed:
                 i = self._route(closed, depths, chunks, session, tight, hint)
                 self._remember_route(i, chunks, session)
@@ -349,6 +350,7 @@ class ReplicaSet:
                 # request, queue-full, consumer close, crash) — a leaked
                 # ticket would bar the replica from ever being probed again
                 self._probing[i] = False
+                note_release("replica.probe", (id(self), i))
 
     def _record_success(self, i: int):
         with self._lock:

@@ -61,7 +61,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from mlx_sharding_tpu import tracing
-from mlx_sharding_tpu.analysis.runtime import make_lock
+from mlx_sharding_tpu.analysis import runtime as mst_runtime
+from mlx_sharding_tpu.analysis.runtime import (
+    make_lock,
+    note_acquire,
+    note_release,
+    note_reset,
+)
 from mlx_sharding_tpu.cache import (
     KVCache,
     export_pool_pages,
@@ -91,6 +97,21 @@ from mlx_sharding_tpu.sample import (
     sample_token_batched,
     stack_sampler_params,
 )
+
+
+def _note_pages(owner, pages, *, acquired: bool):
+    """Leak-ledger shadow of a batch of free-list pops (acquired=True) or
+    returns. One global read when the ledger is off — the per-page loop
+    only runs under instrument_resources()."""
+    led = mst_runtime._RESOURCES
+    if led is None:
+        return
+    oid = id(owner)
+    for p in pages:
+        if acquired:
+            led.note_acquire("scheduler.page", (oid, p))
+        else:
+            led.note_release("scheduler.page", (oid, p))
 
 
 @dataclass(eq=False)  # identity semantics: requests key the spill tier
@@ -1006,7 +1027,7 @@ class ContinuousBatcher:
         locks never nest."""
         if not self.paged:
             return None
-        spill = self.spill  # mst: allow(MST201): bound once in __init__, never reassigned
+        spill = self.spill
         tier = spill.stats() if spill is not None else {}
         with self._admission_lock:
             out = {
@@ -1227,6 +1248,7 @@ class ContinuousBatcher:
             self._page_ref.pop(p, None)
             self._free_pages.append(p)
             self.prefix_evictions += 1
+            _note_pages(self, (p,), acquired=False)
 
     def _write_table_row(self, slot: int, pages: list):
         """Publish a slot's page mapping to the device table and bump the
@@ -1249,6 +1271,7 @@ class ContinuousBatcher:
             if r <= 0:
                 self._page_ref.pop(p, None)
                 self._free_pages.append(p)
+                _note_pages(self, (p,), acquired=False)
             else:
                 self._page_ref[p] = r
 
@@ -1316,11 +1339,24 @@ class ContinuousBatcher:
                 # _release_pages like any mapped page; the entry's claim
                 # (+1 at registration) outlives the slot
                 self._page_ref[p] += 1
-            self._evict_for(n - cover)
-            pages = list(lease.pages) + [
-                self._free_pages.pop() for _ in range(n - cover)
-            ]
-            for p in pages[cover:]:
+            tail: list[int] = []
+            try:
+                self._evict_for(n - cover)
+                for _ in range(n - cover):
+                    tail.append(self._free_pages.pop())
+            except BaseException:
+                # overcommit race: the headroom _fits saw evaporated
+                # before the tail allocation — give back the partial
+                # pops, the slot's claims and the COW lease, or the
+                # entry can never demote
+                self._free_pages.extend(tail)
+                for p in lease.pages:
+                    self._page_ref[p] -= 1
+                lease.release()
+                raise
+            _note_pages(self, tail, acquired=True)
+            pages = list(lease.pages) + tail
+            for p in tail:
                 self._page_ref[p] = 1
             req._please = lease
             return pages, lease.n_tokens
@@ -1331,6 +1367,7 @@ class ContinuousBatcher:
         store.count_lookup("host")
         self._evict_for(n)
         pages = [self._free_pages.pop() for _ in range(n)]
+        _note_pages(self, pages, acquired=True)
         for p in pages:
             self._page_ref[p] = 1
         page = self.engine.page_size
@@ -1502,10 +1539,10 @@ class ContinuousBatcher:
                     "is wedged; the thread is abandoned (daemon) and /health "
                     "now reports degraded", timeout,
                 )
-        spill = self.spill  # mst: allow(MST201): bound once in __init__, never reassigned
+        spill = self.spill
         if spill is not None:
             spill.close()
-        store = self.prefix_store  # mst: allow(MST201): bound once in __init__, never reassigned
+        store = self.prefix_store
         if store is not None:
             # drop this engine's device entries from the fleet store: the
             # pool backing those pages is going away with the engine, so
@@ -1513,12 +1550,17 @@ class ContinuousBatcher:
             # for the next admission. Host-tier blocks survive (they're
             # self-contained numpy) and keep serving other replicas.
             store.drop_owner(self)
+        # the page pool dies with the engine: index-resident prefix pages
+        # (legitimately out of the free list while the batcher lives) are
+        # discarded wholesale, so retire them from the leak ledger too
+        oid = id(self)
+        note_reset("scheduler.page", lambda k: k[0] == oid)
         # release engine-held resources (a shared-weight store lease drops
         # its ref here — drain/retire/hot-swap all funnel through close())
-        eng_close = getattr(self.engine, "close", None)  # mst: allow(MST201): bound once in __init__, never reassigned
+        eng_close = getattr(self.engine, "close", None)
         if eng_close is not None:
             eng_close()
-        draft = self.draft  # mst: allow(MST201): bound once in __init__, never reassigned
+        draft = self.draft
         if draft is not None and hasattr(draft, "close"):
             draft.close()
 
@@ -1600,6 +1642,7 @@ class ContinuousBatcher:
                     )
                     self._write_sampler_row(req, slot_arr)
                     self._slots[slot] = req
+                    note_acquire("scheduler.slot", (id(self), slot))
                     req.slot = slot
                     # prefill only the uncovered tail; the shared (or
                     # imported) prefix KV is already mapped to this slot
@@ -1625,6 +1668,7 @@ class ContinuousBatcher:
             pages = shared + [
                 self._free_pages.pop() for _ in range(n - len(shared))
             ]
+            _note_pages(self, pages[len(shared):], acquired=True)
             for p in pages[len(shared):]:
                 self._page_ref[p] = 1
             self._pages_of[slot] = pages
@@ -1645,6 +1689,7 @@ class ContinuousBatcher:
                 )
             )
         self._slots[slot] = req
+        note_acquire("scheduler.slot", (id(self), slot))
         req.slot = slot
         # prefill starts past the reused prefix — its KV is already mapped
         req.prefill_pos = reused_tokens
@@ -1715,6 +1760,7 @@ class ContinuousBatcher:
                     f"{len(self._free_pages)} free"
                 )
             pages = [self._free_pages.pop() for _ in range(need)]
+            _note_pages(self, pages, acquired=True)
             for p in pages:
                 self._page_ref[p] = 1
             # residency accounting, read BEFORE the import consumes the
@@ -1781,6 +1827,7 @@ class ContinuousBatcher:
         req.resume_recent = None
         req.history = [int(t) for t in block.history]
         self._slots[slot] = req
+        note_acquire("scheduler.slot", (id(self), slot))
         req.slot = slot
         req.prefill_pos = req.prompt.size
         req.draft_pos = req.prompt.size
@@ -1983,6 +2030,7 @@ class ContinuousBatcher:
                         self._put(jnp.asarray(self.decode_block, jnp.int32)),
                     )
             self._slots[req.slot] = None
+            note_release("scheduler.slot", (id(self), req.slot))
             req.slot = -1
         # completion stamp for the drain-rate Retry-After estimate; cancelled
         # reaps count too — they free queue capacity all the same
@@ -2139,6 +2187,7 @@ class ContinuousBatcher:
         # dispatch is safe here; re-admission re-plans against the store
         self._drop_prefix_lease(req)
         self._slots[slot] = None
+        note_release("scheduler.slot", (id(self), slot))
         req.slot = -1
         if tr is not None:
             tr.add("spill", t0, time.perf_counter(), slot=slot,
@@ -2176,8 +2225,6 @@ class ContinuousBatcher:
                 continue
             if not self._prefill_done(req) or not req.history:
                 continue  # mid-prefill slots have nothing to spill
-            # mst: allow(MST201): qsize is advisory; a racy undercount just
-            # delays the cold verdict by one scan
             backlog = req.out.qsize()
             consumed = req.produced - backlog
             if backlog > 0 and consumed == req._consumed_seen:
@@ -2197,6 +2244,14 @@ class ContinuousBatcher:
         async path quiesce first: suspension device_gets sampler rows and
         rewrites page tables, which must not race an in-flight block."""
         for req in cold:
+            if req.slot < 0:
+                # the async caller's quiesce drains the in-flight block
+                # AFTER the candidate scan, and that harvest can finish a
+                # cold slot (max_tokens landed). Suspending it then would
+                # release slot -1 — i.e. clobber self._slots[-1], dropping
+                # whichever live stream holds the last slot — and park a
+                # finished request for _wake_parked to re-admit.
+                continue
             with self._admission_lock:
                 self.cold_spills += 1
             tr = req._trace
@@ -2221,7 +2276,6 @@ class ContinuousBatcher:
                 self._drop_spill(req)
                 req.out.put(None)
                 continue
-            # mst: allow(MST201): racy read only delays the wake one tick
             if req.out.qsize() == 0:
                 woken.append(req)
             else:
@@ -2343,6 +2397,7 @@ class ContinuousBatcher:
             keys_h, recent_h = jax.device_get((self.keys, self.recent))
         for slot, req in admitted:
             self._slots[slot] = None
+            note_release("scheduler.slot", (id(self), slot))
             req.slot = -1
             if req.cancelled:
                 self._release_pages(slot)
@@ -2500,6 +2555,7 @@ class ContinuousBatcher:
             # the prefill pool next time this prefix arrives
             self._drop_prefix_lease(req)
             self._slots[slot] = None
+            note_release("scheduler.slot", (id(self), slot))
             req.slot = -1
             req.out.put(HandoffReadyError(state))
             with self._admission_lock:
@@ -2543,6 +2599,7 @@ class ContinuousBatcher:
                 self._evict_for(n_more)
                 if len(self._free_pages) >= n_more:
                     fresh = [self._free_pages.pop() for _ in range(n_more)]
+                    _note_pages(self, fresh, acquired=True)
                     for p in fresh:
                         self._page_ref[p] = 1
                     pages = self._pages_of[slot]
@@ -3062,10 +3119,13 @@ class ContinuousBatcher:
         # drop the lookahead block's futures (host-side); the wholesale
         # pool reset below reclaims whatever it was still writing
         self._inflight = None
+        failed: list = []
         for slot, req in enumerate(self._slots):
             if req is not None:
                 req.slot = -1
                 self._slots[slot] = None
+                note_release("scheduler.slot", (id(self), slot))
+                failed.append(req)
                 req.out.put(exc)
         self.active = self._zeros_like(self.active)
         if self.paged:
@@ -3075,12 +3135,20 @@ class ContinuousBatcher:
             self._page_ref.clear()
             self._prefix_index.clear()
             self._free_pages = list(range(self.engine.pool_pages - 1, -1, -1))
+            oid = id(self)
+            note_reset("scheduler.page", lambda k: k[0] == oid)
             if self.prefix_store is not None:
                 # the fleet store's device entries for THIS engine point at
                 # pages the wholesale reset just freed — drop them (marking
                 # any outstanding leases dead so late releases are no-ops);
                 # host-tier blocks are self-contained and stay valid
                 self.prefix_store.drop_owner(self)
+        for req in failed:
+            # the drop above orphaned the dead slots' entries; retire their
+            # leases through the normal idempotent path so the exactly-once
+            # contract (and the leak ledger) sees every lease come back.
+            # No demotion fires: a dropped entry's release returns None.
+            self._drop_prefix_lease(req)
         if self.spill is not None:
             # spilled blocks reference requests whose streams just died;
             # host DRAM back to the budget
@@ -3128,7 +3196,16 @@ class ContinuousBatcher:
         for slot, req in enumerate(self._slots):
             if req is not None:
                 self._slots[slot] = None
+                note_release("scheduler.slot", (id(self), slot))
                 req.slot = -1
+                # retire the slot's COW lease host-side: release WITHOUT
+                # demotion (an export here would be a device op, and in
+                # multi-host serving a one-rank collective entry). The
+                # returned last-ref entry is dropped — close() is about to
+                # drop_owner() the whole pool anyway.
+                lease, req._please = req._please, None
+                if lease is not None:
+                    lease.release()
                 req.out.put(None)
         for req in self._waiting:
             req.out.put(None)

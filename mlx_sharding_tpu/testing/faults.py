@@ -72,6 +72,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from mlx_sharding_tpu.analysis.runtime import note_acquire, note_release
+
 # safety bound on gate waits: a test that forgets to release its gate gets
 # a slow test, not a hung interpreter
 GATE_MAX_WAIT_S = 30.0
@@ -152,6 +154,7 @@ def arm(
               after=after, match=match)
     with _ARM_LOCK:
         _ARMED.setdefault(site, []).append(f)
+    note_acquire("faults.arm", id(f), site=site)
     return f
 
 
@@ -159,9 +162,12 @@ def disarm(site: Optional[str] = None):
     """Disarm one site, or everything when ``site`` is None."""
     with _ARM_LOCK:
         if site is None:
+            dropped = [f for lst in _ARMED.values() for f in lst]
             _ARMED.clear()
         else:
-            _ARMED.pop(site, None)
+            dropped = _ARMED.pop(site, [])
+    for f in dropped:
+        note_release("faults.arm", id(f))
 
 
 def inject(site: str, **ctx):

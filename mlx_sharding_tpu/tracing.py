@@ -42,7 +42,7 @@ import time
 from collections import deque
 from typing import Optional
 
-from mlx_sharding_tpu.analysis.runtime import make_lock
+from mlx_sharding_tpu.analysis.runtime import make_lock, note_acquire, note_release
 
 # the typed span vocabulary — one lane per type in the Chrome export
 SPAN_TYPES = (
@@ -374,10 +374,12 @@ class bind:
     def __enter__(self):
         self._prev = getattr(_TLS, "trace", None)
         _TLS.trace = self._tr
+        note_acquire("tracing.bind", id(self))
         return self._tr
 
     def __exit__(self, *exc):
         _TLS.trace = self._prev
+        note_release("tracing.bind", id(self))
         return False
 
 

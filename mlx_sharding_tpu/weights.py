@@ -37,7 +37,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-from mlx_sharding_tpu.analysis.runtime import make_lock
+from mlx_sharding_tpu.analysis.runtime import make_lock, note_acquire, note_release
 
 
 @dataclass
@@ -104,6 +104,7 @@ class WeightLease:
                 f"weight lease for {self.key.checkpoint!r} released twice"
             )
         self._released = True
+        note_release("weights.lease", id(self))
         return self.store.release(self.key)
 
 
@@ -136,7 +137,9 @@ class WeightStore:
                 entry = _Entry(build())
                 self._entries[key] = entry
             entry.refs += 1
-            return WeightLease(self, key, entry.weights)
+            lease = WeightLease(self, key, entry.weights)
+            note_acquire("weights.lease", id(lease), checkpoint=key.checkpoint)
+            return lease
 
     def release(self, key: WeightKey) -> bool:
         """Drop one ref on ``key``; the last release frees the store's

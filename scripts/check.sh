@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# One-command pre-merge gate: lint + incremental mstcheck self-scan +
+# the static-analysis fixture corpus and runtime leak-ledger tests.
+#
+#   scripts/check.sh            # everything (warm mstcheck run is ~10ms)
+#   scripts/check.sh --no-cache # force a full (cold) self-scan
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MSTCHECK_ARGS=()
+for arg in "$@"; do
+    MSTCHECK_ARGS+=("$arg")
+done
+
+# 1. ruff — optional: the container image does not ship it, and the gate
+#    must not require anything pip-installed.
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff =="
+    ruff check mlx_sharding_tpu/ tests/
+else
+    echo "== ruff == (not installed; skipping lint)"
+fi
+
+# 2. incremental self-scan: per-file results cached by content hash in
+#    .mstcheck-cache.json, invalidated wholesale when the checker changes.
+echo "== mstcheck (incremental self-scan) =="
+python -m mlx_sharding_tpu.analysis mlx_sharding_tpu/ "${MSTCHECK_ARGS[@]+"${MSTCHECK_ARGS[@]}"}"
+
+# 3. fixture gate + leak ledger: every rule fires on its known-bad
+#    fixture, and the composed stack leaves zero live handles.
+echo "== fixture corpus + resource ledger =="
+env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_static_analysis.py tests/test_resource_ledger.py -q
+
+echo "check.sh: all gates passed"

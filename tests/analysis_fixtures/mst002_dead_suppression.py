@@ -1,0 +1,6 @@
+"""MST002: a suppression whose finding no longer fires is dead weight."""
+
+
+def snapshot(counter):
+    # mst: allow(MST201): bound once in __init__, never reassigned
+    return counter

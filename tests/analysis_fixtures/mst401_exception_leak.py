@@ -1,0 +1,11 @@
+"""MST401: a lease leaks when an exception unwinds past its acquire."""
+
+
+def admit(store, owner, digests, pages):
+    lease = store.register(owner, digests, pages, digests, 64)
+    broadcast(pages)  # may raise: the lease never reaches release()
+    lease.release()
+
+
+def broadcast(pages):
+    raise RuntimeError("table write failed")

@@ -1,0 +1,8 @@
+"""MST402: the exactly-once release contract, broken on one path."""
+
+
+def demote(store, owner, digests, pages, urgent):
+    lease = store.register(owner, digests, pages, digests, 64)
+    if urgent:
+        lease.release()
+    lease.release()

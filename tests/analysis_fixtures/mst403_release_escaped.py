@@ -1,0 +1,7 @@
+"""MST403: releasing a handle whose ownership was already handed off."""
+
+
+def handoff(store, owner, digests, pages, registry):
+    lease = store.register(owner, digests, pages, digests, 64)
+    registry["lease"] = lease  # ownership transferred to the registry
+    lease.release()  # not ours to release any more

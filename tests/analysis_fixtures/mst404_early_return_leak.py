@@ -1,0 +1,9 @@
+"""MST404: the release exists, but an early-return arm skips it."""
+
+
+def maybe_admit(store, owner, digests, pages, fast_path):
+    lease = store.register(owner, digests, pages, digests, 64)
+    if fast_path:
+        return None  # forgot the lease on this arm
+    lease.release()
+    return True

@@ -432,3 +432,27 @@ def test_cold_spill_parity_sweep(kv_dtype, async_sched, fault):
     finally:
         faults.disarm()
         batcher.close()
+
+
+def test_spill_cold_skips_candidate_unslotted_by_the_quiesce(residency_env):
+    """Regression: the async tick scans cold candidates BEFORE quiescing,
+    and the quiesce's harvest can finish a candidate (its max_tokens lands
+    in the drained block), leaving ``req.slot == -1``. ``_spill_cold``
+    must skip such a request — suspending it would release slot -1
+    (clobbering ``self._slots[-1]``, i.e. whatever live stream holds the
+    last slot) and park an already-finished request for ``_wake_parked``
+    to re-admit. The window is harvest-timing dependent, so this pins the
+    guard directly with an unslotted candidate."""
+    from types import SimpleNamespace
+
+    eng, _ = residency_env
+    batcher = _residency_batcher(eng)
+    try:
+        finished = SimpleNamespace(slot=-1, _trace=None)
+        before = batcher.spill_stats()
+        batcher._spill_cold([finished])
+        after = batcher.spill_stats()
+        assert after["cold_spills"] == before["cold_spills"]
+        assert after["parked"] == before["parked"]
+    finally:
+        batcher.close()

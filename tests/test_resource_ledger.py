@@ -1,0 +1,235 @@
+"""Runtime leak ledger (the MST40x verifier's dynamic cross-check).
+
+``analysis.runtime.instrument_resources()`` turns every handle kind in the
+resource registry — weight leases, prefix COW leases, breaker probe
+tickets, slot/page allocations, spill-tier residency, fault arms, tracing
+binds — into a live-handle set, the same way ``enable_tracing()`` turns
+``make_lock`` locks into a dynamic lock-order graph. The contract under
+test: driving the real composed stack (prefix store + cold-spill +
+breaker probes + an autoscaler-style weight-lease storm, with a fault
+armed mid-flight) leaves ZERO live handles and zero anomalies at
+teardown; and a seeded leak is reported by name, so the assertion has
+teeth.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.analysis import runtime as mst_runtime
+from mlx_sharding_tpu.analysis.resources import RUNTIME_KINDS
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+from mlx_sharding_tpu.prefix_store import PrefixStore
+from mlx_sharding_tpu.replicas import ReplicaSet
+from mlx_sharding_tpu.scheduler import ContinuousBatcher
+from mlx_sharding_tpu.testing import faults
+from mlx_sharding_tpu.weights import WeightKey, WeightStore, aliased_spawn
+from tests.helpers import hard_timeout
+
+TINY = dict(
+    vocab_size=256,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+PAGE = 8
+BASE = [7, 7, 2, 1, 9, 4, 4, 6, 3, 17, 42, 5, 11, 2, 2, 8]
+
+KEY = WeightKey(checkpoint="ck", stage_bounds=(("auto", 1),),
+                dtype="float32", quant="tp1", placement="pp=1|0")
+
+
+class _Tree:
+    weight_bytes = 100
+
+
+class _StubReplica:
+    """Scriptable replica: fails on demand, else yields a fixed stream."""
+
+    concurrent = True
+
+    def __init__(self):
+        self.fail = False
+
+    def generate_step(self, prompt_tokens, **kw):
+        if self.fail:
+            raise RuntimeError("injected replica crash")
+        yield from [(t, None) for t in (1, 2, 3)]
+
+
+@pytest.fixture()
+def ledger():
+    led = mst_runtime.instrument_resources()
+    try:
+        yield led
+    finally:
+        mst_runtime.deinstrument_resources()
+        faults.disarm()
+
+
+# ----------------------------------------------------------- ledger unit
+def test_ledger_semantics(ledger):
+    ledger.note_acquire("weights.lease", 1, checkpoint="ck")
+    ledger.note_acquire("weights.lease", 2)
+    ledger.note_release("weights.lease", 1)
+    assert ledger.counts() == {"weights.lease": (2, 1)}
+    assert list(ledger.live()) == [("weights.lease", 2)]
+    with pytest.raises(AssertionError, match="weights.lease:2"):
+        ledger.assert_clean()
+    ledger.assert_clean(ignore=("weights.lease",))  # scoped escape hatch
+    ledger.note_release("weights.lease", 2)
+    ledger.assert_clean()
+
+
+def test_ledger_records_anomalies_without_raising(ledger):
+    ledger.note_acquire("tier.block", (1, "d"))
+    ledger.note_acquire("tier.block", (1, "d"))   # double acquire
+    ledger.note_release("tier.block", (1, "d"))
+    ledger.note_release("tier.block", (1, "d"))   # double release
+    assert len(ledger.anomalies()) == 2
+    with pytest.raises(AssertionError, match="double release"):
+        ledger.assert_clean()
+
+
+def test_note_reset_filters_by_owner(ledger):
+    ledger.note_acquire("scheduler.page", (10, 0))
+    ledger.note_acquire("scheduler.page", (10, 1))
+    ledger.note_acquire("scheduler.page", (20, 0))
+    ledger.note_reset("scheduler.page", lambda k: k[0] == 10)
+    assert list(ledger.live()) == [("scheduler.page", (20, 0))]
+    assert ledger.counts()["scheduler.page"] == (3, 2)
+
+
+def test_hooks_are_noops_when_uninstrumented():
+    assert mst_runtime._RESOURCES is None
+    # must not raise, must not allocate a ledger
+    mst_runtime.note_acquire("weights.lease", 1)
+    mst_runtime.note_release("weights.lease", 1)
+    mst_runtime.note_reset("weights.lease")
+    assert mst_runtime._RESOURCES is None
+
+
+# ------------------------------------------------------- seeded regression
+def test_seeded_leak_is_reported_by_name(ledger):
+    """The assertion has teeth: a lease acquired and never released fails
+    teardown naming the kind; releasing it makes the same check pass."""
+    store = WeightStore()
+    lease = store.acquire(KEY, _Tree)
+    with pytest.raises(AssertionError, match=r"live weights\.lease"):
+        ledger.assert_clean()
+    lease.release()
+    ledger.assert_clean()
+
+
+# ---------------------------------------------------- composed-stack zero
+@hard_timeout(420)
+def test_composed_stack_leaves_zero_live_handles(ledger):
+    """The flagship invariant: prefix-store COW + host-tier demotion +
+    cold-slot spill + breaker probe cycle + a concurrent weight-lease
+    storm (with a faulted spawn and a mid-flight injected lookup fault),
+    and at teardown every handle kind the registry knows is back."""
+    # --- autoscaler-style weight-lease storm: concurrent spawns alias
+    # one tree; one spawn faults mid-construction and must self-release
+    wstore = WeightStore()
+    leases = [None] * 6
+
+    def spawn(i):
+        leases[i] = wstore.acquire(KEY, _Tree)
+
+    threads = [threading.Thread(target=spawn, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    def boom(lease):
+        raise RuntimeError("spawn fault")
+
+    with pytest.raises(RuntimeError, match="spawn fault"):
+        aliased_spawn(wstore, KEY, _Tree, boom)
+    for ls in leases:
+        ls.release()
+
+    # --- breaker probe tickets: open, failed probe (ticket back), healed
+    # probe (ticket back again)
+    r0, r1 = _StubReplica(), _StubReplica()
+    rs = ReplicaSet([r0, r1], breaker_threshold=1, probe_interval=0.15)
+    r0.fail = True
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]  # failover
+    time.sleep(0.2)  # half-open: next request is the probe, and it fails
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]
+    time.sleep(0.2)
+    r0.fail = False
+    assert [t for t, _ in rs.generate_step([1])] == [1, 2, 3]  # probe heals
+    assert rs.health()["status"] == "ok"
+
+    # --- real engine: prefix store + cold spill composed on one batcher
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    eng = PipelineEngine(
+        model, params, pipeline_mesh(1), microbatches=2, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8,
+        pool_pages=8, page_size=PAGE,
+    )
+    store = PrefixStore(host_bytes=64 << 20)
+    batcher = ContinuousBatcher(
+        eng, decode_block=3, prefix_store=store, overcommit=True,
+        spill_bytes=64 << 20, spill_cold_after=2, kv_prefetch="on",
+    )
+    try:
+        # job 1 registers the hot prefix; its finish demotes the entry to
+        # the host tier (tier.block put). The consumer stalls after the
+        # first token so the slot goes cold and spills (more tier traffic).
+        toks: list = []
+        stall = threading.Event()
+
+        def consume():
+            for i, (t, _) in enumerate(
+                    batcher.generate_step(BASE + [5], max_tokens=24)):
+                toks.append(t)
+                if i == 0:
+                    stall.wait()
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if batcher.spill_stats()["cold_spills"] > 0:
+                break
+            time.sleep(0.02)
+        assert batcher.spill_stats()["cold_spills"] > 0, "slot never cold"
+        stall.set()
+        th.join(timeout=90)
+        assert not th.is_alive() and len(toks) == 24
+
+        # job 2 reuses the prefix; a lookup fault injected mid-flight
+        # degrades it to plain prefill (the lease paths must still balance)
+        faults.arm("cache.prefix_lookup", exc=faults.FaultError, times=1)
+        assert len(list(batcher.generate_step(BASE + [9],
+                                              max_tokens=8))) == 8
+        faults.disarm()
+        # job 3, fault gone: served through the store again
+        assert len(list(batcher.generate_step(BASE + [3],
+                                              max_tokens=8))) == 8
+    finally:
+        batcher.close()
+        store.close()
+
+    # every registry kind was actually exercised...
+    counts = ledger.counts()
+    for kind in RUNTIME_KINDS:
+        acq, rel = counts.get(kind, (0, 0))
+        assert acq > 0, f"composed workload never exercised {kind}"
+    # ...and every handle came back: zero live, zero anomalies
+    ledger.assert_clean()

@@ -52,6 +52,11 @@ EXPECTED = {
     "mst303_unknown_fault_site.py": ("MST303", 6, 4),
     "mst304/scheduler.py": ("MST304", 1, 0),
     "mst112_trace_hot_path.py": ("MST112", 11, 4),
+    "mst002_dead_suppression.py": ("MST002", 5, 0),
+    "mst401_exception_leak.py": ("MST401", 6, 0),
+    "mst402_double_release.py": ("MST402", 8, 4),
+    "mst403_release_escaped.py": ("MST403", 7, 4),
+    "mst404_early_return_leak.py": ("MST404", 7, 0),
 }
 
 
@@ -197,3 +202,128 @@ def test_write_baseline_cli_roundtrip(tmp_path):
     assert main([str(bad), "--baseline", str(baseline_path)]) == 0
     assert main([str(bad), "--baseline", str(baseline_path),
                  "--no-baseline"]) == 1
+
+
+def test_stale_baseline_entry_is_mst003_hard_error(tmp_path):
+    """Fixing the grandfathered bug must surface the baseline entry as a
+    hard error with the regeneration hint — never silent rot."""
+    bad = tmp_path / "counter.py"
+    bad.write_text((FIXTURES / "mst201_unlocked_attr.py").read_text())
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(baseline_path, analyze_paths([str(bad)],
+                                                baseline=None).findings)
+    bad.write_text("x = 1\n")  # the bug is gone; the entry goes stale
+    report = analyze_paths([str(bad)], baseline=load_baseline(baseline_path),
+                           baseline_path=baseline_path)
+    assert [f.rule for f in report.findings] == ["MST003"]
+    f = report.findings[0]
+    assert f.path == str(baseline_path)
+    assert "--write-baseline" in f.message and "MST201" in f.message
+    assert main([str(bad), "--baseline", str(baseline_path)]) == 1
+
+
+# ------------------------------------------------- incremental cache
+def test_incremental_cache_reuses_and_invalidates(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text((FIXTURES / "mst201_unlocked_attr.py").read_text())
+    cache = tmp_path / "cache.json"
+
+    cold = analyze_paths([str(src)], baseline=None, cache_path=cache)
+    assert (cold.cache_hits, cold.cache_misses) == (0, 1)
+    warm = analyze_paths([str(src)], baseline=None, cache_path=cache)
+    assert (warm.cache_hits, warm.cache_misses) == (1, 0)
+    # cached facts reproduce the finding exactly
+    assert [(f.rule, f.line, f.col) for f in warm.findings] == \
+        [(f.rule, f.line, f.col) for f in cold.findings]
+
+    src.write_text("x = 1\n")  # content hash changes -> full recheck
+    fixed = analyze_paths([str(src)], baseline=None, cache_path=cache)
+    assert (fixed.cache_hits, fixed.cache_misses) == (0, 1)
+    assert fixed.findings == []
+
+
+def test_cache_preserves_suppressions(tmp_path):
+    src = tmp_path / "counter.py"
+    src.write_text(
+        "import threading\n\n\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._count = 0\n\n"
+        "    def incr(self):\n"
+        "        with self._lock:\n"
+        "            self._count += 1\n\n"
+        "    def snapshot(self):\n"
+        "        # mst: allow(MST201): racy read is fine for a gauge\n"
+        "        return self._count\n"
+    )
+    cache = tmp_path / "cache.json"
+    assert analyze_paths([str(src)], baseline=None,
+                         cache_path=cache).findings == []
+    warm = analyze_paths([str(src)], baseline=None, cache_path=cache)
+    assert warm.cache_hits == 1 and warm.findings == []
+
+
+def test_cli_json_format_reports_cache_and_registry(tmp_path, capsys):
+    fixture = FIXTURES / "mst402_double_release.py"
+    cache = tmp_path / "cache.json"
+    assert main([str(fixture), "--format", "json",
+                 "--cache", str(cache)]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["files_scanned"] == 1 and out["cache_misses"] == 1
+    assert [f["rule"] for f in out["findings"]] == ["MST402"]
+    kinds = {r["kind"] for r in out["resource_registry"]}
+    assert {"prefix.lease", "weights.lease", "replica.probe",
+            "scheduler.page"} <= kinds
+    # warm run serves the same findings from the cache
+    assert main([str(fixture), "--format", "json",
+                 "--cache", str(cache)]) == 1
+    out2 = json.loads(capsys.readouterr().out)
+    assert out2["cache_hits"] == 1
+    assert out2["findings"] == out["findings"]
+
+
+# --------------------------------------------- MST40x path sensitivity
+def test_mst40x_clean_idioms_stay_clean(tmp_path):
+    """The verifier must be quiet on the repo's own disciplined shapes:
+    try/finally, None-refined early return, release delegated to a helper
+    (interprocedural summary), and ownership transfer via return."""
+    good = tmp_path / "clean.py"
+    good.write_text(
+        "def protected(store, owner, digests, pages):\n"
+        "    lease = store.register(owner, digests, pages, digests, 64)\n"
+        "    try:\n"
+        "        broadcast(pages)\n"
+        "    finally:\n"
+        "        lease.release()\n"
+        "\n\n"
+        "def optional(store, owner, digests, pages):\n"
+        "    lease = store.register(owner, digests, pages, digests, 64)\n"
+        "    if lease is None:\n"
+        "        return None\n"
+        "    try:\n"
+        "        broadcast(pages)\n"
+        "    finally:\n"
+        "        lease.release()\n"
+        "    return True\n"
+        "\n\n"
+        "def delegated(store, owner, digests, pages):\n"
+        "    lease = store.register(owner, digests, pages, digests, 64)\n"
+        "    _finish(lease)\n"
+        "\n\n"
+        "def _finish(lease):\n"
+        "    lease.release()\n"
+        "\n\n"
+        "def spawn(store, owner, digests, pages, make_engine):\n"
+        "    lease = store.register(owner, digests, pages, digests, 64)\n"
+        "    try:\n"
+        "        return make_engine(lease)\n"
+        "    except BaseException:\n"
+        "        lease.release()\n"
+        "        raise\n"
+        "\n\n"
+        "def broadcast(pages):\n"
+        "    raise RuntimeError\n"
+    )
+    report = analyze_paths([str(good)], baseline=None)
+    assert report.findings == [], [f.render() for f in report.findings]
