@@ -1435,6 +1435,169 @@ def measure_disagg_prefill_decode(model, params, label: str) -> dict:
     return res
 
 
+def measure_pod_fleet(model, params, label: str) -> dict:
+    """Pod-scale multihost smoke (ISSUE 15 tentpole) over the loopback
+    fabric: two simulated hosts, each holding ONE packed weight tree that
+    both of its local engines alias (the pod weight bytes are
+    N_hosts x W, not N_replicas x W), a cross-host prefill→decode handoff
+    stream (serialized KVPageBlock over the pod wire, tokens relayed
+    back), and a host-kill storm — the remote host goes silent mid-relay
+    and every stream must drain onto the origin with zero drops. Records
+    the aliased/naive weight-byte ratio, handoff first-token latency
+    p50/p99, relayed decode tok/s, and the storm's completion count."""
+    import threading
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.disagg import DisaggCoordinator
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import (
+        PipelineEngine,
+        place_weights,
+    )
+    from mlx_sharding_tpu.pod import LoopbackHub, PodFleet
+    from mlx_sharding_tpu.replicas import ReplicaSet
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+    from mlx_sharding_tpu.weights import WeightKey, WeightStore
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return dict(label=label, skipped="needs 2 devices")
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(23)
+    prompts = [
+        [int(x) for x in rng.integers(1, vocab - 64, 16)] for _ in range(4)
+    ]
+    kw = dict(max_tokens=24)
+
+    # one packed tree per "host", aliased by both of that host's engines
+    stores = {0: WeightStore(), 1: WeightStore()}
+    leases = []
+
+    def aliased_batcher(host):
+        dev = devices[host:host + 1]
+        mesh = make_mesh(pp=1, devices=dev)
+        key = WeightKey(checkpoint="bench-pod", stage_bounds=(("auto", 1),),
+                       dtype="bfloat16", quant="none",
+                       placement=f"pod-host-{host}")
+        lease = stores[host].acquire(
+            key, lambda: place_weights(model, params, mesh))
+        leases.append(lease)
+        eng = PipelineEngine(
+            model, None, lease.weights.mesh, weights=lease.weights,
+            microbatches=2, max_seq=256, cache_dtype=jnp.bfloat16,
+            prefill_chunk=16, pool_pages=24, page_size=16,
+        )
+        eng.on_close(lease.release)
+        return ContinuousBatcher(eng, decode_block=4)
+
+    co = DisaggCoordinator(
+        ReplicaSet([aliased_batcher(0)], role="prefill"),
+        ReplicaSet([aliased_batcher(0)], role="decode"),
+    )
+    b1 = aliased_batcher(1)
+    _idle = aliased_batcher(1)  # second local ref proves the aliasing
+
+    weight_meta = {}
+    for host, store in stores.items():
+        st = store.stats()
+        weight_meta[f"host{host}"] = dict(
+            trees=st["trees"], refs=st["refs"], bytes=st["bytes"])
+    pod_bytes = sum(m["bytes"] for m in weight_meta.values())
+    naive_bytes = sum(m["bytes"] * m["refs"] for m in weight_meta.values())
+
+    def run_pod(kill_after_tokens=None):
+        """Serve every prompt through the pod; optionally go silent after
+        N relayed tokens (the host-death drain)."""
+        hub = LoopbackHub()
+        f0 = PodFleet(0, hub.register(0), co)
+        f1 = PodFleet(1, hub.register(1), b1)
+        f0.tick()
+        f1.tick()
+        f0.start()  # keep heartbeats fresh while the streams run
+        f1.start()
+        f0.handoff.local_pressure = lambda: 1.0
+        if kill_after_tokens is not None:
+            f0.handoff.relay_timeout_s = 1.0
+            orig = hub._handlers[0]
+            relayed = [0]
+
+            def silent(src, kind, payload):
+                if kind == "pod.tok":
+                    relayed[0] += 1
+                    if relayed[0] > kill_after_tokens:
+                        return
+                elif kind == "pod.end":
+                    return
+                orig(src, kind, payload)
+
+            hub._handlers[0] = silent
+        done = []
+        errors = []
+
+        def worker(p):
+            try:
+                done.append(len([t for t, _ in co.generate_step(p, **kw)]))
+            except Exception as e:  # noqa: BLE001 — a drop, counted
+                errors.append(repr(e)[:120])
+
+        t0 = _time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = _time.perf_counter() - t0
+        stats = f0.handoff.stats()
+        f0.close(close_local=False)
+        f1.close(close_local=False)
+        co.pod = None
+        return done, errors, dt, stats
+
+    try:
+        # steady state: every decode leg relayed from the remote host
+        done, errors, dt, h = run_pod()
+        steady = dict(
+            completed=len(done), dropped=len(errors),
+            shipped=h["shipped"], bytes_shipped=h["bytes_shipped"],
+            relayed_tokens=h["relayed_tokens"],
+            first_token_ms_p50=round(h["ms_p50"], 2) if h["ms_p50"] else None,
+            first_token_ms_p99=round(h["ms_p99"], 2) if h["ms_p99"] else None,
+            relayed_tps=round(h["relayed_tokens"] / max(dt, 1e-9), 2),
+            fallbacks=h["fallbacks"],
+        )
+        # host-kill storm: remote goes silent after 2 relayed tokens per
+        # stream — every stream must drain locally, token-exact, no drops
+        done, errors, dt, h = run_pod(kill_after_tokens=2)
+        storm = dict(
+            completed=len(done), dropped=len(errors),
+            fallbacks=h["fallbacks"], wall_s=round(dt, 2),
+        )
+    finally:
+        co.close()
+        b1.close()
+        _idle.close()
+
+    res = dict(
+        label=label, weights=weight_meta,
+        pod_weight_bytes=pod_bytes, naive_weight_bytes=naive_bytes,
+        weight_bytes_saved_frac=round(1 - pod_bytes / max(naive_bytes, 1), 3),
+        steady=steady, kill_storm=storm,
+    )
+    log(f"[{label}] pod weights {pod_bytes / 2**20:.1f}MiB aliased vs "
+        f"{naive_bytes / 2**20:.1f}MiB naive; handoff first-token "
+        f"p50={steady['first_token_ms_p50']}ms "
+        f"p99={steady['first_token_ms_p99']}ms "
+        f"relayed {steady['relayed_tps']} tok/s; kill storm "
+        f"{storm['completed']}/{len(prompts)} drained, "
+        f"dropped={storm['dropped']}")
+    return res
+
+
 def measure_paged_ragged_vs_gather(model, params, label: str) -> dict:
     """The ragged paged-attention A/B (ISSUE 1 tentpole): mixed-length
     continuous batching decode through the same page pool on both paths.
@@ -2288,6 +2451,13 @@ def main() -> int:
                 detail["disagg_prefill_decode_cpu"] = dict(error=repr(e)[:300])
                 log(f"[disagg_prefill_decode_cpu] FAILED: {e!r}")
             try:
+                detail["pod_fleet_cpu"] = measure_pod_fleet(
+                    m2, p2, "pod_fleet_cpu"
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["pod_fleet_cpu"] = dict(error=repr(e)[:300])
+                log(f"[pod_fleet_cpu] FAILED: {e!r}")
+            try:
                 detail["trace_overhead_cpu"] = measure_trace_overhead(
                     m2, p2, "trace_overhead_cpu"
                 )
@@ -2554,6 +2724,16 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["disagg_prefill_decode"] = dict(error=repr(e)[:300])
             log(f"[disagg_prefill_decode] FAILED: {e!r}")
+        gc.collect()
+        try:
+            # loopback 2-"host" pod smoke on one real chip pair: aliased
+            # weight bytes, cross-host handoff latency, kill-storm drain
+            detail["pod_fleet"] = measure_pod_fleet(
+                model, params, "pod_fleet"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["pod_fleet"] = dict(error=repr(e)[:300])
+            log(f"[pod_fleet] FAILED: {e!r}")
 
         # HEADLINE (BASELINE.json primary config): DeepSeek-Coder-V2-Lite at
         # its real architecture and scale — 27 layers, 64-expert MoE + 2

@@ -51,6 +51,10 @@ KNOWN_FAULT_SITES = {
     # content-addressed prefix store (prefix_store.py): the admission-time
     # LPM probe — must degrade to plain prefill, never a wrong stream
     "cache.prefix_lookup",
+    # pod fleet (pod.py): the cross-host prefill→decode handoff control
+    # point — must degrade to the single-host plan (serve-in-place or
+    # blockless re-prefill), never a dropped stream
+    "pod.handoff",
 }
 # basename -> the inject() site that file must keep calling
 REQUIRED_FAULT_SITES = {
@@ -62,6 +66,7 @@ REQUIRED_FAULT_SITES = {
     "kv_transfer.py": "cache.export",
     "disagg.py": "disagg.handoff",
     "prefix_store.py": "cache.prefix_lookup",
+    "pod.py": "pod.handoff",
 }
 
 

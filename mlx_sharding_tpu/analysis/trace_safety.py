@@ -104,6 +104,16 @@
   argument marshalling and lock traffic on every decode block even with
   ``--trace off`` — exactly the regression the ``trace_overhead`` bench
   phase exists to catch, caught here statically instead.
+- **MST113 control-plane-in-tick** — a blocking control-plane collective
+  (``<plane>.exchange(...)``, ``<plane>.heartbeat(...)``,
+  ``<plane>.pod_exchange(...)``) inside a tick-hot function. A collective
+  is a cross-host rendezvous: it completes when the slowest host arrives
+  or after the plane timeout when one never does, so inline in the tick it
+  wedges every live slot's decode behind the slowest peer — and a dead
+  peer freezes the fleet for the full timeout. Collectives belong on the
+  dedicated transport/heartbeat threads; the tick reads the gossiped
+  snapshot. An intentional inline rendezvous carries its own
+  ``# mst: allow(MST113): …``.
 - **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
   timeout arithmetic (an expression whose identifiers mention deadline /
   timeout / expiry / until / budget / ttft / retry_after / lease). The wall
@@ -167,6 +177,12 @@ SPILL_PRODUCER_PREFIXES = ("export_block", "export_pool_pages")
 # the block-migration primitives MST108 keeps out of tick-hot functions:
 # whole-request page-chain gathers/scatters (kv_transfer.py)
 MIGRATION_CALLS = {"export_block", "import_block"}
+
+# the blocking control-plane collectives MST113 keeps out of tick-hot
+# functions: each is a cross-host rendezvous bounded only by the plane's
+# timeout (multihost.py ControlPlane.exchange / PodControlPlane.pod_exchange,
+# and the heartbeat wrappers over them)
+CONTROL_PLANE_CALLS = {"exchange", "heartbeat", "pod_exchange"}
 
 # host→device upload calls MST109 polices in tick-hot functions when their
 # argument is a spilled block's page payload (the demand-paged resume)
@@ -487,6 +503,42 @@ def _check_block_migration(mod: ModuleInfo) -> list[Finding]:
                 f"{name.split('.')[-1]}() gathers/scatters a whole page "
                 "chain per request — park the request on the tick and run "
                 "the migration from a non-hot helper or the flusher thread",
+                context=qualname_for_line(mod.tree, node.lineno),
+            ))
+    return findings
+
+
+def _check_control_plane_in_tick(mod: ModuleInfo) -> list[Finding]:
+    """MST113: a blocking control-plane collective (``exchange`` /
+    ``heartbeat`` / ``pod_exchange``) inside a tick-hot function. A
+    collective is a cross-host rendezvous: it returns when the SLOWEST
+    host arrives, or after the plane's timeout (seconds to minutes) when
+    one never does — so one call inline in the tick wedges every live
+    slot's decode behind a peer's GC pause, and a dead peer freezes the
+    whole fleet for the full timeout instead of one heartbeat thread. The
+    pod discipline runs every collective on its own daemon thread
+    (``mst-pod-transport``) and lets the tick read the gossiped snapshot;
+    an intentional inline rendezvous carries its own
+    ``# mst: allow(MST113): …``."""
+    findings = []
+    for fn in _hot_functions(mod):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or "." not in name:
+                continue  # bare exchange()/heartbeat() locals are not the
+                # plane surface — the collective always rides a plane object
+            if name.split(".")[-1] not in CONTROL_PLANE_CALLS:
+                continue
+            findings.append(Finding(
+                "MST113", mod.display_path, node.lineno, node.col_offset,
+                f"blocking control-plane collective in hot path "
+                f"{fn.name}(): {name}() is a cross-host rendezvous bounded "
+                "only by the plane timeout — run it on the pod transport "
+                "thread and let the tick read the gossiped snapshot",
                 context=qualname_for_line(mod.tree, node.lineno),
             ))
     return findings
@@ -901,6 +953,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_double_harvest(mod)
     findings += _check_sync_spill(mod)
     findings += _check_block_migration(mod)
+    findings += _check_control_plane_in_tick(mod)
     findings += _check_sync_import(mod)
     findings += _check_store_import(mod)
     findings += _check_hot_trace_overhead(mod)

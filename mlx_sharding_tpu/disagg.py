@@ -119,6 +119,11 @@ class DisaggCoordinator:
                 )
         self.prefill = prefill_pool
         self.decode = decode_pool
+        # pod-scale cross-host handoff (pod.PodHandoff), attached by the
+        # pod fleet after construction: when set, phase 2 may ship the
+        # block to a less-loaded REMOTE decode host instead of the local
+        # decode pool, with the same never-drop degradation ladder
+        self.pod = None
         # fleet-wide prefix store (optional): when the WHOLE prompt is
         # already covered — a device entry on some decode replica or a
         # host-tier block — phase 1 is pure overhead, so generate_step
@@ -161,6 +166,12 @@ class DisaggCoordinator:
     def _count(self, kind: str):
         with self._lock:
             self.fallbacks[kind] = self.fallbacks.get(kind, 0) + 1
+
+    def attach_pod(self, pod_handoff) -> None:
+        """Wire the cross-host leg in (pod.PodFleet calls this): phase 2
+        consults ``pod_handoff.pick_remote()`` per handoff and may serve
+        the decode leg on a remote host."""
+        self.pod = pod_handoff
 
     def generate_step(self, prompt_tokens, **kw):
         emitted: list = []  # every token the client saw, both phases
@@ -289,6 +300,36 @@ class DisaggCoordinator:
                            bytes=int(nbytes))
             elif tr is not None:
                 tr.point("handoff_fault")
+            # ---- pod leg: a remote decode host may be less loaded than
+            # the local decode pool. serve_remote ships the block through
+            # the ``pod.handoff`` fault site and relays the remote tokens
+            # back; ANY failure raises PodHandoffFallback (counted by the
+            # handoff, by kind) and the request continues on the local
+            # plan below — cross-host never weakens the never-drop ladder.
+            if target is self.decode and self.pod is not None \
+                    and self.pod.pick_remote() is not None:
+                from mlx_sharding_tpu.pod import PodHandoffFallback
+
+                it = self.pod.serve_remote(state, resume_kw)
+                try:
+                    for item in it:
+                        if trackable:
+                            trackable = _track(item)
+                        yield item
+                    return
+                except GeneratorExit:
+                    it.close()
+                    raise
+                except PodHandoffFallback as exc:
+                    if not exc.keep_block or exc.tokens_relayed:
+                        # the block is gone (shipped/corrupt) or the remote
+                        # already advanced the stream: rebuild a blockless
+                        # resume from the coordinator's own delivered-token
+                        # record — the existing token-exact fold path
+                        state = ResumeState(
+                            prompt=prompt_tokens, history=list(emitted),
+                            produced=len(emitted),
+                        )
             plan = [target, self.decode if target is self.prefill
                     else self.prefill]
             fwd = resume_kw

@@ -76,6 +76,23 @@ def pool_pressure(slots: int, active: int, queued: int,
     return (active + queued) / max(1, slots) + min(1.0, 0.25 * shed_delta)
 
 
+def aggregate_pressure(host_infos: list) -> float:
+    """Pod-wide pressure: the slot-weighted mean of per-host pool
+    pressures. Each entry is a host heartbeat's fleet block
+    (``{"pressure": float, "slots": int, ...}``); hosts with no slots
+    (draining out, just died) contribute nothing. Slot weighting matters:
+    a saturated 2-slot host must not read as urgent as a saturated
+    32-slot host — the pod autoscaler prices capacity, not host count."""
+    num = den = 0.0
+    for info in host_infos:
+        slots = max(0, int(info.get("slots", 0) or 0))
+        if slots <= 0:
+            continue
+        num += float(info.get("pressure", 0.0) or 0.0) * slots
+        den += slots
+    return num / den if den else 0.0
+
+
 class BrownoutController:
     """Degradation ladder (see module docstring). ``observe(pressure)`` is
     the only input; the outputs are ``state()`` / the level predicates the
@@ -370,6 +387,44 @@ class FleetAutoscaler:
         logger.info("autoscaler (%s) drained replica %d",
                     self.role or "fleet", victim)
         return "drain"
+
+    # ------------------------------------------------------- pod surface
+    def pressure(self) -> float:
+        """Instantaneous pool pressure for the pod heartbeat — the same
+        :func:`pool_pressure` the decision loop prices, sampled without
+        touching the shed-delta bookkeeping (``tick()`` owns that)."""
+        slots, active, queued = self.rs.stats()
+        return pool_pressure(slots, active, queued, 0)
+
+    def headroom(self) -> dict:
+        """How much THIS host's pool can still grow/shrink — the pod
+        autoscaler's per-host entry in the pod-wide free list."""
+        live = self.rs.fleet_stats()["size"]
+        max_reps = self.max_replicas if self.max_replicas is not None else live
+        return {
+            "live": live,
+            "spawnable": max(0, max_reps - live) if self.factory else 0,
+            "drainable": max(0, live - self.min_replicas),
+        }
+
+    def spawn_one(self) -> str:
+        """Pod-autoscaler nudge: spawn now if bounds allow, with the same
+        failure quarantine as an organic scale-up. Returns the action
+        string (``spawn`` / ``spawn_failed`` / ``spawn_skipped``)."""
+        now = self.clock()
+        live = self.rs.fleet_stats()["size"]
+        max_reps = self.max_replicas if self.max_replicas is not None else live
+        if self.factory is None or live >= max_reps:
+            return "spawn_skipped"
+        return self._spawn(now)
+
+    def drain_one(self) -> str:
+        """Pod-autoscaler nudge: drain the least-loaded replica if bounds
+        allow (``drain`` / ``drain_failed`` / ``drain_skipped``)."""
+        now = self.clock()
+        if self.rs.fleet_stats()["size"] <= self.min_replicas:
+            return "drain_skipped"
+        return self._drain(now)
 
     # --------------------------------------------------------- loop/state
     def start(self):

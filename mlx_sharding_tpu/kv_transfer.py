@@ -241,6 +241,71 @@ class KVPageBlock:
                         "KV page payload checksum mismatch (corrupt block)"
                     )
 
+    def to_bytes(self) -> bytes:
+        """Wire format for cross-host shipment (the pod handoff): the
+        host-materialized payload trees plus every resume field, one
+        pickled dict. Host-materialization is the caller's job (``ship``
+        runs off-tick, so the blocking :meth:`to_host` is legal there);
+        the stamped checksum rides along and :meth:`from_bytes` re-verifies
+        it on arrival, so transport corruption surfaces as
+        :class:`BlockIntegrityError` — the importer's re-prefill fallback —
+        never as wrong KV rows."""
+        with self._lock:
+            if not self._host:
+                raise BlockIntegrityError(
+                    "to_bytes() needs a host-materialized block — "
+                    "call to_host() first (off the tick path)"
+                )
+            payload = {
+                "k_pages": self.k_pages,
+                "v_pages": self.v_pages,
+                "n_tokens": self.n_tokens,
+                "page_size": self.page_size,
+                "prompt": self.prompt,
+                "history": list(self.history),
+                "produced": self.produced,
+                "last_tok": self.last_tok,
+                "resume_keys": self.resume_keys,
+                "resume_recent": self.resume_recent,
+                "checksum": self.checksum,
+            }
+        import pickle
+
+        return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "KVPageBlock":
+        """Rebuild a shipped block on the receiving host and verify it.
+        Raises :class:`BlockIntegrityError` on any truncation, unpickle
+        failure, or checksum mismatch — the caller counts the fallback and
+        re-prefills from the resume history instead."""
+        import pickle
+
+        try:
+            payload = pickle.loads(data)
+            blk = KVPageBlock(
+                k_pages=payload["k_pages"],
+                v_pages=payload["v_pages"],
+                n_tokens=int(payload["n_tokens"]),
+                page_size=int(payload["page_size"]),
+                prompt=np.asarray(payload["prompt"], np.int32),
+                history=[int(t) for t in payload["history"]],
+                produced=int(payload["produced"]),
+                last_tok=int(payload["last_tok"]),
+                resume_keys=payload["resume_keys"],
+                resume_recent=payload["resume_recent"],
+                checksum=payload["checksum"],
+                _host=True,
+            )
+        except BlockIntegrityError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any decode failure is corruption
+            raise BlockIntegrityError(
+                f"undecodable shipped block: {e!r}"
+            ) from e
+        blk.verify()
+        return blk
+
     def compatible_with(self, cache) -> Optional[str]:
         """``None`` if this block's pages can be scattered into ``cache``'s
         pool; else a reason string. Catches cross-mode imports (int8 block

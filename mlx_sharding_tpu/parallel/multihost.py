@@ -712,3 +712,146 @@ def serve_worker(engine) -> None:
                 raise RuntimeError(
                     f"worker protocol desync: unexpected op {op} mid-request"
                 )
+
+
+# --------------------------------------------------------------------------
+# Pod control plane: the symmetric (every-host-publishes) variant of the
+# exchange, for the pod fleet subsystem (pod.py). Where ControlPlane is
+# rank-0-publishes / workers-mirror (SPMD lockstep over ONE engine), the pod
+# plane stitches N *independent* host fleets together: each host contributes
+# its own fixed-shape buffer every pod tick and receives everyone's —
+# heartbeats, weight-store registrations, autoscaler pressure, and chunked
+# KV-block shipments all ride the same allgather.
+
+# pod header slots (int32[POD_HEADER]): [seq, host_id, n_msgs, blob_used,
+# epoch, flags, reserved, reserved]
+POD_HEADER = 8
+
+
+class PodControlPlane:
+    """Fixed-shape symmetric exchange over ``process_allgather``.
+
+    Every pod tick, every host calls :meth:`pod_exchange` with its header
+    and message blob; the collective returns all hosts' buffers. Because a
+    collective only completes when EVERY rank arrives, each host bounds the
+    wait with the same timed daemon-thread discipline ControlPlane uses on
+    rank 0 (``MST_POD_TIMEOUT_S``, default 60s) — a SIGKILLed peer turns
+    into a :class:`WorkerTimeoutError` here, which the pod transport
+    surfaces as "all peers dead" so the local fleet degrades to single-host
+    serving instead of wedging its pod thread in the collective forever.
+
+    The blob is an opaque uint8 payload (default 256 KiB,
+    ``MST_POD_BLOB_BYTES``); framing/chunking is the transport's job
+    (pod.CollectiveTransport), keeping this class a pure collective."""
+
+    def __init__(self, blob_bytes: Optional[int] = None,
+                 timeout_s: Optional[float] = None):
+        if blob_bytes is None:
+            try:
+                blob_bytes = int(
+                    os.environ.get("MST_POD_BLOB_BYTES", str(256 << 10))
+                )
+            except ValueError:
+                blob_bytes = 256 << 10
+        self.blob_bytes = max(4096, int(blob_bytes))
+        if timeout_s is None:
+            try:
+                timeout_s = float(os.environ.get("MST_POD_TIMEOUT_S", "60"))
+            except ValueError:
+                timeout_s = 60.0
+        # unlike ControlPlane, EVERY host times its collectives: each host
+        # drives its own pod tick loop, so each must detect dead peers
+        self.timeout_s = timeout_s if timeout_s > 0 else None
+        self.dead = False
+        self.last_ok: Optional[float] = None
+        self._thread = None
+        from mlx_sharding_tpu.analysis.runtime import make_lock
+
+        self._lock = make_lock("PodControlPlane._lock")
+
+    @staticmethod
+    def _allgather(buf):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(buf)
+
+    def pod_exchange(self, header: np.ndarray, blob: np.ndarray) -> tuple:
+        """One pod tick's collective: contribute ``(header, blob)``, get
+        back ``(headers, blobs)`` stacked over hosts (shape ``[n_hosts,
+        ...]``). Raises :class:`WorkerTimeoutError` when a peer doesn't
+        arrive within the budget, and instantly once the plane is dead —
+        the same fail-fast contract as ControlPlane.exchange."""
+        try:
+            # same fault site as the SPMD plane: a dropped pod collective
+            # and a dropped broadcast have identical liveness semantics
+            inject("multihost.exchange", plane="pod")
+        except Exception as e:  # noqa: BLE001 — injected drop == dead plane
+            with self._lock:
+                self.dead = True
+            raise WorkerTimeoutError(
+                "pod collective dropped (injected fault) — marking the pod "
+                "control plane down"
+            ) from e
+        hdr = np.zeros((POD_HEADER,), np.int32)
+        hdr[: min(POD_HEADER, np.asarray(header).size)] = \
+            np.asarray(header, np.int32).reshape(-1)[:POD_HEADER]
+        buf = np.zeros((self.blob_bytes,), np.uint8)
+        b = np.asarray(blob, np.uint8).reshape(-1)
+        if b.size > self.blob_bytes:
+            raise ValueError(
+                f"pod blob of {b.size} bytes exceeds the plane width "
+                f"{self.blob_bytes} — chunk it (transport bug)"
+            )
+        buf[: b.size] = b
+        tree = {"header": hdr, "blob": buf}
+        if self.timeout_s is None:
+            out = self._allgather(tree)
+        else:
+            with self._lock:
+                if self.dead:
+                    raise WorkerTimeoutError(
+                        "pod control plane is down (a peer host previously "
+                        "failed to respond)"
+                    )
+                import queue as _q
+
+                if self._thread is None:
+                    # same rationale as ControlPlane: one daemon thread
+                    # issuing collectives in program order; a timed-out
+                    # allgather strands the thread, not the pod loop
+                    self._work: _q.Queue = _q.Queue()
+                    self._out: _q.Queue = _q.Queue()
+
+                    def run():
+                        while True:
+                            t = self._work.get()
+                            try:
+                                self._out.put(("ok", self._allgather(t)))
+                            except BaseException as e:  # noqa: BLE001
+                                self._out.put(("err", e))
+
+                    import threading
+
+                    self._thread = threading.Thread(
+                        target=run, name="mst-pod-ctrl", daemon=True
+                    )
+                    self._thread.start()
+                self._work.put(tree)
+                try:
+                    kind, val = self._out.get(timeout=self.timeout_s)
+                except _q.Empty:
+                    self.dead = True
+                    raise WorkerTimeoutError(
+                        f"pod collective did not complete within "
+                        f"{self.timeout_s:.0f}s — a peer host is dead or "
+                        "wedged; marking the pod control plane down"
+                    ) from None
+                if kind == "err":
+                    self.dead = True
+                    raise WorkerTimeoutError(
+                        "pod collective failed — the distributed runtime "
+                        "reported a dead or unreachable peer host"
+                    ) from val
+                out = val
+        self.last_ok = time.monotonic()
+        return np.asarray(out["header"]), np.asarray(out["blob"])

@@ -706,6 +706,21 @@ class ReplicaSet:
                 queued += max(inflight[i] - 1, 0)
         return slots, active, queued
 
+    def pool_load(self) -> dict:
+        """One heartbeat-sized load summary for the pod control plane:
+        slot occupancy plus live-replica count, so a remote prefill host
+        can price THIS pool as a decode target (``free`` slots) and the
+        pod autoscaler can weigh its pressure by real capacity. Everything
+        here is gauge-grade — stale by one pod tick by design."""
+        slots, active, queued = self.stats()
+        return {
+            "slots": slots,
+            "active": active,
+            "queued": queued,
+            "free": max(0, slots - active),
+            "live": self.fleet_stats()["size"],
+        }
+
     def replica_stats(self) -> list:
         """Per-replica routing/breaker snapshot for /metrics: inflight,
         queue depth, breaker state (numeric: 0 closed / 1 half-open /

@@ -1516,13 +1516,43 @@ class ContinuousBatcher:
                     "prefix block prefetch failed (demand import): %s", e
                 )
 
+    def stage_resume(self, state) -> bool:
+        """Dispatch-only host→device staging of an incoming resume block —
+        the pod receiving host calls this BEFORE submitting the shipped
+        request (``generate_step(..., _resume=state)``), so the block's
+        host→device DMA rides alongside the decode block already in
+        flight and the admission scatter consumes device-resident arrays
+        (the same PRESERVE-style overlap as the spill/store prefetch
+        passes). Returns True when a stage was dispatched; any failure is
+        absorbed into the counted demand-import path."""
+        block = getattr(state, "block", None)
+        if block is None or not getattr(block, "is_host", False) \
+                or block.is_prefetched:
+            return False
+        try:
+            block.prefetch(put=self._put)
+            with self._admission_lock:
+                self.prefetches += 1
+            return True
+        except Exception as e:  # noqa: BLE001 — degrade to demand import
+            with self._admission_lock:
+                self.prefetch_faults += 1
+            logging.getLogger(__name__).debug(
+                "resume block prefetch failed (demand import): %s", e
+            )
+            return False
+
     def close(self, timeout: float = 10.0):
         with self._start_lock:
             self._stop = True
             t = self._thread
         if t is not None:
-            # mst: allow(MST201): wake sentinel; Queue locks internally
-            self._submit.put(None)  # wake the idle wait
+            if t.is_alive():
+                # a sentinel for a dead thread would sit in _submit forever,
+                # inflating the queued gauge (and the pod-gossiped pressure)
+                # by one per repeated close
+                # mst: allow(MST201): wake sentinel; Queue locks internally
+                self._submit.put(None)  # wake the idle wait
             t.join(timeout=timeout)
             if t.is_alive():
                 # a tick is wedged (stuck device op / injected fault): the

@@ -186,6 +186,7 @@ class ModelProvider:
         prefill_replicas: int = 1,
         decode_replicas: int = 1,
         shared_weights: str = "auto",
+        pod: bool = False,
     ):
         # admission control: per-batcher bound on queued requests; a full
         # queue rejects with QueueFullError (HTTP 429 + Retry-After)
@@ -212,6 +213,12 @@ class ModelProvider:
         self.disagg = bool(disagg)
         self.prefill_replicas = max(1, prefill_replicas)
         self.decode_replicas = max(1, decode_replicas)
+        # pod-scale serving (pod.py): N independent host-local fleets (one
+        # per process, engines on local devices only) stitched by the pod
+        # gossip plane — NOT the SPMD mirror plane (the two are mutually
+        # exclusive, so only one collective plane ever exists)
+        self.pod = bool(pod)
+        self.pod_fleet = None  # PodFleet once a generator is loaded
         # cross-replica shared weights (weights.WeightStore): one resident
         # packed tree per host, every replica co-located on one model-
         # parallel slice and aliasing it — fleet weight bytes ~W, not N×W.
@@ -293,16 +300,53 @@ class ModelProvider:
         rank-divergent answer here is a multi-host desync."""
         return bool(self.prompt_cache and self.paged_pool is not None)
 
-    def _shared_weights_on(self) -> bool:
+    def _shared_weights_on(self, *, weight_bytes: int = 0, want: int = 0,
+                           per: int = 0, n_devices: int = 0) -> bool:
         """Resolve --shared-weights. ``on`` forces (main() already rejected
-        the incompatible multihost/chained configs); ``auto`` shares exactly
-        when a fleet would otherwise upload N private copies."""
+        the incompatible multihost/chained configs); ``auto`` prices the
+        trade capacity-aware when the caller passes the fleet shape.
+
+        Sharing co-locates all ``want`` replicas on ONE slice: it saves
+        ``(want-1)*W`` of weight uploads but squeezes every replica's KV
+        headroom into the single slice's budget ``B`` instead of spreading
+        the fleet over ``want`` private slices. Equating the two — bytes
+        saved ``(N-1)W`` against per-slice KV headroom forfeited
+        ``(B-W)(N-1)/N`` — sharing wins exactly when ``W*(N+1) >= B``.
+        ``B`` comes from ``MST_DEVICE_MEMORY_BYTES`` (per device, scaled by
+        the slice width); unset means the budget is unknown and ``auto``
+        keeps the legacy rule (a fleet always shares). A grid too small
+        for ``want`` private slices forces sharing regardless: co-location
+        is then the only way the fleet fits at all."""
         mode = (self.shared_weights or "auto").lower()
         if mode == "off":
             return False
         if mode == "on":
             return True
-        return (self.replicas > 1 or self.disagg) and not self.multihost
+        if not ((self.replicas > 1 or self.disagg) and not self.multihost):
+            return False
+        if not (weight_bytes and want > 1 and per):
+            return True
+        if n_devices and want * per > n_devices:
+            logger.info(
+                "shared-weights auto: forced ON — %d private slices of %d "
+                "devices exceed the %d-device grid", want, per, n_devices,
+            )
+            return True
+        per_device = int(os.environ.get("MST_DEVICE_MEMORY_BYTES", 0) or 0)
+        if per_device <= 0:
+            return True
+        budget = per_device * per
+        share = weight_bytes * (want + 1) >= budget
+        logger.info(
+            "shared-weights auto: %s — weights %.1f MiB x (%d replicas + 1) "
+            "%s slice budget %.1f MiB (saved upload %.1f MiB vs KV headroom "
+            "%.1f MiB/replica private)",
+            "ON" if share else "OFF", weight_bytes / 2**20, want,
+            ">=" if share else "<", budget / 2**20,
+            (want - 1) * weight_bytes / 2**20,
+            max(0, budget - weight_bytes) / 2**20,
+        )
+        return share
 
     def _load_draft(self, cache_dtype):
         """Load the draft model pair for speculative decoding. The draft
@@ -405,12 +449,23 @@ class ModelProvider:
                         )
 
                     per = stages * self.tp * self.ep
-                    devices = _jax.devices()
+                    # a pod host's fleet lives on ITS devices only — local
+                    # meshes are process-addressable, so each host builds
+                    # engines without any cross-host program
+                    devices = (
+                        _jax.local_devices() if self.pod else _jax.devices()
+                    )
                     want = (
                         self.prefill_replicas + self.decode_replicas
                         if self.disagg else self.replicas
                     )
-                    shared = self._shared_weights_on() and not self.multihost
+                    shared = self._shared_weights_on(
+                        weight_bytes=sum(
+                            getattr(leaf, "nbytes", 0)
+                            for leaf in _jax.tree.leaves(params)
+                        ),
+                        want=want, per=per, n_devices=len(devices),
+                    ) and not self.multihost
                     self.shared_weights_active = shared
                     if shared:
                         # shared-weights replicas all co-locate on ONE
@@ -723,6 +778,26 @@ class ModelProvider:
                         decode_block=self.decode_block,
                         prompt_cache=self.prompt_cache,
                     )
+            if self.pod:
+                # stitch this host's fleet into the pod: gossip transport
+                # over the PodControlPlane, weight-registry + handoff +
+                # pod-autoscaler front door wrapping the local generator
+                # (DisaggCoordinator gets the cross-host decode leg via
+                # attach_pod inside PodFleet)
+                from mlx_sharding_tpu.pod import CollectiveTransport, PodFleet
+
+                ctrls = (
+                    self.fleet if isinstance(self.fleet, tuple)
+                    else (self.fleet,) if self.fleet is not None else ()
+                )
+                transport = CollectiveTransport()
+                pf = PodFleet(
+                    transport.host_id, transport, generator,
+                    controllers=list(ctrls),
+                )
+                pf.start()
+                self.pod_fleet = pf
+                generator = pf
             from transformers import AutoTokenizer
 
             tokenizer = AutoTokenizer.from_pretrained(str(get_model_path(target)))
@@ -865,6 +940,15 @@ class APIHandler(BaseHTTPRequestHandler):
             if store is not None:
                 try:
                     payload["prefix_store"] = store.stats()
+                except Exception:  # noqa: BLE001 — health must render anyway
+                    pass
+            # pod fleet: per-host liveness/weights from the gossip view,
+            # handoff + autoscaler counters — absent on every single-host
+            # deployment (shape contract: no pod key, no host labels)
+            pod = getattr(self.provider, "pod_fleet", None)
+            if pod is not None:
+                try:
+                    payload["pod"] = pod.pod_stats()
                 except Exception:  # noqa: BLE001 — health must render anyway
                     pass
             ctrl = getattr(gen, "ctrl", None)
@@ -1607,6 +1691,11 @@ def make_server(
                 prefix_store_fn=lambda: getattr(
                     provider, "prefix_store_obj", None
                 ),
+                pod_stats_fn=lambda: (
+                    provider.pod_fleet.pod_stats()
+                    if getattr(provider, "pod_fleet", None) is not None
+                    else None
+                ),
             ),
             "profile_dir": profile_dir,
             "api_key": api_key,
@@ -1874,6 +1963,13 @@ def main(argv=None):
                         help="host:port of jax.distributed coordinator")
     parser.add_argument("--process-id", type=int, default=None)
     parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--pod", action="store_true",
+                        help="pod-scale serving: each process runs its own "
+                             "host-local fleet on its local devices, "
+                             "stitched by the pod gossip plane (weight "
+                             "registry, cross-host disagg handoff, pod "
+                             "autoscaler) instead of the SPMD mirror — "
+                             "requires --coordinator and --num-processes")
     args = parser.parse_args(argv)
 
     if args.engine == "chained" and not args.stage_bounds:
@@ -1882,7 +1978,15 @@ def main(argv=None):
         parser.error("--concurrent requires the fused engine")
     if (args.tp > 1 or args.ep > 1) and args.engine == "chained":
         parser.error("--tp/--ep require the fused engine")
-    if args.coordinator and (args.num_processes or 1) > 1:
+    if args.pod:
+        if not (args.coordinator and (args.num_processes or 1) > 1):
+            parser.error("--pod requires --coordinator and --num-processes "
+                         "> 1 (the pod gossip plane rides "
+                         "jax.distributed)")
+        if not args.model:
+            parser.error("--pod serving requires --model (every host loads "
+                         "its fleet at startup)")
+    if args.coordinator and (args.num_processes or 1) > 1 and not args.pod:
         if not args.model:
             parser.error("multi-host serving requires --model (workers load "
                          "the model at startup)")
@@ -1990,13 +2094,13 @@ def main(argv=None):
                          "stage, layer-range, --draft-model, or fleet "
                          "flags)")
     if args.replicas > 1 and (
-        args.coordinator or args.engine == "chained"
+        (args.coordinator and not args.pod) or args.engine == "chained"
         or (args.draft_model and args.concurrent <= 1)
         or args.start_layer is not None or args.end_layer is not None
     ):
         parser.error("--replicas requires the fused full-model engine path "
-                     "(no --coordinator/--engine chained/layer-range flags; "
-                     "--draft-model only with --concurrent)")
+                     "(no --coordinator/--engine chained/layer-range flags "
+                     "unless --pod; --draft-model only with --concurrent)")
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
     if args.paged_pool and args.engine == "chained":
@@ -2011,7 +2115,8 @@ def main(argv=None):
         parser.error("--admission-policy requires --paged-pool")
     if args.overcommit and not args.paged_pool:
         parser.error("--overcommit requires --paged-pool")
-    if args.overcommit and args.coordinator and (args.num_processes or 1) > 1:
+    if (args.overcommit and args.coordinator
+            and (args.num_processes or 1) > 1 and not args.pod):
         # the sampler-state stash is no longer the blocker (it travels in
         # KVPageBlock / ResumeState now); what remains is that preemption
         # and resume rewrite page tables and free lists host-side, outside
@@ -2056,9 +2161,10 @@ def main(argv=None):
         if args.replicas > 1:
             parser.error("--disagg replaces --replicas: size the pools "
                          "with --prefill-replicas/--decode-replicas")
-        if args.coordinator or args.engine == "chained":
+        if (args.coordinator and not args.pod) or args.engine == "chained":
             parser.error("--disagg requires the single-host fused engine "
-                         "path (no --coordinator/--engine chained)")
+                         "path (no --coordinator/--engine chained) — or "
+                         "--pod, where each host runs its own disagg pools")
         if args.draft_model:
             parser.error("--disagg is incompatible with --draft-model "
                          "(speculative slots cannot resume from a "
@@ -2092,10 +2198,12 @@ def main(argv=None):
         parser.error("--autoscale-interval must be > 0 and "
                      "--autoscale-cooldown >= 0")
     if args.shared_weights == "on":
-        if args.coordinator or (args.num_processes or 1) > 1:
+        if (args.coordinator or (args.num_processes or 1) > 1) \
+                and not args.pod:
             parser.error("--shared-weights on is single-host only: worker "
                          "ranks hold their own device grids, there is no "
-                         "one resident tree for them to alias")
+                         "one resident tree for them to alias (--pod hosts "
+                         "each alias their own local tree)")
         if args.engine == "chained":
             parser.error("--shared-weights on requires the fused engine "
                          "path (chained stage processes each own their "
@@ -2119,7 +2227,7 @@ def main(argv=None):
                      "use 'auto'")
     if args.async_sched == "on" and args.coordinator and (
         args.num_processes or 1
-    ) > 1:
+    ) > 1 and not args.pod:
         parser.error("--async-sched on is not supported in multi-host "
                      "serving (worker mirrors replay the op stream per "
                      "broadcast tick); use 'auto'")
@@ -2127,7 +2235,8 @@ def main(argv=None):
                       ("--ttft-timeout", args.ttft_timeout)):
         if val is not None and val <= 0:
             parser.error(f"{flag} must be a positive number of seconds")
-    multihost = bool(args.coordinator) and (args.num_processes or 1) > 1
+    multihost = (bool(args.coordinator) and (args.num_processes or 1) > 1
+                 and not args.pod)
     provider = ModelProvider(
         args.model, start_layer=args.start_layer, end_layer=args.end_layer,
         num_stages=args.num_stages, stage_bounds=stage_bounds,
@@ -2160,6 +2269,7 @@ def main(argv=None):
         prefill_replicas=args.prefill_replicas,
         decode_replicas=args.decode_replicas,
         shared_weights=args.shared_weights,
+        pod=args.pod,
     )
     if multihost:
         import jax

@@ -46,6 +46,10 @@ Sites wired into the serving stack:
   lookup, disagg full-hit check); ctx ``engine=id(batcher)`` or
   ``probe="covers"`` (raise here to prove a sick store degrades to plain
   prefill — the stream is never wrong and never drops)
+- ``pod.handoff``         — the cross-host prefill→decode handoff control
+  point in ``PodHandoff.serve_remote``, before any wire work; ctx
+  ``n_bytes=<block payload>`` (raise here to force the origin's local
+  plan — serve-in-place with the block intact, never a dropped stream)
 
 Programmatic use (the fault-injection test suite)::
 

@@ -182,6 +182,10 @@ class ServingMetrics:
     # zero-arg callable returning the live prefix_store.PrefixStore (or
     # None) — callable for the same hot-swap reason as batcher_fn
     prefix_store_fn: object = None
+    # zero-arg callable returning pod.PodFleet.pod_stats() (or None) —
+    # None on every single-host deployment, which keeps the single-host
+    # exposition byte-identical (no host labels, no pod families)
+    pod_stats_fn: object = None
 
     def record_request(
         self,
@@ -697,6 +701,94 @@ class ServingMetrics:
                     f'mst_prefix_store_faults_total{{kind="import"}} '
                     f"{pstats['import_faults']}",
                 ]
+            # pod fleet (pod.py): host-labeled size/weights/heartbeat from
+            # the gossip view plus handoff and autoscaler counters — only
+            # on --pod deployments (pod_stats_fn unset keeps single-host
+            # exposition label-free); the gossip snapshot can race a host
+            # death mid-render, so the whole section drops on any error
+            pmark = len(lines)
+            try:
+                pod = (
+                    self.pod_stats_fn()
+                    if self.pod_stats_fn is not None
+                    else None
+                )
+                if pod is not None:
+                    lines += [
+                        "# TYPE mst_pod_hosts gauge",
+                        f"mst_pod_hosts {len(pod['hosts'])}",
+                        "# TYPE mst_pod_host_deaths_total counter",
+                        f"mst_pod_host_deaths_total "
+                        f"{pod['autoscaler']['deaths_detected']}",
+                    ]
+                    hosts = sorted(pod["hosts"])
+                    # one # TYPE per family (invalid exposition otherwise),
+                    # then every host's sample; mst_fleet_size and the
+                    # mst_weight_store_* families were already declared by
+                    # the single-host sections above, so the host-labeled
+                    # samples ride the existing declarations
+                    lines.append("# TYPE mst_pod_host_alive gauge")
+                    lines += [
+                        f'mst_pod_host_alive{{host="{h}"}} '
+                        f"{int(bool(pod['hosts'][h].get('alive')))}"
+                        for h in hosts
+                    ]
+                    ages = [
+                        (h, pod["hosts"][h].get("heartbeat_age_s"))
+                        for h in hosts
+                    ]
+                    if any(a is not None for _, a in ages):
+                        lines.append(
+                            "# TYPE mst_pod_heartbeat_age_seconds gauge"
+                        )
+                        lines += [
+                            f'mst_pod_heartbeat_age_seconds{{host="{h}"}} '
+                            f"{a:.3f}"
+                            for h, a in ages if a is not None
+                        ]
+                    lines += [
+                        f'mst_fleet_size{{host="{h}"}} '
+                        f"{(pod['hosts'][h].get('fleet') or {}).get('live', 0)}"
+                        for h in hosts if pod["hosts"][h].get("fleet")
+                    ]
+                    for fam, key in (("trees", "trees"), ("refs", "refs"),
+                                     ("bytes", "bytes")):
+                        lines += [
+                            f'mst_weight_store_{fam}{{host="{h}"}} '
+                            f"{(pod['hosts'][h].get('weights') or {}).get(key, 0)}"
+                            for h in hosts if pod["hosts"][h].get("weights")
+                        ]
+                    ho = pod["handoff"]
+                    lines += [
+                        "# TYPE mst_pod_handoff_total counter",
+                        f"mst_pod_handoff_total {ho['shipped']}",
+                        "# TYPE mst_pod_handoff_bytes_total counter",
+                        f"mst_pod_handoff_bytes_total {ho['bytes_shipped']}",
+                        "# TYPE mst_pod_handoff_received_total counter",
+                        f"mst_pod_handoff_received_total {ho['received']}",
+                        "# TYPE mst_pod_handoff_fallbacks_total counter",
+                    ]
+                    fb = ho.get("fallbacks") or {}
+                    if fb:
+                        lines += [
+                            f'mst_pod_handoff_fallbacks_total'
+                            f'{{kind="{kind}"}} {fb[kind]}'
+                            for kind in sorted(fb)
+                        ]
+                    else:
+                        # a bare # TYPE with no samples is invalid
+                        # exposition — emit the zero explicitly
+                        lines.append("mst_pod_handoff_fallbacks_total 0")
+                    if ho.get("ms_p50") is not None:
+                        lines += [
+                            "# TYPE mst_pod_handoff_ms summary",
+                            f'mst_pod_handoff_ms{{quantile="0.5"}} '
+                            f"{ho['ms_p50']:.3f}",
+                            f'mst_pod_handoff_ms{{quantile="0.99"}} '
+                            f"{ho['ms_p99']:.3f}",
+                        ]
+            except Exception:  # noqa: BLE001 — scrapes must never 500
+                del lines[pmark:]
         return "\n".join(_finalize(lines)) + "\n"
 
 

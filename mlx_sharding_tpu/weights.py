@@ -78,6 +78,21 @@ class WeightKey:
     placement: str
 
 
+def key_digest(key: WeightKey) -> str:
+    """Short stable identity of a WeightKey for the pod control plane:
+    hosts gossip digests (16 hex chars), not full keys — the checkpoint
+    path alone can exceed a pod message slot, and equality is all the
+    cross-host arbitration needs."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=8)
+    h.update(repr((
+        key.checkpoint, key.stage_bounds, key.dtype, key.quant,
+        key.placement,
+    )).encode())
+    return h.hexdigest()
+
+
 class WeightLease:
     """One engine's refcounted handle on a resident tree. ``release()`` is
     single-shot by contract — the double-release of a shared tree is how a
@@ -166,12 +181,15 @@ class WeightStore:
 
     def stats(self) -> dict:
         """Gauge source for ``mst_weight_store_{bytes,trees,refs}`` and the
-        /health store block."""
+        /health store block. Each entry carries its :func:`key_digest` so
+        the pod weight registry can gossip which trees THIS host holds
+        without shipping the full WeightKey over the control plane."""
         with self._lock:
             entries = [
                 {
                     "checkpoint": key.checkpoint,
                     "placement": key.placement,
+                    "digest": key_digest(key),
                     "refs": e.refs,
                     "bytes": int(getattr(e.weights, "weight_bytes", 0) or 0),
                 }
@@ -183,6 +201,16 @@ class WeightStore:
             "bytes": sum(e["bytes"] for e in entries),
             "entries": entries,
         }
+
+    def find(self, digest: str) -> Optional[WeightKey]:
+        """Resolve a gossiped digest back to this host's WeightKey, or None
+        when this host holds no such tree — the pod teardown handler uses
+        this to map a ``weights.teardown`` message onto a local key."""
+        with self._lock:
+            for key in self._entries:
+                if key_digest(key) == digest:
+                    return key
+        return None
 
 
 def aliased_spawn(

@@ -1,0 +1,11 @@
+"""MST113: a blocking control-plane collective inside a tick-hot
+function — a cross-host rendezvous completes when the slowest host
+arrives (or at the plane timeout when one never does), wedging every
+live slot's decode; run it on the transport thread and let the tick
+read the gossiped snapshot."""
+
+
+# mst: hot-path
+def tick_with_rendezvous(plane, hdr, blob, out):
+    headers, blobs = plane.pod_exchange(hdr, blob)
+    out.append(headers)

@@ -38,6 +38,7 @@ from mlx_sharding_tpu.weights import (
     WeightKey,
     WeightStore,
     aliased_spawn,
+    key_digest,
     weight_store,
 )
 from tests.helpers import run_concurrent
@@ -86,7 +87,8 @@ def test_acquire_builds_once_and_aliases():
     assert st == {
         "trees": 1, "refs": 2, "bytes": 100,
         "entries": [{"checkpoint": "ck", "placement": "pp=1|0",
-                     "refs": 2, "bytes": 100}],
+                     "refs": 2, "bytes": 100,
+                     "digest": key_digest(KEY)}],
     }
 
 
