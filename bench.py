@@ -2156,6 +2156,139 @@ def measure_async_tick_overlap(model, params, label: str) -> dict:
     return res
 
 
+def measure_adaptive_speculation(model, params, label: str) -> dict:
+    """Adaptive speculation A/B (ISSUE 16 tentpole): the same saturated
+    continuous-batching load with prompt-lookup n-gram drafting at three
+    policy points — per-slot adaptive windows (``auto``:
+    ``spec_window_max=8``, the acceptance EWMA walks each slot along the
+    2/4/8 ladder and disables losers), a pinned bottom-rung window
+    (``fixed_w2``: ``spec_window_max=2``, the closest thing to fixed-K
+    the tracker admits), and no speculation (``off``) — across an easy
+    mix (repetitive prompts; a greedy stream over them settles into
+    cycles the proposer catches) and a hard mix (seeded sampled decode:
+    novel text, drafts rarely accept). Records aggregate tok/s, p99 ITL
+    (per-emit gaps observed stream-side), and each run's accept
+    rate/rounds/draft-token spend. Expectation (CPU smoke): auto >=
+    fixed_w2 >= off on the easy mix — wider windows where drafts pay —
+    and auto ~ off on the hard mix (the tracker disables losing slots
+    instead of paying K-wide verifies for junk drafts). N-gram rounds
+    ride the async double-buffered tick, so the run also reports the
+    resolved scheduler mode."""
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(29)
+    slots = 4
+    # long enough for greedy streams to settle into the cycles the
+    # proposer feeds on AND for disabled slots to hit the 1 s re-probe
+    gen_tokens = 80
+
+    motif = [int(x) for x in rng.integers(1, vocab - 64, 6)]
+    mixes = {
+        # repeated motif with a per-slot prefix: the trailing n-gram
+        # always has an earlier occurrence to continue from
+        "easy": [
+            [int(rng.integers(1, vocab - 64))] + motif * 7
+            for _ in range(slots)
+        ],
+        "hard": [
+            [int(x) for x in rng.integers(1, vocab - 64, 32)]
+            for _ in range(slots)
+        ],
+    }
+    modes = {
+        "auto": dict(draft="ngram", spec_window_max=8),
+        "fixed_w2": dict(draft="ngram", spec_window_max=2),
+        "off": dict(),
+    }
+
+    eng = PipelineEngine(
+        model, params, make_mesh(pp=1), microbatches=slots,
+        max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=64,
+    )
+    res: dict = {"label": label, "slots": slots}
+    for mix, prompts in mixes.items():
+        sampled = mix == "hard"
+        entry = {}
+        for mode, kw in modes.items():
+            batcher = ContinuousBatcher(eng, decode_block=8, **kw)
+            try:
+                for _ in batcher.generate_step(prompts[0][:16], max_tokens=8):
+                    pass  # compile prefill + decode/verify programs
+                gaps: list[list[float]] = [[] for _ in range(slots)]
+                done = [0] * slots
+
+                def run(i):
+                    kws = (
+                        dict(temperature=0.8, seed=1000 + i)
+                        if sampled else {}
+                    )
+                    t_last = time.perf_counter()
+                    for _ in batcher.generate_step(
+                        prompts[i], max_tokens=gen_tokens, **kws
+                    ):
+                        now = time.perf_counter()
+                        gaps[i].append(now - t_last)
+                        t_last = now
+                        done[i] += 1
+
+                threads = [
+                    threading.Thread(target=run, args=(i,))
+                    for i in range(slots)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                st = batcher.spec_stats()
+                is_async = bool(getattr(batcher, "_async", False))
+            finally:
+                batcher.close()
+            itls = [g for gs in gaps for g in gs[1:]]  # drop per-slot TTFT
+            entry[mode] = dict(
+                aggregate_tps=round(sum(done) / wall, 2),
+                itl_p99_ms=round(
+                    float(np.percentile(itls, 99)) * 1e3, 2
+                ) if itls else None,
+                async_sched=is_async,
+                **(
+                    dict(
+                        accept_rate=round(st["accept_rate"], 3),
+                        rounds=st["rounds"],
+                        draft_tokens=st["draft_tokens"],
+                        disabled_slots=st.get("disabled_slots"),
+                    ) if st is not None else {}
+                ),
+            )
+        entry["auto_vs_off_tps_ratio"] = round(
+            entry["auto"]["aggregate_tps"]
+            / max(entry["off"]["aggregate_tps"], 1e-9), 3
+        )
+        entry["auto_vs_fixed_tps_ratio"] = round(
+            entry["auto"]["aggregate_tps"]
+            / max(entry["fixed_w2"]["aggregate_tps"], 1e-9), 3
+        )
+        res[mix] = entry
+        log(f"[{label}] {mix}: auto={entry['auto']['aggregate_tps']} tok/s "
+            f"(accept={entry['auto'].get('accept_rate')}, "
+            f"p99 ITL {entry['auto']['itl_p99_ms']}ms) "
+            f"fixed_w2={entry['fixed_w2']['aggregate_tps']} "
+            f"off={entry['off']['aggregate_tps']} — "
+            f"auto/off={entry['auto_vs_off_tps_ratio']}x "
+            f"auto/fixed={entry['auto_vs_fixed_tps_ratio']}x")
+    del eng
+    return res
+
+
 def kernel_smoke(detail: dict) -> None:
     """Compile (for real) + numerically cross-check both Pallas kernels
     against the XLA paths they replace, and time them."""
@@ -2476,6 +2609,17 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 detail["async_tick_overlap_cpu"] = dict(error=repr(e)[:300])
                 log(f"[async_tick_overlap_cpu] FAILED: {e!r}")
+            # n-gram speculation's win is fewer rounds, not cheaper
+            # forwards, so the tiny model measures the policy fine
+            try:
+                detail["adaptive_speculation_cpu"] = (
+                    measure_adaptive_speculation(
+                        m2, p2, "adaptive_speculation_cpu"
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["adaptive_speculation_cpu"] = dict(error=repr(e)[:300])
+                log(f"[adaptive_speculation_cpu] FAILED: {e!r}")
             # int8-KV equal-memory A/B: needs head_dim >= 64 for its
             # capacity claim (the ratio is 2D/(D+4): D=32 caps at 1.78x,
             # D=64 gives 1.88x), so this phase gets its own tiny variant
@@ -2683,6 +2827,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["overload_shedding"] = dict(error=repr(e)[:300])
             log(f"[overload_shedding] FAILED: {e!r}")
+        gc.collect()
+        try:
+            detail["adaptive_speculation"] = measure_adaptive_speculation(
+                model, params, "adaptive_speculation"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["adaptive_speculation"] = dict(error=repr(e)[:300])
+            log(f"[adaptive_speculation] FAILED: {e!r}")
         gc.collect()
         try:
             detail["async_tick_overlap"] = measure_async_tick_overlap(

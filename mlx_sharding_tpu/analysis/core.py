@@ -43,6 +43,7 @@ SUPPRESS_RE = re.compile(
 HOT_PATH_RE = re.compile(r"#\s*mst:\s*hot-path\b")
 DECODE_HOT_RE = re.compile(r"#\s*mst:\s*decode-hot\b")
 SPAWN_HOT_RE = re.compile(r"#\s*mst:\s*spawn-hot\b")
+SPEC_HOT_RE = re.compile(r"#\s*mst:\s*spec-hot\b")
 
 
 @dataclass(frozen=True)
@@ -77,6 +78,7 @@ class ModuleInfo:
     hot_lines: set[int] = field(default_factory=set)  # '# mst: hot-path'
     decode_hot_lines: set[int] = field(default_factory=set)  # 'decode-hot'
     spawn_hot_lines: set[int] = field(default_factory=set)  # 'spawn-hot'
+    spec_hot_lines: set[int] = field(default_factory=set)  # 'spec-hot'
 
     @property
     def basename(self) -> str:
@@ -159,6 +161,8 @@ def parse_module(path: Path, display_path: str,
             mod.decode_hot_lines.add(i)
         if SPAWN_HOT_RE.search(text):
             mod.spawn_hot_lines.add(i)
+        if SPEC_HOT_RE.search(text):
+            mod.spec_hot_lines.add(i)
         m = SUPPRESS_RE.search(text)
         if m:
             rules = {r.strip() for r in m.group(1).split(",") if r.strip()}

@@ -55,18 +55,24 @@ KNOWN_FAULT_SITES = {
     # point — must degrade to the single-host plan (serve-in-place or
     # blockless re-prefill), never a dropped stream
     "pod.handoff",
+    # speculative decoding (scheduler.py / speculative.py): before each
+    # round's draft proposals — a faulted draft source must degrade that
+    # tick to plain decode, counted, never a wrong or dropped stream
+    "spec.draft",
 }
-# basename -> the inject() site that file must keep calling
+# basename -> the inject() sites that file must keep calling (a file can
+# own more than one failure domain — the scheduler carries both the tick
+# wedge and the speculative draft-degradation hook)
 REQUIRED_FAULT_SITES = {
-    "scheduler.py": "scheduler.tick",
-    "replicas.py": "replica.dispatch",
-    "multihost.py": "multihost.exchange",
-    "openai_api.py": "server.sse_write",
-    "fleet.py": "autoscaler.tick",
-    "kv_transfer.py": "cache.export",
-    "disagg.py": "disagg.handoff",
-    "prefix_store.py": "cache.prefix_lookup",
-    "pod.py": "pod.handoff",
+    "scheduler.py": ("scheduler.tick", "spec.draft"),
+    "replicas.py": ("replica.dispatch",),
+    "multihost.py": ("multihost.exchange",),
+    "openai_api.py": ("server.sse_write",),
+    "fleet.py": ("autoscaler.tick",),
+    "kv_transfer.py": ("cache.export",),
+    "disagg.py": ("disagg.handoff",),
+    "prefix_store.py": ("cache.prefix_lookup",),
+    "pod.py": ("pod.handoff",),
 }
 
 
@@ -256,12 +262,16 @@ def _check_fault_sites(mod: ModuleInfo) -> list[Finding]:
                 f"unknown fault-injection site {site!r} — not in the "
                 "registered set, so it can never be armed",
                 context=qualname_for_line(mod.tree, node.lineno)))
-    required = REQUIRED_FAULT_SITES.get(mod.basename)
-    if required and required not in called_sites:
+    required = REQUIRED_FAULT_SITES.get(mod.basename, ())
+    missing = [s for s in required if s not in called_sites]
+    if missing:
+        # one finding per file, naming every dropped site — a module that
+        # loses two hooks is one regression, not two
+        sites = ", ".join(repr(s) for s in missing)
         findings.append(Finding(
             "MST304", mod.display_path, 1, 0,
-            f"{mod.basename} must call inject({required!r}) so the "
-            "resilience suite keeps exercising this failure domain",
+            f"{mod.basename} must call inject() with site(s) {sites} so "
+            "the resilience suite keeps exercising this failure domain",
             context="<module>"))
     return findings
 

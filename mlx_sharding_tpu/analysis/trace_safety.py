@@ -114,6 +114,20 @@
   dedicated transport/heartbeat threads; the tick reads the gossiped
   snapshot. An intentional inline rendezvous carries its own
   ``# mst: allow(MST113): …``.
+- **MST114 sync-in-spec-policy** — a blocking device sync
+  (``jax.device_get`` / ``.item()``) inside the speculation policy surface:
+  the per-round draft proposal and acceptance-tracker functions
+  (``_dispatch_spec``/``_spec_plan`` in the scheduler,
+  ``propose``/``observe``/``window`` on the proposer/tracker, plus anything
+  annotated ``# mst: spec-hot``). These run once per speculative round on
+  the tick thread and are host-side numpy BY DESIGN — the n-gram match
+  reads the request's host history, the tracker's EWMA is a float — so
+  they are deliberately NOT in the MST102 hot set (``np.asarray`` is their
+  bread and butter). But a ``device_get``/``.item()`` there drains the
+  dispatch pipe once per round to read a value the round's single
+  consolidated harvest (``_harvest_spec``) already returns — exactly the
+  per-round stall adaptive speculation exists to amortize away. An
+  MST102 suppression nearby does NOT cover this rule.
 - **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
   timeout arithmetic (an expression whose identifiers mention deadline /
   timeout / expiry / until / budget / ttft / retry_after / lease). The wall
@@ -164,6 +178,11 @@ HOT_PATH_FUNCS = {
         "_tick", "_tick_async", "_decode_once", "_dispatch_block",
         "_harvest", "_quiesce", "_decoding", "_growth_fits", "_spec_once",
         "_prefill_one_chunk", "_grow_for_decode", "_emit",
+        # the speculative round's harvest side runs on every spec tick;
+        # _dispatch_spec/_spec_plan are deliberately NOT here (host numpy
+        # proposal work — np.asarray is their job) and are covered by the
+        # stricter MST114 device-sync rule instead
+        "_harvest_spec", "_spec_tick", "_harvest_any",
     },
 }
 
@@ -210,6 +229,16 @@ WEIGHT_UPLOAD_CALLS = {"device_put", "put_global", "place_weights"}
 # identifier fragments that mark a call's argument as a param tree (vs the
 # KV staging a spawn legitimately does)
 PARAM_TREE_HINTS = ("param", "weight", "state_dict", "checkpoint")
+
+# speculation-policy roots checked by MST114 (beyond '# mst: spec-hot'
+# annotations): the per-round draft proposal and acceptance-tracker surface.
+# Host numpy is expected here (so MST102 does not apply); a device sync is
+# the one thing that must never appear — it stalls the dispatch pipe once
+# per draft round for a value the round's consolidated harvest already pulls
+SPEC_HOT_FUNCS = {
+    "scheduler.py": {"_dispatch_spec", "_spec_plan"},
+    "speculative.py": {"propose", "observe", "window"},
+}
 
 # decode-hot roots checked by MST105 (beyond '# mst: decode-hot'
 # annotations): every packed decode matmul funnels through these
@@ -383,6 +412,55 @@ def _check_hot_syncs(mod: ModuleInfo) -> list[Finding]:
                     "MST102", mod.display_path, node.lineno, node.col_offset,
                     f"blocking device sync in hot path {fn.name}(): {what} "
                     "stalls the tick for a device round trip",
+                    context=qualname_for_line(mod.tree, node.lineno),
+                ))
+    return findings
+
+
+def _spec_hot_functions(mod: ModuleInfo) -> list[ast.FunctionDef]:
+    configured = SPEC_HOT_FUNCS.get(mod.basename, set())
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        annotated = any(
+            line in mod.spec_hot_lines
+            for line in (node.lineno, node.lineno - 1)
+        )
+        if node.name in configured or annotated:
+            out.append(node)
+    return out
+
+
+def _check_spec_policy_syncs(mod: ModuleInfo) -> list[Finding]:
+    """MST114: a blocking device sync inside the speculation policy
+    surface. Narrower than MST102 on purpose — proposal/tracker code is
+    host numpy by design (``np.asarray`` over the request's history IS the
+    n-gram match), so only the true device round trips fire:
+    ``jax.device_get`` and argless ``.item()``."""
+    findings = []
+    for fn in _spec_hot_functions(mod):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host policy code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            what = None
+            if name is not None and name.split(".")[-1] == "device_get":
+                what = f"{name}()"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args
+            ):
+                what = ".item()"
+            if what:
+                findings.append(Finding(
+                    "MST114", mod.display_path, node.lineno, node.col_offset,
+                    f"device sync in speculation policy {fn.name}(): {what} "
+                    "drains the dispatch pipe once per draft round — the "
+                    "proposal/tracker surface reads host state only; device "
+                    "results arrive at the round's consolidated harvest",
                     context=qualname_for_line(mod.tree, node.lineno),
                 ))
     return findings
@@ -950,6 +1028,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     traced = _traced_closure(_traced_roots(mod.tree, table), table)
     findings = _check_host_effects(mod, traced)
     findings += _check_hot_syncs(mod)
+    findings += _check_spec_policy_syncs(mod)
     findings += _check_double_harvest(mod)
     findings += _check_sync_spill(mod)
     findings += _check_block_migration(mod)

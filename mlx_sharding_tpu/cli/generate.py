@@ -61,6 +61,20 @@ def main(argv=None):
                         "sampling (distribution-exact)")
     parser.add_argument("--spec-k", type=int, default=4,
                         help="speculation window (with --draft-model)")
+    parser.add_argument("--draft",
+                        choices=("auto", "off", "ngram", "engine"),
+                        default="auto",
+                        help="draft source for speculative decoding: "
+                        "'engine' is the two-model path (--draft-model), "
+                        "'ngram' drafts by prompt-lookup — n-gram matches "
+                        "against the prompt+history propose the window, no "
+                        "second checkpoint and no draft KV; 'auto' picks "
+                        "'engine' when --draft-model is given, else 'off'")
+    parser.add_argument("--spec-window-max", type=int, default=None,
+                        help="adaptive speculation ceiling (>= 2): per-round "
+                        "acceptance (EWMA) resizes the window in {0,2,4,8} "
+                        "up to this cap and disables drafting when it never "
+                        "pays; with --draft ngram defaults to 8")
     parser.add_argument("--paged-attention",
                         choices=("auto", "ragged", "gather"), default="auto",
                         help="decode-attention path for paged pipeline "
@@ -102,6 +116,22 @@ def main(argv=None):
     if args.draft_model and (args.sp or args.stage_bounds or args.num_stages
                              or args.tp > 1 or args.ep > 1):
         parser.error("--draft-model applies to the single-chip generator")
+    if args.draft == "engine" and not args.draft_model:
+        parser.error("--draft engine requires --draft-model")
+    if args.draft in ("off", "ngram") and args.draft_model:
+        parser.error(f"--draft {args.draft} conflicts with --draft-model "
+                     "(drop one: 'engine' is the two-model path)")
+    if args.draft == "ngram" and (args.sp or args.stage_bounds
+                                  or args.num_stages or args.tp > 1
+                                  or args.ep > 1):
+        parser.error("--draft ngram applies to the single-chip generator")
+    if args.spec_window_max is not None:
+        if args.spec_window_max < 2:
+            parser.error("--spec-window-max must be >= 2 (a 1-token window "
+                         "is plain decode; use --draft off)")
+        if args.draft not in ("ngram", "engine") and not args.draft_model:
+            parser.error("--spec-window-max needs a draft source "
+                         "(--draft ngram or --draft-model)")
     if args.kv_dtype == "int8":
         parser.error("--kv-dtype int8 requires a paged KV pool; serve with "
                      "--concurrent N --paged-pool P instead")
@@ -156,7 +186,16 @@ def main(argv=None):
             from mlx_sharding_tpu.parallel.mesh import make_mesh
 
             sp_mesh = make_mesh(sp=args.sp)
-        if args.draft_model:
+        if args.draft == "ngram":
+            from mlx_sharding_tpu.speculative import NgramSpeculativeGenerator
+
+            generator = NgramSpeculativeGenerator(
+                model, params,
+                spec_window_max=args.spec_window_max or 8,
+                max_seq=args.max_seq,
+                prefill_chunk=args.prefill_chunk,
+            )
+        elif args.draft_model:
             from mlx_sharding_tpu.speculative import SpeculativeGenerator
 
             draft_model, draft_params = load_model(args.draft_model)

@@ -111,6 +111,15 @@ class DisaggCoordinator:
                     "admission (ContinuousBatcher); got "
                     f"{type(rep).__name__}"
                 )
+        for rep in getattr(prefill_pool, "replicas", [prefill_pool]):
+            if getattr(rep, "_spec_mode", "off") != "off":
+                raise ValueError(
+                    "prefill-pool replicas must not speculate: a prefill "
+                    "replica emits one token per request before the "
+                    "handoff, so draft windows there are pure ballast — "
+                    "build the pool with draft='off' (decode replicas "
+                    "keep theirs)"
+                )
         for rep in getattr(decode_pool, "replicas", [decode_pool]):
             if not getattr(rep, "supports_resume", False):
                 raise ValueError(
@@ -457,6 +466,13 @@ class DisaggCoordinator:
             vals = [s.get(k, 0) for s in per]
             agg[k] = sum(v or 0 for v in vals)
         return agg
+
+    def spec_stats(self) -> Optional[dict]:
+        """Decode-pool speculation telemetry only — prefill replicas never
+        speculate (enforced at construction), so the decode pool IS the
+        coordinator's whole speculation story."""
+        fn = getattr(self.decode, "spec_stats", None)
+        return fn() if fn is not None else None
 
     def page_stats(self):
         per = [t for t in (self.prefill.page_stats(),

@@ -19,7 +19,10 @@ level  degradation (cumulative)
 1      cap ``max_tokens`` (long generations are the cheapest ballast),
        and pause prefix-store INSERTION (demotion exports are deferrable
        churn; serving hits stays on — hits SHED load, they don't add it)
-2      … and disable speculation (draft compute goes to real tokens)
+2      … and shed speculation (draft compute goes to real tokens).
+       Schedulers with an AcceptanceTracker shed per-slot, lowest
+       acceptance first — streams where drafting demonstrably pays keep
+       their windows; legacy fixed-K engine mode pauses globally
 3      … and tighten admission to half the queue bound (shed earlier,
        shallower queues, bounded queue-wait)
 ====== ==========================================================
@@ -158,6 +161,15 @@ class BrownoutController:
                 "level": lvl,
                 "max_tokens_cap": self.caps[lvl - 1] if lvl > 0 else None,
                 "speculation_disabled": lvl >= 2,
+                # HOW level >= 2 sheds is the scheduler's call: per-slot
+                # lowest-acceptance-first with an AcceptanceTracker
+                # (losing streams drop their windows first), a global
+                # pause in legacy fixed-K engine mode. The ladder only
+                # publishes the level; this names the contract.
+                "speculation_shed": (
+                    "lowest-acceptance-first" if 2 <= lvl < 3
+                    else "all" if lvl >= 3 else None
+                ),
                 "admission_tightened": lvl >= 3,
             }
 

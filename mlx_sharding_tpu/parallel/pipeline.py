@@ -1335,89 +1335,132 @@ class PipelineEngine:
         transformed target distribution). The rollback is one per-slot
         scalar: offset += count keeps exactly the verified prefix
         (speculative.py verify_fn/verify_sampled_fn vectorized over slots).
+        ``wcap`` (M,) is the per-slot adaptive window cap: ``m`` is clamped
+        to ``wcap - 1`` INSIDE the program, before any acceptance is
+        committed — truncating to a prefix of properly-accepted positions
+        is exactly window-wcap speculation (greedy rows are the target's
+        own tokens; sampled prefixes are rejection-sampling-exact at every
+        length), and cache offset / next-token / replay all derive from the
+        capped m. Legacy fixed-K callers pass wcap == K (a no-op clamp).
         Returns a jitted ``prog(layer_params, masks, vparts, shared, tok,
-        drafts, qlps, cache, active, recent, vkeys, sp, rep_sizes, table)
-        -> (gs (K, M), count (M,), next_tok (M, 1), cache, recent)``."""
+        drafts, qlps, cache, active, recent, vkeys, sp, rep_sizes, wcap,
+        table) -> (gs (K, M), count (M,), next_tok (M, 1), cache,
+        recent)``."""
         cache_key = ("verify", K)
         if cache_key not in self._spec_progs:
-            from mlx_sharding_tpu.speculative import rejection_round
-
-            M, B = self.microbatches, self.batch
-            if B != 1:
-                raise ValueError("continuous batching expects batch=1 per slot")
-            inner = self._build_smapped(t_len=K, paged=self.paged, keep_all=True)
-            if not self.paged:
-                dense = inner
-                inner = lambda *args: dense(*args[:-1])  # drop the table arg
-            n_valid = jnp.asarray(K, jnp.int32)
-
-            def prog(layer_params, masks, vparts, shared, tok, drafts, qlps,
-                     cache, active, recent, vkeys, sp, rep_sizes, table):
-                x = jnp.concatenate([tok, drafts[:-1].T], axis=1)  # (M, K)
-                off0 = cache.offset
-                logits_all, k, v = inner(
-                    layer_params, masks, vparts, shared, x[:, None, :],
-                    cache.k, cache.v, off0, active, n_valid, table,
-                )  # (M, 1, K, V)
-                logits_all = logits_all.reshape(M, K, -1)
-                W = recent.shape[1]
-                valid = jnp.arange(W)[None, :] >= (W - rep_sizes)[:, None]
-                sampled = sp.temperature > 0  # (M,)
-
-                def score(rec, i):
-                    tl = transform_logits_batched(
-                        logits_all[:, i], jnp.where(valid, rec, -1), sp
-                    )
-                    g = jnp.argmax(tl, axis=-1).astype(jnp.int32)
-                    plp = jax.nn.log_softmax(
-                        nucleus_logits_batched(tl, sp), axis=-1
-                    )
-                    # the token consumed at position i+1: the draft's
-                    # proposal (sampled — exact on the accepted prefix,
-                    # discarded past it) or the greedy verdict
-                    rec = update_recent_tokens(
-                        rec, jnp.where(sampled, drafts[i], g)
-                    )
-                    return rec, (g, plp)
-
-                _, (gs_g, plps) = jax.lax.scan(score, recent, jnp.arange(K))
-                # greedy: longest agreement prefix, then the correction token
-                mism = gs_g != drafts
-                any_m = mism.any(axis=0)
-                m_g = jnp.where(any_m, jnp.argmax(mism, axis=0), K - 1)
-
-                # rejection sampling, one vmapped lane per slot
-                def rr(key_s, d, q, p):
-                    gs, m, _ = rejection_round(
-                        key_s, d[:, None], q[:, None], p[:, None]
-                    )
-                    return gs[:, 0], m[0]
-
-                gs_s, m_s = jax.vmap(rr, in_axes=(0, 1, 1, 1), out_axes=(1, 0))(
-                    vkeys, drafts, qlps, plps
-                )
-                gs = jnp.where(sampled[None, :], gs_s, gs_g)
-                m = jnp.where(sampled, m_s, m_g)
-                count = jnp.where(active, m + 1, 0).astype(jnp.int32)
-
-                # replay ONLY the emitted tokens into the pre-round window
-                # (the score scan's evolution was provisional)
-                def replay(rec, i):
-                    upd = update_recent_tokens(rec, gs[i])
-                    keep = (i <= m) & active
-                    return jnp.where(keep[:, None], upd, rec), None
-
-                recent, _ = jax.lax.scan(replay, recent, jnp.arange(K))
-                nxt = jnp.take_along_axis(gs, m[None, :], axis=0)[0]  # (M,)
-                next_tok = jnp.where(active, nxt, tok[:, 0])[:, None]
-                return gs, count, next_tok, KVCache(
-                    k=k, v=v, offset=off0 + count
-                ), recent
-
             self._spec_progs[cache_key] = jax.jit(
-                prog, donate_argnums=(7, 9)
+                self._spec_verify_fn(K), donate_argnums=(7, 9)
             )
         return self._spec_progs[cache_key]
+
+    def spec_verify_ngram_cb(self, K: int):
+        """The :meth:`spec_verify_cb` program for DETERMINISTIC (n-gram
+        prompt-lookup) proposals: q is the one-hot distribution on the
+        proposed token, built in-jit from the (K, M) draft ids — the host
+        never ships a (K, M, V) array and there is no draft engine or
+        draft KV at all. Returns a jitted ``prog(layer_params, masks,
+        vparts, shared, tok, drafts, cache, active, recent, vkeys, sp,
+        rep_sizes, wcap, table) -> (gs, count, next_tok, cache, recent)``."""
+        cache_key = ("verify_ngram", K)
+        if cache_key not in self._spec_progs:
+            from mlx_sharding_tpu.speculative import one_hot_draft_logprobs
+
+            raw = self._spec_verify_fn(K)
+            vocab = self.vocab_size
+
+            def prog(layer_params, masks, vparts, shared, tok, drafts,
+                     cache, active, recent, vkeys, sp, rep_sizes, wcap,
+                     table):
+                qlps = one_hot_draft_logprobs(drafts, vocab)
+                return raw(layer_params, masks, vparts, shared, tok, drafts,
+                           qlps, cache, active, recent, vkeys, sp, rep_sizes,
+                           wcap, table)
+
+            self._spec_progs[cache_key] = jax.jit(
+                prog, donate_argnums=(6, 8)
+            )
+        return self._spec_progs[cache_key]
+
+    def _spec_verify_fn(self, K: int):
+        """The raw (unjitted) verify program shared by the draft-engine and
+        n-gram entry points (see :meth:`spec_verify_cb` for semantics)."""
+        from mlx_sharding_tpu.speculative import rejection_round
+
+        M, B = self.microbatches, self.batch
+        if B != 1:
+            raise ValueError("continuous batching expects batch=1 per slot")
+        inner = self._build_smapped(t_len=K, paged=self.paged, keep_all=True)
+        if not self.paged:
+            dense = inner
+            inner = lambda *args: dense(*args[:-1])  # drop the table arg
+        n_valid = jnp.asarray(K, jnp.int32)
+
+        def prog(layer_params, masks, vparts, shared, tok, drafts, qlps,
+                 cache, active, recent, vkeys, sp, rep_sizes, wcap, table):
+            x = jnp.concatenate([tok, drafts[:-1].T], axis=1)  # (M, K)
+            off0 = cache.offset
+            logits_all, k, v = inner(
+                layer_params, masks, vparts, shared, x[:, None, :],
+                cache.k, cache.v, off0, active, n_valid, table,
+            )  # (M, 1, K, V)
+            logits_all = logits_all.reshape(M, K, -1)
+            W = recent.shape[1]
+            valid = jnp.arange(W)[None, :] >= (W - rep_sizes)[:, None]
+            sampled = sp.temperature > 0  # (M,)
+
+            def score(rec, i):
+                tl = transform_logits_batched(
+                    logits_all[:, i], jnp.where(valid, rec, -1), sp
+                )
+                g = jnp.argmax(tl, axis=-1).astype(jnp.int32)
+                plp = jax.nn.log_softmax(
+                    nucleus_logits_batched(tl, sp), axis=-1
+                )
+                # the token consumed at position i+1: the draft's
+                # proposal (sampled — exact on the accepted prefix,
+                # discarded past it) or the greedy verdict
+                rec = update_recent_tokens(
+                    rec, jnp.where(sampled, drafts[i], g)
+                )
+                return rec, (g, plp)
+
+            _, (gs_g, plps) = jax.lax.scan(score, recent, jnp.arange(K))
+            # greedy: longest agreement prefix, then the correction token
+            mism = gs_g != drafts
+            any_m = mism.any(axis=0)
+            m_g = jnp.where(any_m, jnp.argmax(mism, axis=0), K - 1)
+
+            # rejection sampling, one vmapped lane per slot
+            def rr(key_s, d, q, p):
+                gs, m, _ = rejection_round(
+                    key_s, d[:, None], q[:, None], p[:, None]
+                )
+                return gs[:, 0], m[0]
+
+            gs_s, m_s = jax.vmap(rr, in_axes=(0, 1, 1, 1), out_axes=(1, 0))(
+                vkeys, drafts, qlps, plps
+            )
+            gs = jnp.where(sampled[None, :], gs_s, gs_g)
+            m = jnp.where(sampled, m_s, m_g)
+            # per-slot adaptive window: clamp BEFORE anything commits
+            m = jnp.minimum(m, wcap - 1)
+            count = jnp.where(active, m + 1, 0).astype(jnp.int32)
+
+            # replay ONLY the emitted tokens into the pre-round window
+            # (the score scan's evolution was provisional)
+            def replay(rec, i):
+                upd = update_recent_tokens(rec, gs[i])
+                keep = (i <= m) & active
+                return jnp.where(keep[:, None], upd, rec), None
+
+            recent, _ = jax.lax.scan(replay, recent, jnp.arange(K))
+            nxt = jnp.take_along_axis(gs, m[None, :], axis=0)[0]  # (M,)
+            next_tok = jnp.where(active, nxt, tok[:, 0])[:, None]
+            return gs, count, next_tok, KVCache(
+                k=k, v=v, offset=off0 + count
+            ), recent
+
+        return prog
 
     def spec_replay_cb(self, K: int):
         """Replay ``K`` recorded tokens through the dense decode body to

@@ -990,12 +990,34 @@ class PodFleet:
             decode["pressure"] = round(self._local_decode_pressure(), 4)
         except Exception:  # noqa: BLE001 — advertise nothing, not garbage
             decode = {}
-        return {
+        spec = None
+        try:
+            # speculation summary rides the heartbeat so pod placement can
+            # see which hosts speculate and how well it pays (draft-engine
+            # WEIGHT trees already gossip via the registry block above —
+            # they live in the same WeightStore as the base)
+            fn = getattr(self.local, "spec_stats", None)
+            if fn is not None:
+                st = fn()
+                if st:
+                    spec = {
+                        "mode": st.get("mode"),
+                        "accept_rate": round(
+                            float(st.get("accept_rate", 0.0)), 4
+                        ),
+                        "rounds": st.get("rounds", 0),
+                    }
+        except Exception:  # noqa: BLE001 — advertise nothing, not garbage
+            spec = None
+        info = {
             "host": self.host_id,
             "fleet": self.autoscaler.local_info(),
             "decode": decode,
             "weights": self.registry.local_info(),
         }
+        if spec is not None:
+            info["spec"] = spec
+        return info
 
     def tick(self) -> dict:
         """Publish the heartbeat, run one pod-autoscaler decision."""

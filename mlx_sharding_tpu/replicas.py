@@ -866,6 +866,34 @@ class ReplicaSet:
             agg["drains"] = self.drains
         return agg
 
+    def spec_stats(self) -> Optional[dict]:
+        """Speculation telemetry summed across replica batchers (the
+        ``mst_spec_*`` gauge source when serving through a ReplicaSet).
+        None when no replica speculates, so a non-speculating fleet's
+        /metrics exposition stays label-free."""
+        with self._lock:
+            reps = list(self.replicas)
+        per = [
+            s for r in reps
+            if hasattr(r, "spec_stats")
+            for s in [r.spec_stats()]
+            if s is not None
+        ]
+        if not per:
+            return None
+        agg: dict = {
+            "mode": per[0].get("mode"),
+            "window_max": max(s.get("window_max", 0) for s in per),
+        }
+        for k in ("rounds", "draft_tokens", "accepted_tokens",
+                  "fallback_ticks", "replayed_tokens", "draft_faults",
+                  "disabled_slots", "shed_events"):
+            agg[k] = sum(s.get(k, 0) for s in per)
+        agg["accept_rate"] = (
+            agg["accepted_tokens"] / max(1, agg["draft_tokens"])
+        )
+        return agg
+
     def health(self) -> dict:
         """Partial-capacity health: ``draining`` while a drain is in
         progress, degraded (still serving) while at least one replica

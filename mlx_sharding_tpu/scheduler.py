@@ -97,6 +97,10 @@ from mlx_sharding_tpu.sample import (
     sample_token_batched,
     stack_sampler_params,
 )
+from mlx_sharding_tpu.speculative import (
+    AcceptanceTracker,
+    NgramDraftProposer,
+)
 
 
 def _note_pages(owner, pages, *, acquired: bool):
@@ -198,6 +202,27 @@ class _InflightBlock:
     prev_tok: Optional[object] = None  # block's first input (draft replay)
 
 
+@dataclass
+class _InflightSpec:
+    """A dispatched-but-unharvested speculative round: the (count, gs)
+    output futures plus the host-side plan needed to emit, account and
+    train the acceptance tracker at harvest. The async ngram tick keeps at
+    most one of these in flight (same double-buffer slot as
+    :class:`_InflightBlock`)."""
+
+    outs: object                     # (count (M,), gs (K, M)) futures
+    live: list                       # [(slot, req)] snapshot at dispatch
+    wins: dict                       # slot → policy window used this round
+    wcaps: object                    # np (M,) effective per-slot caps
+    K: int                           # round width (max live window)
+    # optimistic continuation per slot (the proposals, assumed accepted):
+    # while THIS round is in flight, the next async dispatch appends these
+    # to the slot's host-visible history so its n-gram lookup sees an
+    # up-to-date tail. A wrong guess only costs that round's acceptance —
+    # the verify never trusts proposals, so exactness is unaffected.
+    guess: dict = field(default_factory=dict)
+
+
 # Retry-After clamps for 429 sheds: the estimate comes from the OBSERVED
 # completion rate (below), not a fixed constant, bounded so a mis-sampled
 # rate can neither tell clients "come back now" nor park them for minutes.
@@ -262,6 +287,8 @@ class ContinuousBatcher:
     def __init__(self, engine, *, repetition_window: int = 64, decode_block: int = 8,
                  policy: str = "fifo", prefix_cache: bool = False,
                  overcommit: bool = False, draft_engine=None, spec_k: int = 4,
+                 draft: str = "auto", spec_window_max: Optional[int] = None,
+                 spec_clock=time.monotonic,
                  max_queue: Optional[int] = None, async_sched: str = "auto",
                  spill_bytes: Optional[int] = None,
                  spill_cold_after: Optional[int] = None,
@@ -271,6 +298,53 @@ class ContinuousBatcher:
             raise ValueError("continuous batching expects engine batch=1")
         if max_queue is not None and (not isinstance(max_queue, int) or max_queue < 1):
             raise ValueError(f"max_queue must be a positive int, got {max_queue!r}")
+        if draft not in ("auto", "off", "ngram", "engine"):
+            raise ValueError(
+                f"draft must be 'auto', 'off', 'ngram' or 'engine', got "
+                f"{draft!r}"
+            )
+        # the draft MODE: 'auto' keeps the legacy contract — engine iff a
+        # draft engine was handed in, otherwise no speculation
+        spec_mode = draft
+        if spec_mode == "auto":
+            spec_mode = "engine" if draft_engine is not None else "off"
+        if spec_mode == "engine" and draft_engine is None:
+            raise ValueError(
+                "draft='engine' needs a draft engine (--draft-model)"
+            )
+        if spec_mode != "engine" and draft_engine is not None:
+            raise ValueError(
+                f"a draft engine was given but draft={draft!r} — drop the "
+                "draft engine or select draft='engine'/'auto'"
+            )
+        if spec_mode == "ngram":
+            if engine.num_stages != 1:
+                raise ValueError(
+                    "speculative continuous batching needs a pp=1 engine "
+                    "(the verify wants the keep_all vectorized body)"
+                )
+            if jax.process_count() > 1:
+                # the worker-mirror protocol (multihost.serve_worker_batched)
+                # has no speculative op: a controller-local spec round would
+                # desync the mirrored op streams
+                raise ValueError(
+                    "--draft ngram is not supported in multi-host serving: "
+                    "worker mirrors replay plain decode ticks only; run it "
+                    "on single-host replicas (e.g. behind --replicas) instead"
+                )
+        if spec_window_max is not None:
+            if isinstance(spec_window_max, bool) \
+                    or not isinstance(spec_window_max, int) \
+                    or spec_window_max < 2:
+                raise ValueError(
+                    f"spec_window_max must be an int >= 2, got "
+                    f"{spec_window_max!r}"
+                )
+            if spec_mode == "off":
+                raise ValueError(
+                    "spec_window_max needs a draft mode — select "
+                    "--draft ngram or --draft engine"
+                )
         if draft_engine is not None:
             # speculative x continuous batching: the draft engine mirrors the
             # target's slot structure (same M, same chunking) with its own
@@ -583,17 +657,56 @@ class ContinuousBatcher:
         # differently than non-speculative decode, as in speculative.py).
         self.draft = draft_engine
         self.spec_k = spec_k
-        # async tick pipelining: resolved mode ("auto" = on for plain
-        # single-host decode, off when speculating or multi-host)
+        self._spec_mode = spec_mode  # "off" | "ngram" | "engine"
+        # async tick pipelining: resolved mode. "auto" turns it on for any
+        # tick whose in-flight work is a pure device-side chain — plain
+        # single-host decode AND n-gram speculation (host-built drafts, no
+        # draft KV); a draft ENGINE forces sync (the round harvests accept
+        # counts the next proposals depend on), multi-host forces sync
+        # (worker mirrors replay per broadcast tick). The reason is kept on
+        # the instance and logged so `--async-sched auto` says WHY.
         self.async_sched = async_sched
-        self._async = async_sched == "on" or (
-            async_sched == "auto"
-            and draft_engine is None
-            and jax.process_count() <= 1
-        )
-        # the block in flight (dispatched, not harvested); owned by the
+        if async_sched == "on":
+            self._async = True
+            reason = "async ticks: async_sched='on'"
+        elif async_sched == "off":
+            self._async = False
+            reason = "sync ticks: async_sched='off'"
+        elif draft_engine is not None:
+            self._async = False
+            reason = (
+                "sync ticks: auto resolved to sync — the draft engine's "
+                "speculative rounds harvest per-round accept counts that "
+                "the next round's proposals depend on, so there is no "
+                "device-side chain to run ahead on"
+            )
+        elif jax.process_count() > 1:
+            self._async = False
+            reason = (
+                "sync ticks: auto resolved to sync — multi-host worker "
+                "mirrors replay the op stream per broadcast tick; a "
+                "rank-local lookahead block would desync them"
+            )
+        elif spec_mode == "ngram":
+            self._async = True
+            reason = (
+                "async ticks: auto resolved to async — n-gram drafts are "
+                "host-built (no draft engine, no draft KV), so the "
+                "speculative round chains pure device-side like a plain "
+                "decode block"
+            )
+        else:
+            self._async = True
+            reason = (
+                "async ticks: auto resolved to async — plain single-host "
+                "decode is a pure device-side chain"
+            )
+        self.async_reason = reason
+        logging.getLogger(__name__).info("%s", reason)
+        # the work in flight (dispatched, not harvested): a plain decode
+        # block or, in async ngram mode, a speculative round. Owned by the
         # scheduler thread, always None in sync mode outside _decode_once
-        self._inflight: Optional[_InflightBlock] = None
+        self._inflight: Optional[object] = None  # _InflightBlock | _InflightSpec
         # per-tick timing (racy gauges by design, like kv_bytes_read_*):
         # device_blocked measures the harvest device_get; host is the rest
         # of the tick's wall time — the work the async path overlaps
@@ -622,31 +735,60 @@ class ContinuousBatcher:
         # resume stalls visible next to the async-sched gauges
         self.tick_kv_import_ms_last = 0.0
         self._tick_kv_import_s_total = 0.0
+        # adaptive window control: an AcceptanceTracker drives per-slot
+        # windows for ngram mode always, and for engine mode when the
+        # operator opts in with spec_window_max (without it the engine path
+        # keeps the legacy fixed-K contract: every round is exactly spec_k
+        # wide). The tracker's clock is injectable for deterministic tests.
+        if spec_mode == "ngram" or (
+            spec_mode == "engine" and spec_window_max is not None
+        ):
+            self.spec_tracker: Optional[AcceptanceTracker] = AcceptanceTracker(
+                self.M, w_max=spec_window_max or 8, clock=spec_clock
+            )
+            self._w_max = self.spec_tracker.rungs[-1]
+        else:
+            self.spec_tracker = None
+            self._w_max = spec_k if spec_mode == "engine" else 0
+        self._ngram = NgramDraftProposer() if spec_mode == "ngram" else None
         # over-commit page growth must cover whichever step writes furthest
-        # ahead: a decode block (1 write/step), TWO decode blocks when the
-        # pipeline runs a block ahead of the host's emitted counts (at
-        # dispatch of block t+1 the host has harvested only through t-1),
-        # or a T=K speculative verify
-        self._grow_ahead = (
-            max(decode_block, spec_k) if draft_engine is not None
-            else (2 if self._async else 1) * self.decode_block
-        )
-        if draft_engine is not None:
+        # ahead: a decode block (1 write/step), a T=K speculative verify,
+        # and DOUBLE that when the pipeline runs a block/round ahead of the
+        # host's emitted counts (at dispatch of t+1 the host has harvested
+        # only through t-1)
+        reach = self.decode_block
+        if spec_mode == "engine":
+            reach = max(reach, spec_k, self._w_max)
+        elif spec_mode == "ngram":
+            reach = max(reach, self._w_max)
+        self._grow_ahead = (2 if self._async else 1) * reach
+        if spec_mode != "off":
             self.rounds = 0          # spec telemetry: verify rounds x slots
-            self.accepted_tokens = 0  # tokens EMITTED by those rounds
+            self.accepted_tokens = 0  # tokens EMITTED by speculating slots
+            self.draft_tokens = 0    # proposal tokens offered to verifies
             # ticks that fell back to plain decode (spec paused) and the
             # tokens replayed through the draft to keep its KV in sync
             self.fallback_ticks = 0
             self.replayed_tokens = 0
+            # spec.draft faults absorbed → that tick ran plain decode
+            self.spec_draft_faults = 0
+        if draft_engine is not None:
             self.dcache = draft_engine.init_cache()
-            k_ = spec_k
             self._split3 = jax.jit(
                 lambda ks: jax.vmap(lambda k: jax.random.split(k, 3))(ks)
             )
             # draft consumed [t0, d1..d_{K-1}] = K rows; keep the verified
-            # prefix (the accepted tokens ARE the draft's inputs there)
+            # prefix (the accepted tokens ARE the draft's inputs there).
+            # k is the ROUND's width — adaptive rounds can run narrower
+            # than spec_k
             self._drewind = jax.jit(
-                lambda off, count, act: off + jnp.where(act, count - k_, 0)
+                lambda off, count, act, k: off + jnp.where(act, count - k, 0)
+            )
+        elif spec_mode == "ngram":
+            # sampled ngram rounds split each slot's key once for the
+            # verify (no draft-side key, unlike the engine path's 3-way)
+            self._split2 = jax.jit(
+                lambda ks: jax.vmap(lambda k: jax.random.split(k, 2))(ks)
             )
         if self.paged:
             self.cache, self.table = engine.init_cache_paged()
@@ -990,8 +1132,10 @@ class ContinuousBatcher:
         """Brownout ladder input from the fleet controller (fleet.py):
         level >= 1 pauses prefix-store INSERTION (serving hits stays on —
         reuse sheds prefill work exactly when the fleet needs it), level
-        >= 2 pauses speculation, level >= 3 halves the effective admission
-        bound. Idempotent; levels outside [0, 3] are clamped."""
+        >= 2 sheds speculation — globally in legacy fixed-K mode, per-slot
+        lowest-acceptance-first with an AcceptanceTracker — and level >= 3
+        halves the effective admission bound (and sheds speculation
+        everywhere). Idempotent; levels outside [0, 3] are clamped."""
         lvl = max(0, min(3, int(level)))
         with self._admission_lock:
             self._pressure = lvl
@@ -1018,6 +1162,28 @@ class ContinuousBatcher:
                 "migrations_in": self.migrations_in,
                 "handoffs_out": self.handoffs_out,
             }
+
+    def spec_stats(self) -> Optional[dict]:
+        """Speculation telemetry for /metrics (``mst_spec_*``); None when
+        the batcher never speculates, so a non-speculating host's exposition
+        stays label-free. Racy counter snapshot by design — gauges, not
+        decision inputs."""
+        if self._spec_mode == "off":
+            return None
+        out = {
+            "mode": self._spec_mode,
+            "window_max": self._w_max,
+            "rounds": self.rounds,
+            "draft_tokens": self.draft_tokens,
+            "accepted_tokens": self.accepted_tokens,
+            "accept_rate": self.accepted_tokens / max(1, self.draft_tokens),
+            "fallback_ticks": self.fallback_ticks,
+            "replayed_tokens": self.replayed_tokens,
+            "draft_faults": self.spec_draft_faults,
+        }
+        if self.spec_tracker is not None:
+            out.update(self.spec_tracker.stats())
+        return out
 
     def spill_stats(self) -> Optional[dict]:
         """KV spill/migration counters + tier occupancy for /metrics
@@ -1718,6 +1884,10 @@ class ContinuousBatcher:
                     self._put(jnp.asarray(0, jnp.int32)),
                 )
             )
+        if self.spec_tracker is not None:
+            # new stream in the slot: window back to the probe rung, no
+            # carried-over acceptance history from the previous occupant
+            self.spec_tracker.reset(slot)
         self._slots[slot] = req
         note_acquire("scheduler.slot", (id(self), slot))
         req.slot = slot
@@ -2053,11 +2223,20 @@ class ContinuousBatcher:
                     # dead slot's offset one block past its true end; queue
                     # a rewind CHAINED AFTER it (self.cache is its output
                     # future) so the reclaimed slot's offset never points
-                    # past the pages just returned — no host sync involved
+                    # past the pages just returned — no host sync involved.
+                    # A speculative round advances by its data-dependent
+                    # accepted count, not decode_block: rewind by the same
+                    # device-side value (still future-chained, still async)
+                    if isinstance(self._inflight, _InflightSpec):
+                        amount = self._inflight.outs[0][req.slot]
+                    else:
+                        amount = self._put(
+                            jnp.asarray(self.decode_block, jnp.int32)
+                        )
                     self.cache = self._rewind_offset(
                         self.cache,
                         self._put(jnp.asarray(req.slot, jnp.int32)),
-                        self._put(jnp.asarray(self.decode_block, jnp.int32)),
+                        amount,
                     )
             self._slots[req.slot] = None
             note_release("scheduler.slot", (id(self), req.slot))
@@ -2787,16 +2966,22 @@ class ContinuousBatcher:
     def _spec_ok(self) -> bool:
         """A tick can take the speculative round iff no decoding slot wants
         logprob summaries (the verify doesn't compute them) and every
-        decoding slot has K rows of KV headroom — the verify writes K
-        positions speculatively, and past max_seq the dynamic-slice clamp
-        would corrupt valid rows. Ticks that fail the check run a plain
-        decode block (all slots still advance, just unspeculated)."""
-        if self._pressure >= 2:
-            # brownout level 2+: draft compute is ballast under overload —
-            # spend the flops on guaranteed tokens (racy gauge-grade read;
-            # the fallback tick path handles the draft-KV replay)
+        decoding slot has window-max rows of KV headroom — the verify
+        writes up to that many positions speculatively, and past max_seq
+        the dynamic-slice clamp would corrupt valid rows. Async ngram ticks
+        double the margin: at dispatch of round t+1 the host has harvested
+        only through t-1, so the true frontier can be a full round ahead of
+        ``history``. Ticks that fail the check run a plain decode block
+        (all slots still advance, just unspeculated)."""
+        if self._pressure >= 2 and self.spec_tracker is None:
+            # legacy fixed-K engine mode: brownout level 2+ pauses
+            # speculation globally — draft compute is ballast under
+            # overload (racy gauge-grade read; the fallback tick path
+            # handles the draft-KV replay). With a tracker the shed is
+            # per-slot, lowest-acceptance-first (effective_windows).
             return False
-        K, ms = self.spec_k, self.engine.max_seq
+        K = (2 if self._async else 1) * self._w_max
+        ms = self.engine.max_seq
         for req in self._slots:
             if req is None or not self._prefill_done(req):
                 continue
@@ -2810,14 +2995,47 @@ class ContinuousBatcher:
                 return False
         return True
 
-    def _spec_once(self):
-        """One speculative round for every decoding slot: K batched draft
-        proposals, one T=K target verify, per-slot acceptance (greedy exact
-        prefix / rejection sampling with the slot's own key chain), emitted
-        counts pulled host-side. The draft's cache rewinds to each slot's
-        verified prefix — rollback is one scalar per slot, same as the
-        single-stream SpeculativeGenerator."""
-        eng, d, K = self.engine, self.draft, self.spec_k
+    def _spec_draft_ok(self) -> bool:
+        """``spec.draft`` fault site, checked before each speculative
+        round's proposals: a faulted draft degrades THAT tick to plain
+        decode — counted, never a wrong or dropped stream (the fallback
+        path replays the block through a draft engine's KV as usual)."""
+        try:
+            inject("spec.draft", engine=id(self))
+        except Exception:
+            self.spec_draft_faults += 1
+            return False
+        return True
+
+    def _spec_plan(self, live):
+        """Per-round window plan: ``(K, wins)`` where K is the round width
+        (max live window) and wins maps slot → policy window, or None when
+        no live slot speculates this round (the tick runs plain decode).
+        Without a tracker (legacy fixed-K engine mode) every slot gets
+        spec_k. With one, windows come from the per-slot controller after
+        brownout shedding (level 2 sheds lowest-acceptance-first, level 3+
+        sheds all — see AcceptanceTracker.effective_windows)."""
+        if self.spec_tracker is None:
+            return self.spec_k, {slot: self.spec_k for slot, _ in live}
+        wins = self.spec_tracker.effective_windows(
+            [slot for slot, _ in live], self._pressure
+        )
+        K = max(wins.values(), default=0)
+        if K < 2:
+            return None
+        return K, wins
+
+    def _dispatch_spec(self, prev_guess=None) -> Optional[_InflightSpec]:
+        """Dispatch one speculative round for every decoding slot and
+        return its handle WITHOUT waiting: proposals (host-built n-gram
+        lookups, or K batched draft-engine steps), one T=K target verify
+        with per-slot window caps, all device outputs left as futures.
+        Slots whose window is 0 (disabled/shed) ride along with wcap=1 —
+        they emit exactly the correction token, i.e. a plain decode step.
+        ``prev_guess`` is the in-flight round's optimistic continuation per
+        slot (async: host history is one round stale at dispatch). Returns
+        None when no slot speculates — the caller runs a plain tick."""
+        eng = self.engine
         if self.paged and self.overcommit:
             self._grow_for_decode()
         live = [
@@ -2825,42 +3043,144 @@ class ContinuousBatcher:
             if req is not None and self._prefill_done(req)
         ]
         if not live:
-            return
+            return None
+        plan = self._spec_plan(live)
+        if plan is None:
+            return None
+        K, wins = plan
         # the T=K verify always takes the gather path (chunked writes want
         # the contiguous buffer), whatever the decode tick uses
         self._account_kv_read(live, 1, path="gather")
-        keys3 = self._split3(self.keys)
-        self.keys, dkeys, vkeys = keys3[:, 0], keys3[:, 1], keys3[:, 2]
-        drafts, qlps, self.dcache = d.spec_propose_cb(K)(
-            d.layer_params, d.layer_masks, d.vocab_parts, d.shared_params,
-            self.last_tok, self.dcache, self.active, self.recent, dkeys,
-            self.sp, self.rep_sizes,
-        )
-        gs, count, self.last_tok, self.cache, self.recent = eng.spec_verify_cb(K)(
-            eng.layer_params, eng.layer_masks, eng.vocab_parts,
-            eng.shared_params, self.last_tok, drafts, qlps, self.cache,
-            self.active, self.recent, vkeys, self.sp, self.rep_sizes,
-            self.table,
-        )
-        self.dcache = self.dcache._replace(
-            offset=self._drewind(self.dcache.offset, count, self.active)
-        )
-        # THE spec-tick sync — accepted counts + token ids reach the host
-        # in one transfer (the round's single harvest)
+        wcaps = np.ones((self.M,), np.int32)
+        guess: dict = {}
+        if self._spec_mode == "ngram":
+            prev_guess = prev_guess or {}
+            drafts_np = np.zeros((K, self.M), np.int32)
+            for slot, req in live:
+                w = wins.get(slot, 0)
+                if w < 2:
+                    continue
+                toks = np.concatenate(
+                    [req.prompt, np.asarray(req.history, np.int32)]
+                )
+                tail = prev_guess.get(slot)
+                if tail is not None and tail.size:
+                    toks = np.concatenate([toks, tail])
+                d, n_valid = self._ngram.propose(toks, w)
+                drafts_np[:w, slot] = d
+                wcaps[slot] = min(w, max(1, n_valid))
+                guess[slot] = d[: wcaps[slot]]
+            keys2 = self._split2(self.keys)
+            self.keys, vkeys = keys2[:, 0], keys2[:, 1]
+            drafts = self._put(jnp.asarray(drafts_np))
+            gs, count, self.last_tok, self.cache, self.recent = \
+                eng.spec_verify_ngram_cb(K)(
+                    eng.layer_params, eng.layer_masks, eng.vocab_parts,
+                    eng.shared_params, self.last_tok, drafts, self.cache,
+                    self.active, self.recent, vkeys, self.sp,
+                    self.rep_sizes, self._put(jnp.asarray(wcaps)),
+                    self.table,
+                )
+        else:
+            d = self.draft
+            for slot, _req in live:
+                wcaps[slot] = max(1, wins.get(slot, 0))
+            keys3 = self._split3(self.keys)
+            self.keys, dkeys, vkeys = keys3[:, 0], keys3[:, 1], keys3[:, 2]
+            drafts, qlps, self.dcache = d.spec_propose_cb(K)(
+                d.layer_params, d.layer_masks, d.vocab_parts, d.shared_params,
+                self.last_tok, self.dcache, self.active, self.recent, dkeys,
+                self.sp, self.rep_sizes,
+            )
+            gs, count, self.last_tok, self.cache, self.recent = \
+                eng.spec_verify_cb(K)(
+                    eng.layer_params, eng.layer_masks, eng.vocab_parts,
+                    eng.shared_params, self.last_tok, drafts, qlps,
+                    self.cache, self.active, self.recent, vkeys, self.sp,
+                    self.rep_sizes, self._put(jnp.asarray(wcaps)),
+                    self.table,
+                )
+            self.dcache = self.dcache._replace(
+                offset=self._drewind(
+                    self.dcache.offset, count, self.active,
+                    jnp.asarray(K, jnp.int32),
+                )
+            )
+        return _InflightSpec(outs=(count, gs), live=live, wins=wins,
+                             wcaps=wcaps, K=K, guess=guess)
+
+    def _harvest_spec(self, inf: Optional[_InflightSpec]):
+        """Pull a dispatched speculative round's (counts, tokens) to the
+        host and run its host-side consequences: per-slot emit of the
+        accepted prefix + correction token, acceptance accounting, and the
+        tracker update that resizes each slot's next window. The ONE
+        ``device_get`` here is the round's tick sync (MST104's single
+        harvest point, spec flavor)."""
+        if inf is None:
+            return
+        t0 = time.perf_counter()
         # mst: allow(MST102): the spec round's one consolidated harvest
-        counts, gs_h = jax.device_get((count, gs))
-        self.rounds += len(live)
-        for slot, req in live:
+        counts, gs_h = jax.device_get(inf.outs)
+        blocked = time.perf_counter() - t0
+        self.tick_device_blocked_ms_last = blocked * 1000.0
+        self._tick_blocked_s_total += blocked
+        self._tick_count += 1
+        self.rounds += len(inf.live)
+        for _, _req in inf.live:
+            _tr = _req._trace
+            if _tr is not None:
+                _tr.add("spec_round", t0, t0 + blocked, slot=_req.slot,
+                        window=inf.K)
+        for slot, req in inf.live:
             emitted = 0
             for j in range(int(counts[slot])):
                 if req.slot != slot:
                     break  # finished (max_tokens) earlier in this round
                 self._emit(req, int(gs_h[j, slot]), None)
                 emitted += 1
-            # count what actually reached the consumer: a slot that hits
-            # max_tokens mid-round drops the rest of its accepted prefix,
-            # and counting those would overstate the acceptance rate
-            self.accepted_tokens += emitted
+            w = inf.wins.get(slot, 0)
+            if w >= 2:
+                # count what actually reached the consumer: a slot that
+                # hits max_tokens mid-round drops the rest of its accepted
+                # prefix, and counting those would overstate the acceptance
+                # rate. Disabled/shed slots ride along as plain decode
+                # (wcap=1) — counting their correction token as "accepted"
+                # with no draft spend would push accept_rate past 1.0.
+                self.accepted_tokens += emitted
+                self.draft_tokens += int(inf.wcaps[slot])
+                if self.spec_tracker is not None and req.slot == slot:
+                    # train on the verify's verdict (the full accepted
+                    # count), not the max_tokens-truncated emission
+                    self.spec_tracker.observe(slot, w, int(counts[slot]))
+
+    def _spec_once(self):
+        """One synchronous speculative round: dispatch + immediate harvest
+        (the sync composition point, like _decode_once for plain ticks)."""
+        self._harvest_spec(self._dispatch_spec())
+
+    def _spec_tick(self) -> bool:
+        """Try to make this sync tick a speculative round. False means the
+        caller must run a plain decode block instead — speculation is off,
+        gated (_spec_ok), fault-degraded (spec.draft), or the per-slot plan
+        came up empty (every window 0/disabled)."""
+        if self._spec_mode == "off":
+            return False
+        if not (self._spec_ok() and self._spec_draft_ok()):
+            return False
+        inf = self._dispatch_spec()
+        if inf is None:
+            return False
+        self._harvest_spec(inf)
+        return True
+
+    def _harvest_any(self, inf):
+        """Harvest whichever flavor of in-flight work ``inf`` is — the
+        async tick's lookahead slot can hold a plain decode block or a
+        speculative round (ngram mode) interchangeably."""
+        if isinstance(inf, _InflightSpec):
+            self._harvest_spec(inf)
+        else:
+            self._harvest(inf)
 
     def _fits(self, req: _Request) -> bool:
         if not self.paged:
@@ -2989,7 +3309,7 @@ class ContinuousBatcher:
         still mutating: admission prefill, preemption, pool-pressure growth
         that might preempt, shutdown."""
         inf, self._inflight = self._inflight, None
-        self._harvest(inf)
+        self._harvest_any(inf)
 
     def _growth_fits(self) -> bool:
         """True iff the next ``_grow_for_decode`` is guaranteed to cover
@@ -3077,8 +3397,23 @@ class ContinuousBatcher:
                 # reshuffle): only safe against a drained pipeline
                 self._quiesce()
             prev, self._inflight = self._inflight, None
-            self._inflight = self._dispatch_block()
-            self._harvest(prev)
+            nxt = None
+            if (
+                self._spec_mode == "ngram"
+                and self._spec_ok()
+                and self._spec_draft_ok()
+            ):
+                # host history is one round stale here (prev not harvested
+                # yet): extend it with prev's optimistic guess so the
+                # n-gram match sees the tokens prev is about to emit. A
+                # wrong guess only costs acceptance, never exactness.
+                nxt = self._dispatch_spec(
+                    prev.guess if isinstance(prev, _InflightSpec) else None
+                )
+            if nxt is None:
+                nxt = self._dispatch_block()
+            self._inflight = nxt
+            self._harvest_any(prev)
         else:
             self._quiesce()  # leftover lookahead block of finished slots
             if not any(self._slots):
@@ -3131,9 +3466,7 @@ class ContinuousBatcher:
             # prefill-only completions leave before the decode block
             self._handoff_out()
         if self._decoding():
-            if self.draft is not None and self._spec_ok():
-                self._spec_once()
-            else:
+            if not self._spec_tick():
                 self._decode_once()
         elif not any(self._slots):
             # idle: block until the next request arrives (bounded wait,

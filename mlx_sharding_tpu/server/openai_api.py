@@ -169,6 +169,8 @@ class ModelProvider:
         kv_prefetch: str = "auto",
         draft_model: Optional[str] = None,
         spec_k: int = 4,
+        draft: str = "auto",
+        spec_window_max: Optional[int] = None,
         prompt_cache: bool = False,
         prefix_store: bool = False,
         prefix_store_bytes: Optional[int] = None,
@@ -227,9 +229,15 @@ class ModelProvider:
         # fused-engine path.
         self.shared_weights = shared_weights
         self.shared_weights_active = False
-        # speculative decoding (single-chip generator path only)
+        # speculative decoding: --draft selects the proposal source
+        # ("auto" keeps the legacy contract — engine iff --draft-model,
+        # else off; "ngram" drafts from the stream's own history, no
+        # second checkpoint), --spec-window-max bounds the per-slot
+        # adaptive window ladder
         self.draft_model = draft_model
         self.spec_k = spec_k
+        self.draft_mode = draft
+        self.spec_window_max = spec_window_max
         # prompt-prefix KV reuse across requests (single-chip generator)
         self.prompt_cache = prompt_cache
         # fleet-wide content-addressed prefix KV store (prefix_store.py):
@@ -537,7 +545,32 @@ class ModelProvider:
                                 stage_bounds=self.stage_bounds,
                             )
 
-                    def build_engine(dev_slice, *, weights_lease=None):
+                        if draft_pair is not None:
+                            # the draft checkpoint is a WeightStore tree
+                            # exactly like the base: keyed by its own
+                            # checkpoint signature + placement, aliased by
+                            # every replica on this host, digest gossiped
+                            # over the pod heartbeat by the same registry
+                            draft_mesh = make_mesh(
+                                pp=1, tp=1, ep=1, devices=devices[:per]
+                            )
+                            draft_key = WeightKey(
+                                checkpoint=checkpoint_signature(
+                                    self.draft_model,
+                                    keep_quantized=self.keep_quantized,
+                                ),
+                                stage_bounds=("auto", 1),
+                                dtype=jnp.dtype(cache_dtype).name,
+                                quant="draft",
+                                placement=mesh_fingerprint(draft_mesh),
+                            )
+
+                            def build_draft_weights():
+                                dm, dp = draft_pair
+                                return place_weights(dm, dp, draft_mesh)
+
+                    def build_engine(dev_slice, *, weights_lease=None,
+                                     speculate=True):
                         if weights_lease is not None:
                             engine = PipelineEngine(
                                 model, None, weights_lease.weights.mesh,
@@ -580,17 +613,41 @@ class ModelProvider:
                             )
 
                             draft_eng = None
-                            if draft_pair is not None:
+                            if draft_pair is not None and speculate:
                                 dmodel, dparams = draft_pair
-                                draft_eng = PipelineEngine(
-                                    dmodel, dparams,
-                                    make_mesh(pp=1, tp=1, ep=1,
-                                              devices=dev_slice),
-                                    microbatches=self.concurrent,
-                                    max_seq=self.max_seq,
-                                    cache_dtype=cache_dtype,
-                                    prefill_chunk=self.prefill_chunk,
-                                )
+                                if shared:
+                                    # alias the store's resident draft
+                                    # tree; the ref drops when the batcher
+                                    # closes this engine. Same spawn
+                                    # contract as the base tree: a faulted
+                                    # build releases before re-raising.
+                                    def make_draft(dlease):
+                                        deng = PipelineEngine(
+                                            dmodel, None,
+                                            dlease.weights.mesh,
+                                            weights=dlease.weights,
+                                            microbatches=self.concurrent,
+                                            max_seq=self.max_seq,
+                                            cache_dtype=cache_dtype,
+                                            prefill_chunk=self.prefill_chunk,
+                                        )
+                                        deng.on_close(dlease.release)
+                                        return deng
+
+                                    draft_eng = aliased_spawn(
+                                        store, draft_key,
+                                        build_draft_weights, make_draft,
+                                    )
+                                else:
+                                    draft_eng = PipelineEngine(
+                                        dmodel, dparams,
+                                        make_mesh(pp=1, tp=1, ep=1,
+                                                  devices=dev_slice),
+                                        microbatches=self.concurrent,
+                                        max_seq=self.max_seq,
+                                        cache_dtype=cache_dtype,
+                                        prefill_chunk=self.prefill_chunk,
+                                    )
                             engine = ContinuousBatcher(
                                 engine,
                                 decode_block=min(8, self.decode_block),
@@ -602,13 +659,18 @@ class ModelProvider:
                                 kv_prefetch=self.kv_prefetch,
                                 draft_engine=draft_eng,
                                 spec_k=self.spec_k,
+                                draft=self.draft_mode if speculate else "off",
+                                spec_window_max=(
+                                    self.spec_window_max if speculate
+                                    else None
+                                ),
                                 max_queue=self.max_queue,
                                 async_sched=self.async_sched,
                                 prefix_store=pstore,
                             )
                         return engine
 
-                    def spawn_replica():
+                    def spawn_replica(speculate=True):
                         """One replica by either strategy: alias the
                         store's resident tree (shared) or take a private
                         device slice and upload a full copy. Both paths
@@ -616,17 +678,23 @@ class ModelProvider:
                         lease is released / the slice returned before the
                         error propagates, so the autoscaler degrades to
                         the static fleet with nothing leaked and nothing
-                        freed in use."""
+                        freed in use. ``speculate=False`` builds a
+                        non-drafting replica (disagg prefill pools: a
+                        prefill replica emits one token per request, so
+                        draft windows there are pure ballast)."""
                         if shared:
                             return aliased_spawn(
                                 store, key, build_weights,
                                 lambda lease: build_engine(
-                                    devices[:per], weights_lease=lease
+                                    devices[:per], weights_lease=lease,
+                                    speculate=speculate,
                                 ),
                             )
                         i = alloc.take()
                         try:
-                            eng = build_engine(alloc.slice_for(i))
+                            eng = build_engine(
+                                alloc.slice_for(i), speculate=speculate
+                            )
                         except BaseException:
                             alloc.give(i)
                             raise
@@ -655,8 +723,17 @@ class ModelProvider:
                             )
                         n_pf = self.prefill_replicas
                         n_dc = self.decode_replicas
+                        # role-aware spawns: decode replicas speculate
+                        # (adaptive windows per stream), prefill replicas
+                        # never do — and their autoscaler factories below
+                        # inherit the same role
+                        import functools
+
+                        spawn_prefill = functools.partial(
+                            spawn_replica, speculate=False
+                        )
                         prefill = ReplicaSet([
-                            spawn_replica() for _ in range(n_pf)
+                            spawn_prefill() for _ in range(n_pf)
                         ], role="prefill", prefix_store=pstore)
                         decode = ReplicaSet([
                             spawn_replica() for _ in range(n_dc)
@@ -684,7 +761,9 @@ class ModelProvider:
                             spare = alloc.total - (n_pf + n_dc)
                             self.fleet = tuple(
                                 FleetAutoscaler(
-                                    pool, spawn_replica,
+                                    pool,
+                                    spawn_prefill if pool is prefill
+                                    else spawn_replica,
                                     min_replicas=base,
                                     max_replicas=base + (
                                         max(1, spare) if shared
@@ -758,6 +837,20 @@ class ModelProvider:
                             )
 
                             generator = MultiHostPipeline(generator)
+                elif self.draft_mode == "ngram":
+                    # single-stream prompt-lookup speculation: drafts from
+                    # the stream's own history, no second checkpoint
+                    from mlx_sharding_tpu.speculative import (
+                        NgramSpeculativeGenerator,
+                    )
+
+                    generator = NgramSpeculativeGenerator(
+                        model, params,
+                        spec_window_max=self.spec_window_max or 8,
+                        max_seq=self.max_seq, cache_dtype=cache_dtype,
+                        prefill_chunk=self.prefill_chunk,
+                        decode_block=self.decode_block,
+                    )
                 elif self.draft_model:
                     from mlx_sharding_tpu.speculative import (
                         SpeculativeGenerator,
@@ -1800,6 +1893,23 @@ def main(argv=None):
                              "Single-chip generator path only.")
     parser.add_argument("--spec-k", type=int, default=4,
                         help="speculation window (with --draft-model)")
+    parser.add_argument("--draft", choices=("auto", "off", "ngram", "engine"),
+                        default="auto",
+                        help="speculative proposal source. 'ngram' drafts "
+                             "by prompt-lookup against the stream's own "
+                             "prompt+history — no second checkpoint, no "
+                             "draft KV, free to enable on every decode "
+                             "host; 'engine' uses --draft-model; 'auto' "
+                             "(default) keeps the legacy contract: engine "
+                             "iff --draft-model, else off")
+    parser.add_argument("--spec-window-max", type=int, default=None,
+                        help="per-slot ADAPTIVE speculation windows, "
+                             "resized each round on an acceptance EWMA "
+                             "over the ladder {0,2,4,8} capped here "
+                             "(losing slots disable and re-probe). Always "
+                             "on for --draft ngram (default cap 8); opt-in "
+                             "for --draft engine (without it the engine "
+                             "path keeps fixed --spec-k rounds)")
     parser.add_argument("--replicas", type=int, default=1,
                         help="data-parallel serving: N independent engine "
                              "replicas, each on its own devices (stages x tp "
@@ -1857,8 +1967,11 @@ def main(argv=None):
                              "attempt) before the next one")
     parser.add_argument("--brownout", choices=("on", "off"), default="on",
                         help="overload brownout ladder: under sustained "
-                             "pressure cap max_tokens, pause speculation and "
-                             "tighten admission BEFORE shedding with 429; "
+                             "pressure cap max_tokens, shed speculation "
+                             "(per-slot lowest-acceptance-first under "
+                             "adaptive windows, globally in fixed-K engine "
+                             "mode) and tighten admission BEFORE shedding "
+                             "with 429; "
                              "level surfaced in /health and the "
                              "X-MST-Brownout-Level response header")
     parser.add_argument("--prompt-cache", action="store_true",
@@ -1904,9 +2017,12 @@ def main(argv=None):
                              "block t, overlapping host-side emit/stop/"
                              "admission work with device compute (token "
                              "streams stay bit-identical to sync). 'auto' "
-                             "(default) enables it for plain decode and "
-                             "falls back to sync with --draft-model or "
-                             "multi-host; 'off' forces the sequential tick")
+                             "(default) enables it for plain decode AND "
+                             "--draft ngram (host-built drafts chain pure "
+                             "device-side) and falls back to sync with "
+                             "--draft-model or multi-host — the resolution "
+                             "reason is logged at startup; 'off' forces "
+                             "the sequential tick")
     parser.add_argument("--max-seq", type=int, default=4096)
     parser.add_argument("--prefill-chunk", type=int, default=256)
     parser.add_argument("--request-timeout", type=float, default=None,
@@ -2041,6 +2157,31 @@ def main(argv=None):
                      "generator or to --concurrent serving "
                      "(no --coordinator/--tp/--ep/stage or "
                      "layer-range flags)")
+    if args.draft == "engine" and not args.draft_model:
+        parser.error("--draft engine needs --draft-model")
+    if args.draft_model and args.draft in ("off", "ngram"):
+        parser.error(f"--draft {args.draft} conflicts with --draft-model: "
+                     "drop one (--draft-model implies the engine proposer)")
+    if args.draft == "ngram" and (
+        (args.coordinator and (args.num_processes or 1) > 1
+         and not args.pod)
+        or args.tp > 1 or args.ep > 1 or args.stage_bounds
+        or (args.num_stages or 1) > 1 or args.engine == "chained"
+        or args.start_layer is not None or args.end_layer is not None
+    ):
+        parser.error("--draft ngram applies to the single-chip full-model "
+                     "generator or to --concurrent serving (the verify "
+                     "needs the pp=1 vectorized body; multi-host worker "
+                     "mirrors replay plain decode ticks only — run it on "
+                     "single-host replicas or --pod hosts instead)")
+    if args.spec_window_max is not None:
+        if args.spec_window_max < 2:
+            parser.error("--spec-window-max must be >= 2")
+        if args.draft == "off" or (
+            args.draft == "auto" and not args.draft_model
+        ):
+            parser.error("--spec-window-max needs a speculating server: "
+                         "--draft ngram or --draft-model")
     # ---- prompt-prefix reuse flags. --prefix-store (the fleet-wide
     # content-addressed store) SUBSUMES --prompt-cache (engine-local page
     # index): running both would put two owners over the same pool pages,
@@ -2096,11 +2237,13 @@ def main(argv=None):
     if args.replicas > 1 and (
         (args.coordinator and not args.pod) or args.engine == "chained"
         or (args.draft_model and args.concurrent <= 1)
+        or (args.draft == "ngram" and args.concurrent <= 1)
         or args.start_layer is not None or args.end_layer is not None
     ):
         parser.error("--replicas requires the fused full-model engine path "
                      "(no --coordinator/--engine chained/layer-range flags "
-                     "unless --pod; --draft-model only with --concurrent)")
+                     "unless --pod; --draft-model/--draft ngram only with "
+                     "--concurrent)")
     if args.paged_pool and args.concurrent <= 1:
         parser.error("--paged-pool requires --concurrent N (N > 1)")
     if args.paged_pool and args.engine == "chained":
@@ -2166,9 +2309,12 @@ def main(argv=None):
                          "path (no --coordinator/--engine chained) — or "
                          "--pod, where each host runs its own disagg pools")
         if args.draft_model:
-            parser.error("--disagg is incompatible with --draft-model "
-                         "(speculative slots cannot resume from a "
-                         "handed-off KV block)")
+            parser.error("--disagg is incompatible with --draft-model: a "
+                         "resumed stream's draft KV cannot be rebuilt from "
+                         "the handed-off block (only the target's pages "
+                         "travel). Use --draft ngram — prompt-lookup "
+                         "drafts need no draft KV, so decode replicas "
+                         "speculate on resumed streams too")
         if args.prefill_replicas < 1 or args.decode_replicas < 1:
             parser.error("--prefill-replicas/--decode-replicas must be "
                          "positive integers")
@@ -2253,6 +2399,7 @@ def main(argv=None):
         spill_cold_after=args.spill_cold_after,
         kv_prefetch=args.kv_prefetch,
         draft_model=args.draft_model, spec_k=args.spec_k,
+        draft=args.draft, spec_window_max=args.spec_window_max,
         prompt_cache=args.prompt_cache, replicas=args.replicas,
         prefix_store=args.prefix_store,
         prefix_store_bytes=args.prefix_store_bytes,

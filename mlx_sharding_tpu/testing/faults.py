@@ -50,6 +50,12 @@ Sites wired into the serving stack:
   point in ``PodHandoff.serve_remote``, before any wire work; ctx
   ``n_bytes=<block payload>`` (raise here to force the origin's local
   plan — serve-in-place with the block intact, never a dropped stream)
+- ``spec.draft``          — before each speculative round's draft
+  proposals (n-gram lookup or draft-engine forward); ctx
+  ``engine=id(batcher)`` (raise here to prove a sick draft source
+  degrades THAT tick to plain decode — counted in
+  ``spec_stats()["draft_faults"]``, streams stay token-exact and are
+  never dropped)
 
 Programmatic use (the fault-injection test suite)::
 

@@ -150,6 +150,46 @@ class Histogram:
         lines.append(f"{family}_count {snap['count']}")
 
 
+def _render_spec_family(lines: list, spec: dict):
+    """Append the adaptive-speculation gauge family from a ``spec_stats()``
+    dict: whether this generator drafts at all, how wide, and whether it
+    pays (accept_rate = accepted / drafted). Never rendered as zeros on a
+    non-speculating host — callers gate on ``spec is not None``."""
+    lines += [
+        "# TYPE mst_spec_enabled gauge",
+        f'mst_spec_enabled{{mode="{spec["mode"]}"}} 1',
+        "# TYPE mst_spec_window gauge",
+        f"mst_spec_window {spec.get('window_max', 0)}",
+        "# TYPE mst_spec_accept_rate gauge",
+        f"mst_spec_accept_rate "
+        f"{spec.get('accept_rate', 0.0):.4f}",
+        "# TYPE mst_spec_draft_tokens_total counter",
+        f"mst_spec_draft_tokens_total "
+        f"{spec.get('draft_tokens', 0)}",
+        "# TYPE mst_spec_accepted_tokens_total counter",
+        f"mst_spec_accepted_tokens_total "
+        f"{spec.get('accepted_tokens', 0)}",
+        "# TYPE mst_spec_rounds_total counter",
+        f"mst_spec_rounds_total {spec.get('rounds', 0)}",
+        "# TYPE mst_spec_fallback_ticks_total counter",
+        f"mst_spec_fallback_ticks_total "
+        f"{spec.get('fallback_ticks', 0)}",
+        "# TYPE mst_spec_draft_faults_total counter",
+        f"mst_spec_draft_faults_total "
+        f"{spec.get('draft_faults', 0)}",
+    ]
+    if "disabled_slots" in spec:
+        # per-slot adaptive control only (tracker-backed)
+        lines += [
+            "# TYPE mst_spec_disabled_slots gauge",
+            f"mst_spec_disabled_slots "
+            f"{spec['disabled_slots']}",
+            "# TYPE mst_spec_shed_events_total counter",
+            f"mst_spec_shed_events_total "
+            f"{spec['shed_events']}",
+        ]
+
+
 @dataclass
 class ServingMetrics:
     # named lock (ordering: ServingMetrics.lock is taken BEFORE any engine
@@ -238,6 +278,7 @@ class ServingMetrics:
             # down, pool closing); drop the whole engine section
             # cleanly rather than 500 or emit a half-rendered family
             mark = len(lines)
+            spec_rendered = False
             try:
                 b = self.batcher_fn() if self.batcher_fn is not None else None
                 if b is not None:
@@ -394,6 +435,10 @@ class ServingMetrics:
                             f'mst_tick_device_blocked_ms{{path="kv_import"}} '
                             f"{tick.get('kv_import_ms_last', 0.0):.3f}",
                         ]
+                    spec = getattr(b, "spec_stats", lambda: None)()
+                    if spec is not None:
+                        _render_spec_family(lines, spec)
+                        spec_rendered = True
                     res = getattr(b, "resilience_stats", lambda: None)()
                     if res is not None:
                         lines += [
@@ -579,8 +624,22 @@ class ServingMetrics:
                         ]
             except Exception:  # noqa: BLE001 — scrapes must never 500
                 del lines[mark:]
-            spec = self.spec_fn() if self.spec_fn is not None else None
-            if spec is not None:
+            spec = (
+                self.spec_fn()
+                if self.spec_fn is not None and not spec_rendered
+                else None
+            )
+            if spec is not None and hasattr(spec, "spec_stats"):
+                # new-protocol generator (n-gram single-stream) hosted
+                # without a batcher: same family, same never-500 contract
+                smark = len(lines)
+                try:
+                    st = spec.spec_stats()
+                    if st is not None:
+                        _render_spec_family(lines, st)
+                except Exception:  # noqa: BLE001 — scrapes must never 500
+                    del lines[smark:]
+            elif spec is not None:
                 # accepted/round ∈ [1, spec_k]: the draft-quality dial the
                 # operator watches to size --spec-k
                 lines += [

@@ -1,0 +1,7 @@
+"""MST114: a draft proposal reading a device value mid-round."""
+
+
+# mst: spec-hot
+def propose_window(tracker_ewma, last_count):
+    accepted = last_count.item()  # drains the dispatch pipe per round
+    return 4 if accepted > 2 else 2

@@ -1,0 +1,548 @@
+"""Adaptive speculation: n-gram drafts, per-slot window control, and the
+async/disagg/fleet composition matrix.
+
+Exactness contract: greedy streams through any speculating path — n-gram
+or draft-engine drafts, sync or async ticks, batched or disagg decode
+pools — are BIT-IDENTICAL to plain decode; adaptivity (window resizes,
+slot disables, brownout shedding, draft faults) may only ever change
+throughput, never content. The AcceptanceTracker's policy is pinned with
+an injected fake clock, so every resize/disable/re-probe step in these
+tests is deterministic.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.disagg import DisaggCoordinator
+from mlx_sharding_tpu.generate import Generator
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.parallel.mesh import pipeline_mesh
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+from mlx_sharding_tpu.replicas import ReplicaSet
+from mlx_sharding_tpu.scheduler import ContinuousBatcher
+from mlx_sharding_tpu.speculative import (
+    AcceptanceTracker,
+    NgramDraftProposer,
+    NgramSpeculativeGenerator,
+    SPEC_WINDOW_LADDER,
+)
+from mlx_sharding_tpu.testing import faults
+from mlx_sharding_tpu.utils.observability import ServingMetrics
+
+TINY = dict(vocab_size=256, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2)
+
+# repetition in the prompt gives the n-gram matcher something to chew on;
+# parity must hold whether or not proposals land
+JOBS = [
+    ([5, 6, 7, 5, 6, 7, 5, 6], dict(max_tokens=12)),
+    ([3, 17, 42], dict(max_tokens=10)),
+    ([9, 1, 9, 1, 9], dict(max_tokens=8, temperature=0.9, top_p=0.85,
+                           seed=321)),
+]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    return model, params
+
+
+def _engine(tiny_model, **kw):
+    model, params = tiny_model
+    kw.setdefault("microbatches", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("prefill_chunk", 8)
+    return PipelineEngine(model, params, pipeline_mesh(1), **kw)
+
+
+def _ref(tiny_model):
+    model, params = tiny_model
+    return Generator(model, params, max_seq=64, cache_dtype=jnp.float32,
+                     prefill_chunk=8)
+
+
+def _run(gen, prompt, **kw):
+    return [t for t, _ in gen.generate_step(prompt, **kw)]
+
+
+def _concurrent(batcher, jobs):
+    results = [None] * len(jobs)
+
+    def worker(i, prompt, kw):
+        results[i] = _run(batcher, prompt, **kw)
+
+    threads = [threading.Thread(target=worker, args=(i, p, kw))
+               for i, (p, kw) in enumerate(jobs)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=300)
+        assert not th.is_alive(), "generation thread hung"
+    return results
+
+
+# ------------------------------------------------------- proposer (host-pure)
+def test_ngram_proposer_continues_most_recent_match():
+    p = NgramDraftProposer(max_ngram=3)
+    # trailing 2-gram (7, 8) occurred earlier, followed by 9, 10
+    drafts, n = p.propose([1, 7, 8, 9, 10, 2, 7, 8], 4)
+    assert n == 4
+    assert drafts.tolist() == [9, 10, 2, 7]
+
+
+def test_ngram_proposer_prefers_longer_context():
+    p = NgramDraftProposer(max_ngram=3)
+    # (5, 6) alone appears twice with different continuations; the 3-gram
+    # (4, 5, 6) disambiguates to the first occurrence's continuation
+    toks = [4, 5, 6, 11, 0, 5, 6, 22, 0, 4, 5, 6]
+    drafts, n = p.propose(toks, 2)
+    assert n == 2
+    assert drafts.tolist() == [11, 0]
+
+
+def test_ngram_proposer_no_match_and_padding():
+    p = NgramDraftProposer()
+    drafts, n = p.propose([1, 2, 3, 4, 5], 4)  # novel text: no repeat
+    assert n == 0
+    assert drafts.tolist() == [0, 0, 0, 0]  # token 0 pad, never -1
+    # partial continuation: match at the very end of the history
+    drafts, n = p.propose([9, 9, 3, 9, 9], 4)
+    assert 0 < n <= 4
+    assert (drafts[n:] == 0).all()
+
+
+def test_ngram_proposer_window_bounds_matching():
+    # min_ngram=2 so the unigram fallback can't rescue the match once the
+    # (7, 7) pair has scrolled out of the 8-token ring
+    p = NgramDraftProposer(window=8, min_ngram=2)
+    toks = [7, 7, 5] + [1, 2, 3, 4] * 3 + [7, 7]
+    drafts, n = p.propose(toks, 2)
+    assert n == 0
+    # same history with an unbounded window finds it
+    assert NgramDraftProposer(min_ngram=2).propose(toks, 2)[1] > 0
+
+
+def test_ngram_proposer_validation():
+    with pytest.raises(ValueError, match="min_ngram"):
+        NgramDraftProposer(max_ngram=2, min_ngram=3)
+    p = NgramDraftProposer()
+    assert p.propose([], 4)[1] == 0
+    assert p.propose([1, 2, 3], 0)[1] == 0
+
+
+# ---------------------------------------- tracker policy under a fake clock
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_tracker_grows_on_saturation_and_shrinks_to_disable():
+    clk = _Clock()
+    tr = AcceptanceTracker(2, w_max=8, clock=clk)
+    assert tr.window(0) == SPEC_WINDOW_LADDER[1] == 2  # bottom rung probe
+    # saturating rounds walk the ladder up: 2 -> 4 -> 8 (the EWMA has to
+    # converge toward the new window before the next grow fires)
+    for _ in range(12):
+        tr.observe(0, tr.window(0), tr.window(0))
+    assert tr.window(0) == 8
+    # a draft that never agrees (count=1) walks back down and disables
+    for _ in range(30):
+        w = tr.window(1)
+        if w == 0:
+            break
+        tr.observe(1, w, 1)
+    assert tr.window(1) == 0
+    assert tr.stats()["disabled_slots"] == 1
+    # slot 0 is untouched by slot 1's collapse
+    assert tr.window(0) == 8
+
+
+def test_tracker_reprobe_after_deadline_is_clock_driven():
+    clk = _Clock()
+    tr = AcceptanceTracker(1, w_max=4, probe_after_s=1.0, clock=clk)
+    while tr.window(0) != 0:
+        tr.observe(0, tr.window(0), 1)
+    clk.now = 0.5
+    assert tr.window(0) == 0  # before the deadline: still disabled
+    clk.now = 1.5
+    assert tr.window(0) == 2  # re-probe at the bottom rung
+    # the probe gets fresh evidence: one good round keeps it alive
+    tr.observe(0, 2, 2)
+    assert tr.window(0) in (2, 4)
+
+
+def test_tracker_reset_clears_history():
+    clk = _Clock()
+    tr = AcceptanceTracker(1, w_max=8, clock=clk)
+    while tr.window(0) != 0:
+        tr.observe(0, tr.window(0), 1)
+    tr.reset(0)
+    assert tr.window(0) == 2
+    assert tr.ewma(0) is None
+
+
+def test_tracker_determinism_same_observations_same_windows():
+    def play():
+        tr = AcceptanceTracker(1, w_max=8, clock=_Clock())
+        seq = []
+        for count in [2, 2, 4, 4, 1, 1, 1, 3, 1, 1, 1, 1]:
+            w = tr.window(0)
+            tr.observe(0, w, min(count, max(w, 1)))
+            seq.append(w)
+        return seq
+
+    assert play() == play()
+
+
+def test_tracker_brownout_sheds_lowest_acceptance_first():
+    clk = _Clock()
+    tr = AcceptanceTracker(4, w_max=4, clock=clk)
+    # slots 1..3 proven with ascending EWMAs (slot 3 the best); slot 0
+    # untouched — no evidence at all, so it sheds before any proven slot
+    for s, count in zip([1, 2, 3], [2, 3, 4]):
+        tr.observe(s, 2, 2)           # bottom-rung probe saturates
+        tr.observe(s, 4, count)       # distinct second-round evidence
+    live = [0, 1, 2, 3]
+    assert all(tr.window(s) > 0 for s in live)
+    wins = tr.effective_windows(live, level=2)
+    shed = {s for s, w in wins.items() if w == 0}
+    assert len(shed) == 2  # half the enabled slots
+    assert 0 in shed  # unproven goes first
+    assert 3 not in shed  # the best acceptance keeps its window
+    assert tr.shed_events == 2
+    # level 3: everything sheds; re-entry is not double counted
+    wins = tr.effective_windows(live, level=3)
+    assert all(w == 0 for w in wins.values())
+    assert tr.shed_events == 4
+    # pressure clears: windows return immediately (shed is not slot state)
+    wins = tr.effective_windows(live, level=0)
+    assert all(w > 0 for w in wins.values())
+    assert tr.shed_events == 4
+
+
+# ------------------------------------------ single-stream ngram generator
+def test_ngram_generator_greedy_token_exact(tiny_model):
+    model, params = tiny_model
+    gen = NgramSpeculativeGenerator(
+        model, params, spec_window_max=8, max_seq=64,
+        cache_dtype=jnp.float32, prefill_chunk=8, clock=lambda: 0.0,
+    )
+    ref = _ref(tiny_model)
+    for prompt, kw in [(JOBS[0][0], dict(max_tokens=12)),
+                       ([3, 17, 42], dict(max_tokens=10))]:
+        assert _run(gen, prompt, **kw) == _run(ref, prompt, **kw)
+    st = gen.spec_stats()
+    assert st["mode"] == "ngram" and st["window_max"] == 8
+    assert st["rounds"] > 0
+    assert 0.0 <= st["accept_rate"] <= 1.0
+
+
+@pytest.mark.slow
+def test_ngram_generator_sampled_deterministic_with_fake_clock(tiny_model):
+    model, params = tiny_model
+
+    def make():
+        return NgramSpeculativeGenerator(
+            model, params, spec_window_max=4, max_seq=64,
+            cache_dtype=jnp.float32, prefill_chunk=8, clock=lambda: 0.0,
+        )
+
+    kw = dict(max_tokens=10, temperature=0.9, top_p=0.85, seed=11)
+    assert _run(make(), [9, 1, 9, 1, 9], **kw) == \
+        _run(make(), [9, 1, 9, 1, 9], **kw)
+
+
+def test_ngram_generator_validation(tiny_model):
+    model, params = tiny_model
+    with pytest.raises(ValueError, match="spec_window_max"):
+        NgramSpeculativeGenerator(model, params, spec_window_max=1)
+
+
+# ------------------------------------------- scheduler: parity matrix
+def _ngram_batcher(tiny_model, async_sched, microbatches=2, **kw):
+    return ContinuousBatcher(
+        _engine(tiny_model, microbatches=microbatches), decode_block=4,
+        draft="ngram", async_sched=async_sched, spec_clock=lambda: 0.0, **kw,
+    )
+
+
+@pytest.mark.parametrize("async_sched", ["off", "auto"])
+def test_scheduler_ngram_greedy_parity(tiny_model, async_sched):
+    """Greedy streams through n-gram speculation — sync and async ticks,
+    interleaved slots — are bit-identical to plain decode, and the rounds
+    actually drafted (this is not vacuous off-path parity)."""
+    batcher = _ngram_batcher(tiny_model, async_sched)
+    try:
+        assert batcher._async == (async_sched == "auto")
+        ref = _ref(tiny_model)
+        greedy = [j for j in JOBS if "temperature" not in j[1]]
+        refs = [_run(ref, p, **kw) for p, kw in greedy]
+        assert _concurrent(batcher, greedy) == refs
+        st = batcher.spec_stats()
+        assert st["mode"] == "ngram"
+        assert st["rounds"] > 0 and st["draft_tokens"] > 0
+        assert st["accepted_tokens"] >= 0
+    finally:
+        batcher.close()
+
+
+@pytest.mark.slow
+def test_scheduler_ngram_sampled_deterministic(tiny_model):
+    """Seeded sampled streams under adaptive n-gram speculation: identical
+    across runs of the same batcher geometry (fake spec clock pins the
+    window schedule, per-slot PRNG chains pin the keys)."""
+    outs = []
+    for _ in range(2):
+        batcher = _ngram_batcher(tiny_model, "off")
+        try:
+            outs.append(_run(batcher, *JOBS[2][:1], **JOBS[2][1]))
+        finally:
+            batcher.close()
+    assert outs[0] == outs[1]
+
+
+def test_scheduler_ngram_spec_draft_fault_degrades_to_plain_decode(tiny_model):
+    """An armed ``spec.draft`` fault: the tick runs plain decode instead,
+    the degradation is counted, and the stream stays token-exact."""
+    batcher = _ngram_batcher(tiny_model, "off")
+    try:
+        ref = _ref(tiny_model)
+        want = _run(ref, JOBS[0][0], **JOBS[0][1])
+        f = faults.arm("spec.draft", exc=RuntimeError, times=3)
+        assert _run(batcher, JOBS[0][0], **JOBS[0][1]) == want
+        assert f.fired == 3
+        assert batcher.spec_stats()["draft_faults"] == 3
+    finally:
+        batcher.close()
+
+
+def test_scheduler_ngram_validation(tiny_model):
+    model, params = tiny_model
+    eng2 = PipelineEngine(model, params, pipeline_mesh(2), microbatches=2,
+                          max_seq=64, cache_dtype=jnp.float32,
+                          prefill_chunk=8)
+    with pytest.raises(ValueError, match="pp=1"):
+        ContinuousBatcher(eng2, draft="ngram")
+    eng = _engine(tiny_model)
+    try:
+        with pytest.raises(ValueError, match="draft"):
+            ContinuousBatcher(eng, draft="lookahead")
+        with pytest.raises(ValueError, match="draft engine"):
+            ContinuousBatcher(eng, draft="engine")  # engine needs a draft
+        with pytest.raises(ValueError, match="spec_window_max"):
+            ContinuousBatcher(eng, draft="ngram", spec_window_max=1)
+        with pytest.raises(ValueError, match="spec_window_max"):
+            ContinuousBatcher(eng, spec_window_max=4)  # no draft mode
+        b = ContinuousBatcher(eng, draft="ngram")
+        try:
+            # ngram always runs the adaptive tracker; engine default stays
+            # legacy fixed-K (pinned by test_scheduler_heavy's perfect-draft
+            # accepts-K case)
+            assert b.spec_tracker is not None
+        finally:
+            b.close()
+    finally:
+        eng.close()
+
+
+def test_async_auto_reason_matrix(tiny_model, monkeypatch):
+    """--async-sched auto must say WHY it resolved: plain decode and ngram
+    lift to async, a draft engine and multi-host force sync."""
+    eng = _engine(tiny_model)
+    cases = [
+        (dict(), True, "plain single-host decode"),
+        (dict(draft="ngram"), True, "n-gram drafts are host-built"),
+    ]
+    for kw, want_async, phrase in cases:
+        b = ContinuousBatcher(eng, **kw)
+        try:
+            assert b._async is want_async, kw
+            assert phrase in b.async_reason
+        finally:
+            b.close()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    b = ContinuousBatcher(eng)
+    try:
+        assert not b._async
+        assert "multi-host" in b.async_reason
+    finally:
+        b.close()
+    # ngram is refused outright in multi-host serving, with the fix named
+    with pytest.raises(ValueError, match="multi-host"):
+        ContinuousBatcher(eng, draft="ngram")
+    monkeypatch.undo()
+    eng.close()
+    # draft engine -> sync, and the reason names the dependency
+    deng = _engine(tiny_model)
+    teng = _engine(tiny_model)
+    b = ContinuousBatcher(teng, draft_engine=deng)
+    try:
+        assert not b._async
+        assert "draft engine" in b.async_reason
+    finally:
+        b.close()
+
+
+# ----------------------------------------------- disagg decode-pool ngram
+def _paged_batcher(tiny_model, **kw):
+    eng = _engine(tiny_model, pool_pages=10, page_size=8)
+    return ContinuousBatcher(eng, decode_block=3, **kw)
+
+
+def test_disagg_decode_pool_speculates_prefill_never(tiny_model):
+    """The placement rule end to end: a prefill pool that would speculate
+    is refused at construction; an ngram decode pool resumes handed-off
+    streams bit-exactly (prompt-lookup drafts need no draft KV, so the
+    block import composes) and its rounds actually draft."""
+    with pytest.raises(ValueError, match="prefill-pool replicas"):
+        co = DisaggCoordinator(
+            ReplicaSet([_paged_batcher(
+                tiny_model, draft="ngram", spec_clock=lambda: 0.0,
+            )], role="prefill"),
+            ReplicaSet([_paged_batcher(tiny_model)], role="decode"),
+        )
+        co.close()
+    co = DisaggCoordinator(
+        ReplicaSet([_paged_batcher(tiny_model)], role="prefill"),
+        ReplicaSet([_paged_batcher(
+            tiny_model, draft="ngram", spec_clock=lambda: 0.0,
+        )], role="decode"),
+    )
+    try:
+        ref = _ref(tiny_model)
+        greedy = [j for j in JOBS if "temperature" not in j[1]]
+        for p, kw in greedy:
+            assert _run(co, p, **kw) == _run(ref, p, **kw)
+        assert co.handoff_stats()["handoffs"] >= 2
+        st = co.spec_stats()
+        assert st is not None and st["mode"] == "ngram"
+        assert st["rounds"] > 0  # resumed streams really speculated
+    finally:
+        co.close()
+
+
+def test_replica_set_aggregates_spec_stats(tiny_model):
+    rs = ReplicaSet([
+        _ngram_batcher(tiny_model, "off"),
+        _ngram_batcher(tiny_model, "off"),
+    ])
+    try:
+        _run(rs, JOBS[0][0], **JOBS[0][1])
+        st = rs.spec_stats()
+        assert st["mode"] == "ngram"
+        assert st["rounds"] > 0
+        assert st["accept_rate"] == pytest.approx(
+            st["accepted_tokens"] / max(1, st["draft_tokens"])
+        )
+    finally:
+        rs.close()
+    plain = ReplicaSet([ContinuousBatcher(_engine(tiny_model))])
+    try:
+        assert plain.spec_stats() is None  # non-speculating fleet: absent
+    finally:
+        plain.close()
+
+
+# --------------------------------------------------------------- /metrics
+def test_metrics_expose_spec_gauges():
+    class _B:
+        def stats(self):
+            return (2, 1, 0)
+
+        def spec_stats(self):
+            return {"mode": "ngram", "window_max": 8, "rounds": 12,
+                    "draft_tokens": 40, "accepted_tokens": 25,
+                    "accept_rate": 0.625, "fallback_ticks": 1,
+                    "replayed_tokens": 0, "draft_faults": 2,
+                    "windows": [4, 0], "disabled_slots": 1,
+                    "shed_events": 3, "ewma_mean": 2.5}
+
+    text = ServingMetrics(batcher_fn=lambda: _B()).render()
+    assert 'mst_spec_enabled{mode="ngram"} 1' in text
+    assert "mst_spec_window 8" in text
+    assert "mst_spec_accept_rate 0.6250" in text
+    assert "mst_spec_draft_tokens_total 40" in text
+    assert "mst_spec_accepted_tokens_total 25" in text
+    assert "mst_spec_rounds_total 12" in text
+    assert "mst_spec_draft_faults_total 2" in text
+    assert "mst_spec_disabled_slots 1" in text
+    assert "mst_spec_shed_events_total 3" in text
+
+
+def test_metrics_spec_gauges_absent_when_not_speculating():
+    class _Plain:
+        def stats(self):
+            return (2, 0, 0)
+
+        def spec_stats(self):
+            return None  # draft='off'
+
+    assert "mst_spec_" not in ServingMetrics(
+        batcher_fn=lambda: _Plain()
+    ).render()
+    assert "mst_spec_" not in ServingMetrics().render()
+
+    class _Legacy:  # pre-speculation batcher: no spec_stats at all
+        def stats(self):
+            return (2, 0, 0)
+
+    assert "mst_spec_" not in ServingMetrics(
+        batcher_fn=lambda: _Legacy()
+    ).render()
+
+
+def test_metrics_spec_gauges_never_500():
+    class _Boom:
+        def stats(self):
+            return (2, 1, 0)
+
+        def spec_stats(self):
+            raise RuntimeError("sick batcher")
+
+    # a sick accessor drops the engine section, never 500s the scrape
+    text = ServingMetrics(batcher_fn=lambda: _Boom()).render()
+    assert "mst_requests_total 0" in text
+    assert "mst_spec_" not in text
+
+
+# ------------------------------------------------ brownout shed (full sweep)
+@pytest.mark.slow
+def test_brownout_shed_keeps_streams_exact_and_counts_sheds(tiny_model):
+    """Pressure level 2 mid-generation: speculation sheds per slot (lowest
+    acceptance first), streams stay token-exact, and the shed is visible in
+    spec_stats; clearing pressure lets windows return."""
+    batcher = _ngram_batcher(tiny_model, "off", microbatches=3)
+    try:
+        ref = _ref(tiny_model)
+        greedy = [(p, dict(kw)) for p, kw in JOBS if "temperature" not in kw]
+        refs = [_run(ref, p, **kw) for p, kw in greedy]
+        batcher.set_pressure(2)
+        assert _concurrent(batcher, greedy) == refs
+        shed_under_pressure = batcher.spec_stats()["shed_events"]
+        assert shed_under_pressure > 0
+        batcher.set_pressure(0)
+        assert _concurrent(batcher, greedy) == refs
+        st = batcher.spec_stats()
+        assert st["rounds"] > 0  # speculation resumed once pressure cleared
+    finally:
+        batcher.close()

@@ -164,7 +164,8 @@ def test_brownout_escalates_immediately_steps_down_one_rung_per_dwell():
     assert b.observe(2.5) == 3  # straight to the top rung
     assert b.state() == {
         "level": 3, "max_tokens_cap": 96,
-        "speculation_disabled": True, "admission_tightened": True,
+        "speculation_disabled": True, "speculation_shed": "all",
+        "admission_tightened": True,
     }
     # pressure collapses — but de-escalation needs the dwell, one rung each
     assert b.observe(0.1) == 3

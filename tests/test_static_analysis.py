@@ -53,6 +53,7 @@ EXPECTED = {
     "mst304/scheduler.py": ("MST304", 1, 0),
     "mst112_trace_hot_path.py": ("MST112", 11, 4),
     "mst113_control_plane_in_tick.py": ("MST113", 10, 21),
+    "mst114_spec_policy_sync.py": ("MST114", 6, 15),
     "mst002_dead_suppression.py": ("MST002", 5, 0),
     "mst401_exception_leak.py": ("MST401", 6, 0),
     "mst402_double_release.py": ("MST402", 8, 4),
