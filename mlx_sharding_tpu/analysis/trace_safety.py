@@ -136,6 +136,12 @@
   breaker half-open ETA, autoscaler cooldown, lease expiry — must be a
   ``time.monotonic()`` difference. Timestamps for humans (log lines, the
   OpenAI ``created`` field) are fine: they carry no deadline identifiers.
+  The rule also covers the reverse drift: inside a class that carries an
+  INJECTABLE clock (``self.clock`` / ``self._clock``, see
+  ``utils/clock.py``), a raw ``time.monotonic()`` in deadline arithmetic
+  is flagged too — it silently bypasses the injected time source, so
+  virtual-clock tests and the fleet simulator pass against one clock while
+  the shipped binary runs on another.
 """
 
 from __future__ import annotations
@@ -975,10 +981,34 @@ def _check_hot_trace_overhead(mod: ModuleInfo) -> list[Finding]:
 # MST107: the wall clock spellings that must never feed a deadline, and the
 # identifier fragments that mark an expression as deadline/timeout math
 WALL_CLOCK_CALLS = {"time.time", "_time.time"}
+# the monotonic spellings that bypass an INJECTED clock: only flagged
+# inside classes that carry one (see _clocked_class_ranges) — a raw
+# monotonic read there makes virtual-time tests pass while the shipped
+# binary runs on a different clock
+MONOTONIC_CALLS = {"time.monotonic", "_time.monotonic"}
 DEADLINE_HINTS = (
     "deadline", "timeout", "expires", "expiry", "expire", "until",
     "budget", "retry_after", "ttft", "lease",
 )
+
+
+def _clocked_class_ranges(tree: ast.AST) -> list[tuple[int, int]]:
+    """Line ranges of ClassDefs that reference an injectable clock
+    attribute (``self.clock`` / ``self._clock``): inside these, deadline
+    arithmetic must read the injected source, never ``time.monotonic()``
+    directly."""
+    ranges = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for n in ast.walk(node):
+            if (isinstance(n, ast.Attribute)
+                    and n.attr in ("clock", "_clock")
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"):
+                ranges.append((node.lineno, node.end_lineno or node.lineno))
+                break
+    return ranges
 
 
 def _check_wall_clock_deadlines(mod: ModuleInfo) -> list[Finding]:
@@ -992,13 +1022,24 @@ def _check_wall_clock_deadlines(mod: ModuleInfo) -> list[Finding]:
             contexts.append(node)
         elif isinstance(node, (ast.While, ast.If)):
             contexts.append(node.test)
+    clocked = _clocked_class_ranges(mod.tree)
+
+    def in_clocked_class(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in clocked)
+
     findings = []
     seen: set[tuple[int, int]] = set()
     for ctx in contexts:
-        calls = [n for n in ast.walk(ctx)
-                 if isinstance(n, ast.Call)
-                 and dotted_name(n.func) in WALL_CLOCK_CALLS]
-        if not calls:
+        wall_calls, mono_calls = [], []
+        for n in ast.walk(ctx):
+            if not isinstance(n, ast.Call):
+                continue
+            name = dotted_name(n.func)
+            if name in WALL_CLOCK_CALLS:
+                wall_calls.append(n)
+            elif name in MONOTONIC_CALLS and in_clocked_class(n.lineno):
+                mono_calls.append(n)
+        if not wall_calls and not mono_calls:
             continue
         idents: set[str] = set()
         for n in ast.walk(ctx):
@@ -1009,17 +1050,25 @@ def _check_wall_clock_deadlines(mod: ModuleInfo) -> list[Finding]:
         idents -= {"time", "_time"}  # the call itself is not evidence
         if not any(h in ident for ident in idents for h in DEADLINE_HINTS):
             continue
-        for call in calls:
+        for call, msg in (
+            [(c, "time.time() feeding deadline/timeout arithmetic — the "
+                 "wall clock steps/slews under NTP, so the deadline can "
+                 "fire early or never; use time.monotonic()")
+             for c in wall_calls]
+            + [(c, "raw time.monotonic() feeding deadline arithmetic in a "
+                   "class that carries an injectable clock — it bypasses "
+                   "the injected time source, so virtual-clock tests and "
+                   "the fleet simulator diverge from the shipped binary; "
+                   "read self.clock()/self._clock() instead")
+               for c in mono_calls]
+        ):
             key = (call.lineno, call.col_offset)
             if key in seen:
                 continue
             seen.add(key)
             findings.append(Finding(
                 "MST107", mod.display_path, call.lineno, call.col_offset,
-                "time.time() feeding deadline/timeout arithmetic — the "
-                "wall clock steps/slews under NTP, so the deadline can "
-                "fire early or never; use time.monotonic()",
-                context=qualname_for_line(mod.tree, call.lineno)))
+                msg, context=qualname_for_line(mod.tree, call.lineno)))
     return findings
 
 

@@ -77,6 +77,7 @@ from mlx_sharding_tpu.resilience import (
     ResumeState,
 )
 from mlx_sharding_tpu.testing.faults import inject
+from mlx_sharding_tpu.utils.clock import MONOTONIC, Clock
 from mlx_sharding_tpu.utils.observability import HANDOFF_BUCKETS_MS, Histogram
 
 
@@ -103,7 +104,8 @@ class DisaggCoordinator:
     supports_sessions = True  # stickiness applies to the prefill leg
 
     def __init__(self, prefill_pool, decode_pool, *,
-                 handoff_window: int = 512, prefix_store=None):
+                 handoff_window: int = 512, prefix_store=None,
+                 clock: Clock = MONOTONIC):
         for rep in getattr(prefill_pool, "replicas", [prefill_pool]):
             if not getattr(rep, "supports_prefill_only", False):
                 raise ValueError(
@@ -128,6 +130,7 @@ class DisaggCoordinator:
                 )
         self.prefill = prefill_pool
         self.decode = decode_pool
+        self.clock = clock
         # pod-scale cross-host handoff (pod.PodHandoff), attached by the
         # pod fleet after construction: when set, phase 2 may ship the
         # block to a less-loaded REMOTE decode host instead of the local
@@ -276,7 +279,7 @@ class DisaggCoordinator:
         if state is not None:
             target = self.decode
             tr = kw.get("_trace")
-            t0 = time.monotonic()
+            t0 = self.clock()
             tp0 = time.perf_counter()
             with tracing.bind(tr):
                 try:
@@ -298,7 +301,7 @@ class DisaggCoordinator:
                         self._count("block_dropped")
             if target is self.decode:
                 nbytes = getattr(state.block, "nbytes", 0) or 0
-                ms = (time.monotonic() - t0) * 1000.0
+                ms = (self.clock() - t0) * 1000.0
                 with self._lock:
                     self.handoffs += 1
                     self.handoff_bytes += int(nbytes)

@@ -53,11 +53,11 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Callable, Optional
 
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.testing.faults import inject
+from mlx_sharding_tpu.utils.clock import MONOTONIC, Clock
 
 logger = logging.getLogger(__name__)
 
@@ -105,7 +105,7 @@ class BrownoutController:
 
     def __init__(self, *, enter=(0.85, 1.25, 2.0), exit=(0.5, 0.9, 1.5),
                  caps=(512, 256, 96), dwell_s: float = 5.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Clock = MONOTONIC):
         if len(enter) != self.LEVELS or len(exit) != self.LEVELS:
             raise ValueError(f"enter/exit need {self.LEVELS} thresholds")
         if len(caps) != self.LEVELS:
@@ -196,7 +196,7 @@ class FleetAutoscaler:
                  brownout: Optional[BrownoutController] = None,
                  enable_brownout: bool = True,
                  role: Optional[str] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Clock = MONOTONIC):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         if max_replicas is not None and max_replicas < min_replicas:
@@ -341,9 +341,9 @@ class FleetAutoscaler:
     def _spawn(self, now: float) -> str:
         try:
             inject("replica.spawn")
-            t0 = time.monotonic()
+            t0 = self.clock()
             rep = self.factory()
-            spawn_s = time.monotonic() - t0
+            spawn_s = self.clock() - t0
             if rep is None:
                 raise RuntimeError("replica factory returned None")
         except Exception:  # noqa: BLE001 — degrade to the static fleet

@@ -29,7 +29,6 @@ serde code, no message framing.
 from __future__ import annotations
 
 import os
-import time
 from typing import Optional
 
 import jax
@@ -41,6 +40,7 @@ from mlx_sharding_tpu.sample import (
     make_sampler_params,
 )
 from mlx_sharding_tpu.testing.faults import inject
+from mlx_sharding_tpu.utils.clock import MONOTONIC, Clock
 
 
 class WorkerTimeoutError(RuntimeError):
@@ -128,8 +128,10 @@ class ControlPlane:
 
     header_size = 8
 
-    def __init__(self, max_prompt: int, timeout_s: Optional[float] = None):
+    def __init__(self, max_prompt: int, timeout_s: Optional[float] = None,
+                 clock: Clock = MONOTONIC):
         self.max_prompt = max_prompt
+        self.clock = clock  # liveness stamps read the injectable source
         if timeout_s is None:
             try:
                 timeout_s = float(os.environ.get("MST_MULTIHOST_TIMEOUT_S", "600"))
@@ -253,7 +255,7 @@ class ControlPlane:
                         "deployment)"
                     ) from val
                 out = val
-        self.last_ok = time.monotonic()
+        self.last_ok = self.clock()
         return {k: np.asarray(v) for k, v in out.items()}
 
 
@@ -745,7 +747,8 @@ class PodControlPlane:
     (pod.CollectiveTransport), keeping this class a pure collective."""
 
     def __init__(self, blob_bytes: Optional[int] = None,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 clock: Clock = MONOTONIC):
         if blob_bytes is None:
             try:
                 blob_bytes = int(
@@ -754,6 +757,7 @@ class PodControlPlane:
             except ValueError:
                 blob_bytes = 256 << 10
         self.blob_bytes = max(4096, int(blob_bytes))
+        self.clock = clock
         if timeout_s is None:
             try:
                 timeout_s = float(os.environ.get("MST_POD_TIMEOUT_S", "60"))
@@ -853,5 +857,5 @@ class PodControlPlane:
                         "reported a dead or unreachable peer host"
                     ) from val
                 out = val
-        self.last_ok = time.monotonic()
+        self.last_ok = self.clock()
         return np.asarray(out["header"]), np.asarray(out["blob"])

@@ -59,6 +59,7 @@ from mlx_sharding_tpu.fleet import aggregate_pressure
 from mlx_sharding_tpu.kv_transfer import BlockIntegrityError, KVPageBlock
 from mlx_sharding_tpu.resilience import ResumeState
 from mlx_sharding_tpu.testing.faults import inject
+from mlx_sharding_tpu.utils.clock import MONOTONIC, Clock
 from mlx_sharding_tpu.weights import weight_store
 
 logger = logging.getLogger(__name__)
@@ -108,7 +109,7 @@ class LoopbackHub:
     every message to or from it raises, so peers discover the death the
     same way they would for real — a stale heartbeat."""
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, clock: Clock = MONOTONIC):
         self.clock = clock
         self._lock = make_lock("LoopbackHub._lock")
         self._info: dict = {}      # host -> (info dict, published stamp)
@@ -201,7 +202,7 @@ class CollectiveTransport:
     _HDR = 12
 
     def __init__(self, *, interval_s: float = 0.05, plane=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Clock = MONOTONIC):
         import jax
 
         from mlx_sharding_tpu.parallel.multihost import PodControlPlane
@@ -508,7 +509,7 @@ class PodHandoff:
                  local_pressure: Optional[Callable[[], float]] = None,
                  heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
                  relay_timeout_s: float = RELAY_TIMEOUT_S,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Clock = MONOTONIC):
         self.host_id = host_id
         self.transport = transport
         self.local_pressure = local_pressure
@@ -762,7 +763,7 @@ class PodAutoscaler:
                  scale_down_pressure: float = 0.25,
                  heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
                  on_host_death: Optional[Callable[[int], None]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Clock = MONOTONIC):
         self.host_id = host_id
         self.transport = transport
         self.controllers = list(controllers)
@@ -927,7 +928,7 @@ class PodFleet:
                  heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
                  relay_timeout_s: float = RELAY_TIMEOUT_S,
                  interval_s: float = 0.5,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Clock = MONOTONIC):
         self.host_id = host_id
         self.transport = transport
         self.local = local

@@ -54,12 +54,12 @@ set keeps serving and ``health()`` reports degraded, not dead.
 from __future__ import annotations
 
 import logging
-import time
 from collections import OrderedDict
 from typing import Optional
 
 from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock, note_acquire, note_release
+from mlx_sharding_tpu.utils.clock import MONOTONIC, WALL_SLEEP, Clock, SleepFn
 from mlx_sharding_tpu.utils.digests import chunk_digests
 from mlx_sharding_tpu.utils.observability import Histogram
 from mlx_sharding_tpu.resilience import (
@@ -97,9 +97,15 @@ class ReplicaSet:
                  probe_interval: float = 5.0, resume_streams: bool = True,
                  route_imbalance: int = 4, affinity_page: int = 128,
                  tight_ttft_s: float = 10.0, role: Optional[str] = None,
-                 prefix_store=None):
+                 prefix_store=None, clock: Clock = MONOTONIC,
+                 sleep: SleepFn = WALL_SLEEP):
         if not replicas:
             raise ValueError("ReplicaSet needs at least one replica")
+        # injectable time source + wait primitive: breaker open/half-open
+        # stamps and the drain unwind loop run on these, so the fleet
+        # simulator can drive the whole dispatcher in virtual time
+        self._clock = clock
+        self._sleep = sleep
         # disaggregated serving: pools are role-tagged ("prefill"/"decode")
         # so fleet gauges, health blocks and autoscale events say which
         # pool they describe; None keeps the monolithic (unlabeled) forms
@@ -304,7 +310,7 @@ class ReplicaSet:
                 hint = None
         depths = self._queue_depths()
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             closed, half_open = [], []
             retry_eta = None  # earliest half-open retry among open breakers
             for j in range(len(self.replicas)):
@@ -364,7 +370,7 @@ class ReplicaSet:
             self.failures[i] += 1
             self._fails_consec[i] += 1
             self._probing[i] = False
-            now = time.monotonic()
+            now = self._clock()
             if self._open_until[i] > 0:
                 # failed half-open probe: straight back to open
                 self._open_until[i] = now + self.probe_interval
@@ -602,12 +608,12 @@ class ReplicaSet:
             with self._lock:
                 self._drain_active[i] = False
             raise
-        deadline_at = time.monotonic() + deadline
-        while time.monotonic() < deadline_at:
+        deadline_at = self._clock() + deadline
+        while self._clock() < deadline_at:
             with self._lock:
                 if self._inflight[i] == 0:
                     break
-            time.sleep(0.01)
+            self._sleep(0.01)
         with self._lock:
             leaked = self._inflight[i]
         closed = False
@@ -727,7 +733,7 @@ class ReplicaSet:
         2 open), drain lifecycle. Queue depths come from each replica's own
         stats() OUTSIDE our lock (see _queue_depths)."""
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             reps = list(self.replicas)
             snap = []
             for j in range(len(reps)):
@@ -900,7 +906,7 @@ class ReplicaSet:
         lives, dead only when none do. Retired replicas left the fleet on
         purpose — they don't count against ``ok``."""
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             reps = list(self.replicas)
             states = [
                 self._breaker_state(j, now) for j in range(len(reps))

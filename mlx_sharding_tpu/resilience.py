@@ -45,7 +45,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 
 class RequestTimeoutError(RuntimeError):
@@ -174,6 +174,7 @@ class Deadlines:
         ttft_timeout: Optional[float] = None,
         request_timeout: Optional[float] = None,
         stall_timeout: Optional[float] = None,
+        clock: Optional[Callable[[], float]] = None,
     ) -> "Deadlines":
         for name, v in (
             ("ttft_timeout", ttft_timeout),
@@ -186,7 +187,10 @@ class Deadlines:
                 or v <= 0
             ):
                 raise ValueError(f"{name} must be a positive number of seconds")
-        now = time.monotonic()
+        # the absolute stamps must come from the SAME clock the consumer
+        # loop compares them against (scheduler._consume's injected one) —
+        # pass that clock here when it isn't the process monotonic source
+        now = time.monotonic() if clock is None else clock()
         if stall_timeout is None:
             stall_timeout = ttft_timeout  # see module docstring
         return cls(

@@ -86,6 +86,7 @@ from mlx_sharding_tpu.resilience import (
     ResumeState,
 )
 from mlx_sharding_tpu.testing.faults import inject
+from mlx_sharding_tpu.utils.clock import MONOTONIC, WALL_SLEEP, Clock, SleepFn
 from mlx_sharding_tpu.utils.observability import (
     Histogram,
     ITL_BUCKETS_S,
@@ -288,12 +289,13 @@ class ContinuousBatcher:
                  policy: str = "fifo", prefix_cache: bool = False,
                  overcommit: bool = False, draft_engine=None, spec_k: int = 4,
                  draft: str = "auto", spec_window_max: Optional[int] = None,
-                 spec_clock=time.monotonic,
+                 spec_clock=None,
                  max_queue: Optional[int] = None, async_sched: str = "auto",
                  spill_bytes: Optional[int] = None,
                  spill_cold_after: Optional[int] = None,
                  kv_prefetch: str = "auto",
-                 prefix_store=None):
+                 prefix_store=None, clock: Clock = MONOTONIC,
+                 sleep: SleepFn = WALL_SLEEP):
         if engine.batch != 1:
             raise ValueError("continuous batching expects engine batch=1")
         if max_queue is not None and (not isinstance(max_queue, int) or max_queue < 1):
@@ -500,6 +502,16 @@ class ContinuousBatcher:
         self.engine = engine
         self.M = engine.microbatches
         self.W = repetition_window
+        # injectable time source + wait primitive (utils/clock.py): every
+        # deadline/TTFT/retry-after computation below reads this clock, so
+        # tests and the fleet simulator can drive admission, timeout expiry
+        # and migrate_out unwinding in virtual time. spec_clock defaults to
+        # the same source (it predates the general slot; kept for callers
+        # that pin the speculative controller to its own clock).
+        self._clock = clock
+        self._sleep = sleep
+        if spec_clock is None:
+            spec_clock = clock
         # Admission: "fifo" is strict arrival order (a request that doesn't
         # fit blocks everything behind it — predictable, starvation-free);
         # "first_fit" lets later requests that DO fit (free slot + enough
@@ -1029,7 +1041,7 @@ class ContinuousBatcher:
                         depth, bound,
                         retry_after_s=estimate_retry_after(
                             max(1, depth - bound + 1),
-                            self._finish_times, time.monotonic(),
+                            self._finish_times, self._clock(),
                         ),
                     )
                 self._submit.put(req)
@@ -1052,7 +1064,7 @@ class ContinuousBatcher:
             while True:
                 kind, timeout = None, None
                 if dl is not None:
-                    now = time.monotonic()
+                    now = self._clock()
                     cands = []
                     if first and dl.ttft_deadline is not None:
                         cands.append(("ttft", dl.ttft_deadline - now))
@@ -1079,7 +1091,7 @@ class ContinuousBatcher:
                     req.cancelled = True
                     with self._admission_lock:  # exact under concurrency
                         self.timeouts += 1
-                    now = time.monotonic()
+                    now = self._clock()
                     budget = (
                         dl.stall_timeout if kind == "stall"
                         else (dl.ttft_deadline if kind == "ttft"
@@ -2244,7 +2256,7 @@ class ContinuousBatcher:
         # completion stamp for the drain-rate Retry-After estimate; cancelled
         # reaps count too — they free queue capacity all the same
         with self._admission_lock:
-            self._finish_times.append(time.monotonic())
+            self._finish_times.append(self._clock())
         tr = req._trace
         if tr is not None:
             tr.point("finish", produced=req.produced)
@@ -2577,15 +2589,15 @@ class ContinuousBatcher:
             return 0
         # mst: allow(MST201): wake sentinel; Queue locks internally
         self._submit.put(None)  # wake the idle wait
-        t0 = time.monotonic()
-        while time.monotonic() - t0 < deadline:
+        t0 = self._clock()
+        while self._clock() - t0 < deadline:
             if not t.is_alive():
                 break
             with self._admission_lock:
                 queued = self._submit.qsize() + len(self._waiting)
             if queued == 0 and not any(r is not None for r in self._slots):
                 break
-            time.sleep(0.01)
+            self._sleep(0.01)
         with self._admission_lock:
             return self.migrations_out - base
 
@@ -2769,7 +2781,7 @@ class ContinuousBatcher:
             req.out.put(HandoffReadyError(state))
             with self._admission_lock:
                 self.handoffs_out += 1
-                self._finish_times.append(time.monotonic())
+                self._finish_times.append(self._clock())
 
     def _grow_for_decode(self):
         """Over-commit page growth: before a decode block runs, every
@@ -3242,7 +3254,7 @@ class ContinuousBatcher:
         # to). Host-local decision — nothing was broadcast for an unassigned
         # request, so worker mirrors never knew it existed.
         if self._waiting:
-            now = time.monotonic()
+            now = self._clock()
             # produced == 0 guard: a woken cold-spilled request is back on
             # the line long after its first token was delivered — its TTFT
             # budget is history, not a shed signal; dropping it here would

@@ -1,0 +1,20 @@
+"""Deterministic discrete-event fleet simulator + chaos campaigns.
+
+``simkit``   — the harness: :class:`~mlx_sharding_tpu.sim.simkit.Simulation`
+               (event queue over a shared ``VirtualClock``, deterministic
+               thread-step scheduler, seeded ``SimRng``, event-log digest).
+``fleetsim`` — real control-plane objects (``ReplicaSet`` /
+               ``FleetAutoscaler`` / ``BrownoutController`` / ``PodFleet``
+               over a ``LoopbackHub``) composed around stub ``SimReplica``
+               engines, plus the synthetic arrival processes.
+``chaos``    — seeded fault campaigns over the ``testing.faults`` site
+               registry, the invariant-checker library, and the
+               delta-debugging shrinker that reduces a failing campaign to
+               a minimal replayable repro file.
+
+Everything here runs with zero hardware and zero wall-clock sleeps: the
+same seed always produces the same event log (bit-identical digests), so
+any failure a campaign finds is a repro, not an anecdote.
+"""
+
+from mlx_sharding_tpu.sim.simkit import SimRng, Simulation  # noqa: F401

@@ -1,0 +1,567 @@
+"""Seeded chaos campaigns, invariant checking, fault-schedule shrinking.
+
+A :class:`Campaign` is a pure value: seed, fleet shape, arrival process,
+and a schedule of :class:`FaultEvent`\\ s pinned to virtual timestamps.
+:func:`run_campaign` builds a fresh simulated fleet (``fleetsim``), arms
+each event through the production ``testing.faults`` API at its timestamp,
+drives arrivals plus a post-storm settle trickle (probe traffic is what
+closes breakers), drains the simulation to quiescence, and evaluates the
+invariant library. Because the whole run is a pure function of the
+campaign value, a failure IS its repro: re-running the same campaign
+reproduces the same event log bit-for-bit (equal digests).
+
+On failure, :func:`shrink` delta-debugs the fault schedule — re-running
+fresh simulations on candidate subsets — down to a minimal schedule that
+still violates the same invariant, and :func:`write_repro` /
+:func:`load_repro` round-trip the result as a JSON repro file
+(``python -m mlx_sharding_tpu.sim.chaos --replay <file>`` replays it).
+
+Invariants (each returns a list of violation strings):
+
+``no_dropped_streams``  every request ends completed / shed / client-
+                        aborted — never dropped mid-stream.
+``token_exact``         every delivered stream is a prefix of the
+                        deterministic expected stream (resume/migration/
+                        handoff never duplicated or corrupted a token).
+``ledger_clean``        the runtime resource ledger balances at teardown
+                        (no leaked slots, probe tickets, arms, binds).
+``convergence``         after the storm: no live replica's breaker stuck
+                        open, every brownout ladder back at level 0.
+``queued_sane``         the aggregate queued gauge never went negative
+                        and is zero at quiescence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import dataclass, field, asdict
+from typing import Optional
+
+from mlx_sharding_tpu import tracing
+from mlx_sharding_tpu.analysis import runtime as mst_runtime
+from mlx_sharding_tpu.sim.fleetsim import (
+    FleetSim,
+    build_fleet,
+    drive_arrivals,
+    token_at,
+)
+from mlx_sharding_tpu.sim.simkit import Simulation
+from mlx_sharding_tpu.testing import faults
+
+# exception name -> class, reusing the MST_FAULTS vocabulary so a repro
+# file reads the same as a fault spec
+_EXC = dict(faults._EXC_NAMES)
+
+TERMINAL_OUTCOMES = ("completed", "shed", "client_aborted")
+
+
+@dataclass
+class FaultEvent:
+    """One scheduled chaos action at virtual time ``t``.
+
+    kinds: ``site`` (arm a fault site), ``host_kill`` (SIGKILL a host:
+    fabric + engines), ``transport_kill`` (partition: fabric only),
+    ``heartbeat_loss`` (drop N of one host's gossip publishes),
+    ``breaker_trip`` (fail one replica's dispatches until its breaker
+    opens), ``relay_crash`` (crash a host's engines mid-stream, healing
+    after ``heal_after`` virtual seconds — the transient-death shape that
+    exercises crash-resume AND breaker re-close)."""
+
+    t: float
+    kind: str
+    site: Optional[str] = None
+    host: Optional[int] = None
+    exc: str = "fault"
+    times: Optional[int] = 1
+    after: int = 0
+    match: Optional[dict] = None
+    heal_after: float = 2.0
+
+    def sites(self) -> tuple:
+        if self.kind == "site":
+            return (self.site,) if self.site else ()
+        if self.kind == "heartbeat_loss":
+            return ("multihost.exchange",)
+        if self.kind == "breaker_trip":
+            return ("replica.dispatch",)
+        return ()
+
+
+@dataclass
+class Campaign:
+    name: str
+    seed: int = 0
+    n_hosts: int = 4
+    replicas_per_host: int = 2
+    duration_s: float = 20.0
+    settle_s: float = 15.0
+    arrival: str = "surge"
+    base_rate: float = 2.0
+    max_tokens: int = 10
+    surge_factor: float = 10.0
+    schedule: list = field(default_factory=list)
+    # the deliberately-broken knob: disables BOTH resume layers (the
+    # dispatcher's crash-resume and the driver's cross-host failover), so
+    # a mid-stream crash becomes a dropped stream the invariants catch
+    resume_streams: bool = True
+    invariants: tuple = ("no_dropped_streams", "token_exact",
+                         "ledger_clean", "convergence", "queued_sane")
+
+    def sites(self) -> frozenset:
+        return frozenset(s for ev in self.schedule for s in ev.sites())
+
+
+@dataclass
+class CampaignResult:
+    campaign: Campaign
+    digest: str
+    violations: list
+    outcomes: dict       # outcome -> count
+    n_requests: int
+    n_events: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def _apply_event(fs: FleetSim, ev: FaultEvent):
+    sim = fs.sim
+    if ev.kind == "site":
+        sim.record("chaos_arm", site=ev.site)
+        faults.arm(ev.site, exc=_EXC[ev.exc], times=ev.times,
+                   after=ev.after, match=ev.match)
+    elif ev.kind == "host_kill":
+        fs.kill_host(ev.host % len(fs.hosts))
+    elif ev.kind == "transport_kill":
+        fs.kill_transport(ev.host % len(fs.hosts))
+    elif ev.kind == "heartbeat_loss":
+        sim.record("chaos_heartbeat_loss", host=ev.host)
+        faults.arm("multihost.exchange", exc=_EXC[ev.exc],
+                   times=ev.times or 3, match={"host": ev.host})
+    elif ev.kind == "breaker_trip":
+        host = fs.hosts[(ev.host or 0) % len(fs.hosts)]
+        sim.record("chaos_breaker_trip", host=host.host_id)
+        # fail enough consecutive dispatches on replica 0 to open its
+        # breaker; the settle trickle's probe then has to close it again
+        faults.arm("replica.dispatch", exc=_EXC[ev.exc],
+                   times=ev.times or host.rs.breaker_threshold,
+                   match={"replica": 0})
+    elif ev.kind == "relay_crash":
+        host = fs.hosts[(ev.host or 0) % len(fs.hosts)]
+        sim.record("chaos_relay_crash", host=host.host_id)
+        for rep in host.replicas:
+            rep.crash()
+        heal = max(0.1, ev.heal_after)
+
+        def _heal(host=host):
+            sim.record("chaos_heal", host=host.host_id)
+            for rep in host.replicas:
+                rep.heal()
+
+        sim.schedule(heal, _heal)
+    else:
+        raise ValueError(f"unknown chaos event kind {ev.kind!r}")
+
+
+# ------------------------------------------------------------- invariants
+def _inv_no_dropped_streams(fs: FleetSim) -> list:
+    out = []
+    for rid, rec in fs.requests.items():
+        if rec["outcome"] not in TERMINAL_OUTCOMES:
+            out.append(
+                f"stream {rid} ended {rec['outcome']!r} after "
+                f"{len(rec['tokens'])} tokens (hops={rec['hops']})"
+            )
+    return out
+
+
+def _inv_token_exact(fs: FleetSim) -> list:
+    out = []
+    for rid, rec in fs.requests.items():
+        toks = rec["tokens"]
+        want = [token_at(rec["prompt"], i) for i in range(len(toks))]
+        if toks != want:
+            i = next(
+                (j for j, (a, b) in enumerate(zip(toks, want)) if a != b),
+                min(len(toks), len(want)),
+            )
+            out.append(
+                f"stream {rid} diverged at token {i}: got {toks[i:i + 3]} "
+                f"want {want[i:i + 3]} (degradations={rec['degradations']})"
+            )
+    return out
+
+
+def _inv_ledger_clean(fs: FleetSim, ledger) -> list:
+    if ledger is None:
+        return []
+    try:
+        ledger.assert_clean()
+    except AssertionError as e:
+        return [str(e)]
+    return []
+
+
+def _inv_convergence(fs: FleetSim) -> list:
+    out = []
+    for host in fs.live_hosts():
+        for st in host.rs.replica_stats():
+            if st["retired"] or st["draining"]:
+                continue
+            if st["breaker"] == "open":
+                out.append(
+                    f"host {host.host_id} replica {st['replica']} breaker "
+                    "still open after settle"
+                )
+        bo = host.ctrl.brownout
+        if bo is not None and bo.level() != 0:
+            out.append(
+                f"host {host.host_id} brownout stuck at level {bo.level()}"
+            )
+    return out
+
+
+def _inv_queued_sane(fs: FleetSim) -> list:
+    out = []
+    if fs.queued_negative:
+        out.append(
+            f"queued gauge went negative {fs.queued_negative} time(s)"
+        )
+    q = fs.total_queued()
+    if q != 0:
+        out.append(f"aggregate queued gauge is {q} at quiescence, want 0")
+    return out
+
+
+INVARIANTS = {
+    "no_dropped_streams": _inv_no_dropped_streams,
+    "token_exact": _inv_token_exact,
+    "convergence": _inv_convergence,
+    "queued_sane": _inv_queued_sane,
+}
+
+
+# ---------------------------------------------------------------- running
+def run_campaign(camp: Campaign) -> CampaignResult:
+    """Execute one campaign in a fresh simulation and judge it. Always
+    tears down (disarm + abort actors + close fleets) before returning, so
+    campaigns can run back-to-back in one process."""
+    sim = Simulation(seed=camp.seed)
+    prev_ledger = mst_runtime._RESOURCES
+    ledger = mst_runtime.instrument_resources()
+    tracing.set_campaign(camp.name, seed=camp.seed, clock=sim.clock)
+    horizon = camp.duration_s + camp.settle_s
+    fs = build_fleet(
+        sim, n_hosts=camp.n_hosts,
+        replicas_per_host=camp.replicas_per_host,
+        horizon_s=horizon, resume_streams=camp.resume_streams,
+    )
+    if not camp.resume_streams:
+        fs.max_hops = 1  # the driver's failover is a resume layer too
+    try:
+        drive_arrivals(
+            fs, kind=camp.arrival, duration_s=camp.duration_s,
+            base_rate=camp.base_rate, max_tokens=camp.max_tokens,
+            surge_factor=camp.surge_factor,
+        )
+        # settle trickle: light traffic after the storm window — breaker
+        # probes need live requests to close, brownout needs calm load to
+        # step its ladder back down
+        trickle = sim.rng.stream("settle")
+        n_settle = max(3, int(camp.settle_s * 0.5))
+        for i in range(n_settle):
+            delay = camp.duration_s + (i + 1) * (
+                camp.settle_s * 0.6 / n_settle
+            )
+            prompt = [trickle.randrange(997) for _ in range(4)]
+            host = trickle.randrange(camp.n_hosts)
+
+            def _go(i=i, prompt=prompt, host=host):
+                fs.submit(f"settle-{i}", prompt, 4, host=host)
+
+            sim.schedule(delay, _go)
+        for ev in sorted(camp.schedule, key=lambda e: (e.t,)):
+            if ev.t > horizon:
+                raise ValueError(
+                    f"fault event at t={ev.t} beyond horizon {horizon}"
+                )
+            sim.schedule(ev.t, lambda ev=ev: _apply_event(fs, ev))
+        sim.run()  # drain to quiescence: zero wall-clock sleeps throughout
+        sim.record("quiesce", requests=len(fs.requests))
+        violations = []
+        for name in camp.invariants:
+            if name == "ledger_clean":
+                continue  # judged after teardown below
+            for v in INVARIANTS[name](fs):
+                violations.append(f"{name}: {v}")
+        digest = sim.digest()
+    finally:
+        faults.disarm()
+        tracing.set_campaign(None)
+        sim.close()  # unwind parked actors -> their finally blocks release
+        for host in fs.hosts:
+            try:
+                host.rs.close()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        mst_runtime._RESOURCES = prev_ledger
+    if "ledger_clean" in camp.invariants:
+        violations += [
+            f"ledger_clean: {v}" for v in _inv_ledger_clean(fs, ledger)
+        ]
+    outcomes: dict = {}
+    for rec in fs.requests.values():
+        key = rec["outcome"] or "unfinished"
+        outcomes[key] = outcomes.get(key, 0) + 1
+    return CampaignResult(
+        campaign=camp, digest=digest, violations=violations,
+        outcomes=outcomes, n_requests=len(fs.requests),
+        n_events=len(camp.schedule),
+    )
+
+
+# --------------------------------------------------------------- shrinking
+def _violated_names(result: CampaignResult) -> frozenset:
+    return frozenset(v.split(":", 1)[0] for v in result.violations)
+
+
+def shrink(camp: Campaign, *, max_runs: int = 200) -> CampaignResult:
+    """Delta-debug ``camp.schedule`` to a 1-minimal failing subset.
+
+    Classic ddmin over the fault-event list: the predicate is "re-running
+    a fresh simulation with this subset still violates at least one of the
+    invariants the full campaign violated". Every probe is a full fresh
+    run (determinism makes that sound); ``max_runs`` bounds the spend.
+    Returns the result of the minimal campaign (its ``.campaign`` holds
+    the shrunk schedule)."""
+    base = run_campaign(camp)
+    if base.ok:
+        return base
+    target = _violated_names(base)
+    runs = [0]
+
+    def fails(schedule: list) -> Optional[CampaignResult]:
+        if runs[0] >= max_runs:
+            return None
+        runs[0] += 1
+        cand = Campaign(**{**asdict(camp), "schedule": []})
+        cand.schedule = list(schedule)  # keep FaultEvent objects intact
+        res = run_campaign(cand)
+        return res if (_violated_names(res) & target) else None
+
+    events = list(camp.schedule)
+    best = base
+    n = 2
+    while len(events) >= 2:
+        chunk = max(1, len(events) // n)
+        reduced = None
+        # try each complement (drop one chunk at a time)
+        for i in range(0, len(events), chunk):
+            cand = events[:i] + events[i + chunk:]
+            res = fails(cand)
+            if res is not None:
+                reduced, best = cand, res
+                break
+        if reduced is not None:
+            events = reduced
+            n = max(2, n - 1)
+        elif n >= len(events):
+            break
+        else:
+            n = min(len(events), n * 2)
+    # an empty schedule can also fail (a broken knob, not a broken storm)
+    if events:
+        res = fails([])
+        if res is not None:
+            events, best = [], res
+    minimal = Campaign(**{**asdict(camp), "schedule": []})
+    minimal.schedule = events
+    if best is base and events != list(camp.schedule):
+        best = run_campaign(minimal)
+    best.campaign.schedule = events
+    return best
+
+
+# -------------------------------------------------------------- repro files
+def write_repro(path: str, result: CampaignResult) -> None:
+    camp = result.campaign
+    doc = {
+        "format": "mst-chaos-repro-v1",
+        "campaign": {
+            **{k: v for k, v in asdict(camp).items() if k != "schedule"},
+            "invariants": list(camp.invariants),
+            "schedule": [asdict(ev) for ev in camp.schedule],
+        },
+        "digest": result.digest,
+        "violations": result.violations,
+        "outcomes": result.outcomes,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_repro(path: str) -> Campaign:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("format") != "mst-chaos-repro-v1":
+        raise ValueError(f"{path}: not a chaos repro file")
+    spec = dict(doc["campaign"])
+    schedule = [FaultEvent(**ev) for ev in spec.pop("schedule")]
+    spec["invariants"] = tuple(spec["invariants"])
+    camp = Campaign(**spec)
+    camp.schedule = schedule
+    return camp
+
+
+# -------------------------------------------------------- scenario library
+def _storm_schedule(t0: float, sites, *, times: int = 2,
+                    spacing: float = 0.7) -> list:
+    excs = {
+        "server.sse_write": "broken_pipe",
+        "multihost.exchange": "drop",
+    }
+    return [
+        FaultEvent(t=t0 + i * spacing, kind="site", site=s,
+                   exc=excs.get(s, "fault"), times=times)
+        for i, s in enumerate(sites)
+    ]
+
+
+def _required_sites() -> list:
+    from mlx_sharding_tpu.analysis.lifecycle import REQUIRED_FAULT_SITES
+    seen, out = set(), []
+    for sites in REQUIRED_FAULT_SITES.values():
+        for s in sites:
+            if s not in seen:
+                seen.add(s)
+                out.append(s)
+    return sorted(out)
+
+
+def scenario_site_storm(seed: int = 7) -> Campaign:
+    """Every REQUIRED fault site armed mid-surge (the coverage-gate
+    scenario: a newly required site lands here automatically)."""
+    return Campaign(
+        name="site_storm", seed=seed, n_hosts=4, duration_s=18.0,
+        arrival="surge", base_rate=2.5,
+        schedule=_storm_schedule(5.0, _required_sites()),
+    )
+
+
+def scenario_host_death(seed: int = 11) -> Campaign:
+    """A host dies mid-surge, another loses its transport, heartbeats
+    drop: peers must detect staleness while every started stream fails
+    over token-exactly."""
+    return Campaign(
+        name="host_death", seed=seed, n_hosts=5, duration_s=18.0,
+        arrival="surge", base_rate=2.5,
+        schedule=[
+            FaultEvent(t=7.0, kind="host_kill", host=1),
+            FaultEvent(t=9.0, kind="transport_kill", host=2),
+            FaultEvent(t=6.0, kind="heartbeat_loss", host=3, exc="drop",
+                       times=3),
+        ],
+    )
+
+
+def scenario_breaker_storm(seed: int = 13) -> Campaign:
+    """Breaker trips plus a transient relay crash: opens must re-close
+    during settle (the convergence invariant's reason to exist)."""
+    return Campaign(
+        name="breaker_storm", seed=seed, n_hosts=3, duration_s=16.0,
+        arrival="herd", base_rate=3.0,
+        schedule=[
+            FaultEvent(t=2.0, kind="breaker_trip", host=0, exc="runtime",
+                       times=3),
+            FaultEvent(t=4.0, kind="relay_crash", host=1, heal_after=2.0),
+        ],
+    )
+
+
+def scenario_surge_100(seed: int = 17, *, n_hosts: int = 100) -> Campaign:
+    """The acceptance campaign: 100 hosts, 10x surge, host deaths +
+    transport kills + a required-site fault storm, all in one seeded run."""
+    schedule = [
+        FaultEvent(t=8.0, kind="host_kill", host=17),
+        FaultEvent(t=9.5, kind="host_kill", host=61),
+        FaultEvent(t=11.0, kind="transport_kill", host=33),
+        FaultEvent(t=12.5, kind="heartbeat_loss", host=5, exc="drop",
+                   times=3),
+    ] + _storm_schedule(8.0, _required_sites(), times=3, spacing=0.5)
+    return Campaign(
+        name="surge_100", seed=seed, n_hosts=n_hosts, duration_s=24.0,
+        settle_s=18.0, arrival="surge", base_rate=8.0, surge_factor=10.0,
+        schedule=schedule,
+    )
+
+
+SCENARIOS = {
+    "site_storm": scenario_site_storm,
+    "host_death": scenario_host_death,
+    "breaker_storm": scenario_breaker_storm,
+    "surge_100": scenario_surge_100,
+}
+
+
+def scenario_sites(name: str) -> frozenset:
+    """Fault sites a scenario arms (the coverage gate cross-checks the
+    union of these against ``lifecycle.REQUIRED_FAULT_SITES``)."""
+    return SCENARIOS[name]().sites()
+
+
+# --------------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mlx_sharding_tpu.sim.chaos",
+        description="Run seeded chaos campaigns against the simulated fleet",
+    )
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS),
+                    default="site_storm")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny seeded campaign, every invariant judged "
+                         "(the scripts/check.sh gate)")
+    ap.add_argument("--replay", metavar="REPRO",
+                    help="replay a repro file and re-judge its invariants")
+    ap.add_argument("--repro-out", metavar="PATH",
+                    help="on failure, shrink and write the repro here")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        camp = load_repro(args.replay)
+    elif args.smoke:
+        camp = scenario_site_storm(seed=args.seed or 7)
+        camp = Campaign(**{**asdict(camp), "schedule": []})
+        camp.n_hosts, camp.duration_s, camp.settle_s = 3, 10.0, 8.0
+        camp.base_rate = 2.0
+        camp.schedule = _storm_schedule(3.0, _required_sites(),
+                                        spacing=0.4)
+        camp.schedule.append(FaultEvent(t=5.0, kind="host_kill", host=2))
+    else:
+        factory = SCENARIOS[args.scenario]
+        camp = factory(args.seed) if args.seed is not None else factory()
+
+    res = run_campaign(camp)
+    print(f"campaign {camp.name} seed={camp.seed} hosts={camp.n_hosts} "
+          f"events={res.n_events}")
+    print(f"  requests={res.n_requests} outcomes={res.outcomes}")
+    print(f"  digest={res.digest}")
+    if res.ok:
+        print("  invariants: all green")
+        return 0
+    print(f"  VIOLATIONS ({len(res.violations)}):")
+    for v in res.violations:
+        print(f"    {v}")
+    if args.repro_out:
+        shrunk = shrink(camp)
+        write_repro(args.repro_out, shrunk)
+        print(f"  shrunk to {len(shrunk.campaign.schedule)} event(s); "
+              f"repro written to {args.repro_out}")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,511 @@
+"""Real control plane, stub data plane: the simulated fleet.
+
+A :class:`SimHost` is the production composition with the engines swapped
+out: a real :class:`~mlx_sharding_tpu.replicas.ReplicaSet` (breakers,
+routing, drain/resume) over :class:`SimReplica` stubs, a real
+:class:`~mlx_sharding_tpu.fleet.FleetAutoscaler` +
+:class:`~mlx_sharding_tpu.fleet.BrownoutController`, all inside a real
+:class:`~mlx_sharding_tpu.pod.PodFleet` on a shared
+:class:`~mlx_sharding_tpu.pod.LoopbackHub` fabric — every component
+handed the simulation's one ``VirtualClock``. Nothing here re-implements
+policy; the point is that chaos campaigns exercise the SAME routing,
+breaker, drain, brownout and pod-gossip code that serves production
+traffic, at 100s-of-hosts scale.
+
+:class:`SimReplica` is the batcher-shaped stub engine: a deterministic
+token function (so token-exactness is checkable to the bit), virtual
+per-token latency that stretches under load (so pressure/brownout/
+autoscaler dynamics are real), the ``_resume`` protocol for token-exact
+continuation, ``migrate_out`` for drains, and crash/heal hooks for the
+chaos engine. It carries the engine-side fault sites (``scheduler.tick``,
+``spec.draft``, ``cache.export``, ``cache.import``) through the same
+``testing.faults.inject`` calls the real scheduler does.
+
+The request driver runs each stream as a simulation actor through the
+production dispatch path, carrying the remaining control-point sites
+(``server.sse_write`` per delivered chunk, ``cache.prefix_lookup`` at
+admission, ``disagg.handoff`` / ``pod.handoff`` at the two-phase and
+cross-host control points) and modeling the pod story end to end: a host
+death mid-stream re-places the stream on a survivor with a caller-seeded
+``ResumeState`` — token-exact, never dropped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from mlx_sharding_tpu.fleet import BrownoutController, FleetAutoscaler
+from mlx_sharding_tpu.pod import LoopbackHub, PodFleet
+from mlx_sharding_tpu.replicas import ReplicaSet
+from mlx_sharding_tpu.resilience import (
+    QueueFullError,
+    ReplicasUnavailableError,
+    ResumeState,
+)
+from mlx_sharding_tpu.sim.simkit import Simulation
+from mlx_sharding_tpu.testing.faults import inject
+
+VOCAB = 50021  # prime, so token_at mixes well
+
+
+def token_at(prompt, i: int) -> int:
+    """The deterministic token function: what token ``i`` of ``prompt``'s
+    stream MUST be, wherever and however many times it is (re)computed.
+    Token-exactness across crash-resume, drains and cross-host handoffs
+    reduces to comparing against this."""
+    key = ",".join(str(int(t)) for t in prompt) + f"|{i}"
+    h = hashlib.blake2b(key.encode(), digest_size=4).digest()
+    return int.from_bytes(h, "big") % VOCAB
+
+
+class SimReplica:
+    """Batcher-shaped stub engine (see module docstring)."""
+
+    concurrent = True
+    supports_resume = True
+
+    def __init__(self, sim: Simulation, name: str, *, slots: int = 4,
+                 queue_cap: int = 16, tick_s: float = 0.05,
+                 draft: bool = True):
+        self.sim = sim
+        self.name = name
+        self.slots = int(slots)
+        self.queue_cap = int(queue_cap)
+        self.tick_s = float(tick_s)
+        self.draft = draft
+        self._n = 0            # admitted streams (active + queued model)
+        self._crashed = False
+        self._migrate = False
+        self.closed = False
+        self.pressure_level = 0
+        self.shed_queue_full = 0
+        self.draft_faults = 0
+        self.export_faults = 0
+        self.import_faults = 0
+
+    # ------------------------------------------------------------- surfaces
+    def stats(self):
+        return (self.slots, min(self._n, self.slots),
+                max(0, self._n - self.slots))
+
+    def resilience_stats(self):
+        return {"timeouts": 0, "shed_queue_full": self.shed_queue_full,
+                "shed_deadline": 0, "max_queue": self.queue_cap,
+                "scheduler_thread_live": not self._crashed}
+
+    def set_pressure(self, level: int):
+        self.pressure_level = int(level)
+
+    def close(self):
+        self.closed = True
+
+    # ---------------------------------------------------------- chaos hooks
+    def crash(self):
+        """Engine death: new dispatches and in-flight streams raise at
+        their next step — the ReplicaSet's crash-resume path takes over."""
+        self._crashed = True
+
+    def heal(self):
+        self._crashed = False
+
+    # --------------------------------------------------------------- drain
+    def migrate_out(self, deadline: Optional[float] = None) -> int:
+        try:
+            inject("cache.export", replica=self.name)
+        except Exception:  # noqa: BLE001 — export fault degrades blockless,
+            self.export_faults += 1  # the resume stays token-exact
+        self._migrate = True
+        return self._n
+
+    # -------------------------------------------------------------- serving
+    def generate_step(self, prompt_tokens, **kw):
+        if self.closed or self._crashed:
+            raise RuntimeError(f"sim replica {self.name} is down")
+        resume: Optional[ResumeState] = kw.pop("_resume", None)
+        hist: list = []
+        if resume is not None:
+            hist = [int(t) for t in (resume.history or [])]
+            if resume.block is not None:
+                try:
+                    inject("cache.import", replica=self.name)
+                except Exception:  # noqa: BLE001 — demand re-prefill path:
+                    self.import_faults += 1  # same tokens, more virtual work
+                    self.sim.sleep(self.tick_s * 2)
+        if self._n >= self.slots + self.queue_cap:
+            self.shed_queue_full += 1
+            raise QueueFullError(self._n - self.slots, self.queue_cap)
+        max_tokens = int(kw.get("max_tokens", 16))
+        if self.pressure_level >= 1:
+            # the brownout ladder's level-1 contract: cap generation length
+            max_tokens = min(max_tokens, 8)
+        self._n += 1
+        try:
+            for i in range(len(hist), max_tokens):
+                # per-token latency stretches with oversubscription, so a
+                # surge genuinely raises pressure instead of just fanning out
+                load = max(1.0, self._n / max(1, self.slots))
+                self.sim.sleep(self.tick_s * load)
+                inject("scheduler.tick", engine=id(self), replica=self.name)
+                if self.draft and self.pressure_level < 2:
+                    try:
+                        inject("spec.draft", engine=id(self))
+                    except Exception:  # noqa: BLE001 — a sick draft source
+                        self.draft_faults += 1  # degrades THIS tick to plain
+                if self.closed or self._crashed:
+                    raise RuntimeError(
+                        f"sim replica {self.name} died mid-stream"
+                    )
+                if self._migrate:
+                    from mlx_sharding_tpu.resilience import (
+                        RequestMigratedError,
+                    )
+                    raise RequestMigratedError(ResumeState(
+                        prompt=prompt_tokens, history=list(hist),
+                        produced=len(hist),
+                        block=("simblock", len(hist)),
+                    ))
+                tok = token_at(prompt_tokens, i)
+                hist.append(tok)
+                yield (tok, None)
+        finally:
+            self._n -= 1
+
+
+@dataclass
+class SimHost:
+    host_id: int
+    rs: ReplicaSet
+    ctrl: FleetAutoscaler
+    fleet: PodFleet
+    transport: object
+    replicas: list
+    alive: bool = True
+    heartbeat_misses: int = 0
+
+
+@dataclass
+class FleetSim:
+    """The whole simulated deployment plus the request ledger the
+    invariant checkers read."""
+
+    sim: Simulation
+    hub: LoopbackHub
+    hosts: list = field(default_factory=list)
+    # request ledger: rid -> record dict (outcome, delivered tokens, hops)
+    requests: dict = field(default_factory=dict)
+    queued_negative: int = 0
+    max_hops: int = 4
+
+    # ------------------------------------------------------------- topology
+    def live_hosts(self) -> list:
+        return [h for h in self.hosts if h.alive]
+
+    def kill_host(self, host_id: int):
+        """SIGKILL one host: the fabric bounces its messages, heartbeats
+        freeze (peers declare it dead by staleness), its engines crash so
+        in-flight streams fail over, and its periodic ticks stop."""
+        host = self.hosts[host_id]
+        if not host.alive:
+            return
+        host.alive = False
+        self.hub.kill(host_id)
+        for rep in host.replicas:
+            rep.crash()
+        self.sim.record("host_kill", host=host_id)
+
+    def kill_transport(self, host_id: int):
+        """Partition one host off the fabric without killing its engines:
+        peers see a stale heartbeat (death detection fires) while the host
+        keeps serving the streams it already owns."""
+        host = self.hosts[host_id]
+        self.hub.kill(host_id)
+        self.sim.record("transport_kill", host=host_id)
+
+    def sample_queued(self):
+        """The queued-gauge sanity probe, sampled on every pod tick: the
+        aggregate must never go negative (the wake-sentinel-leak bug
+        class) — and must be zero once the fleet quiesces."""
+        for host in self.live_hosts():
+            _, _, queued = host.rs.stats()
+            if queued < 0:
+                self.queued_negative += 1
+
+    def total_queued(self) -> int:
+        return sum(h.rs.stats()[2] for h in self.live_hosts())
+
+    # ------------------------------------------------------------- requests
+    def submit(self, rid: str, prompt: list, max_tokens: int, *,
+               host: int, cross_host: bool = False, two_phase: bool = False,
+               shared_prefix: bool = False):
+        rec = {
+            "rid": rid, "prompt": prompt, "max_tokens": max_tokens,
+            "host": host, "outcome": None, "tokens": [], "hops": 0,
+            "degradations": [],
+        }
+        self.requests[rid] = rec
+        self.sim.record("arrive", rid=rid, host=host)
+        self.sim.spawn(
+            lambda: self._serve(rec, cross_host=cross_host,
+                                two_phase=two_phase,
+                                shared_prefix=shared_prefix),
+            name=f"req-{rid}",
+        )
+
+    def _route_host(self, preferred: int) -> Optional[SimHost]:
+        if self.hosts[preferred].alive:
+            return self.hosts[preferred]
+        for host in self.hosts:  # the load balancer skips dead backends
+            if host.alive:
+                return host
+        return None
+
+    def _serve(self, rec: dict, *, cross_host: bool, two_phase: bool,
+               shared_prefix: bool):
+        rid = rec["rid"]
+        host = self._route_host(rec["host"])
+        if host is None:
+            rec["outcome"] = "shed"
+            self.sim.record("shed", reason="no_live_host", rid=rid)
+            return
+        if shared_prefix:
+            try:
+                inject("cache.prefix_lookup", probe="sim")
+            except Exception:  # noqa: BLE001 — degrade to plain prefill
+                rec["degradations"].append("prefix_lookup_fault")
+        if two_phase:
+            try:
+                inject("disagg.handoff", n_bytes=0)
+            except Exception:  # noqa: BLE001 — serve-in-place
+                rec["degradations"].append("handoff_fault")
+        if cross_host:
+            # the pod handoff control point: on success, decode lands on the
+            # least-pressured live peer (the REAL pick_remote over the
+            # gossip view); any fault degrades to the origin's local plan
+            try:
+                inject("pod.handoff", n_bytes=0)
+                dest = host.fleet.handoff.pick_remote()
+                if dest is not None and self.hosts[dest].alive:
+                    host = self.hosts[dest]
+                    rec["degradations"].append(f"pod_handoff:{dest}")
+            except Exception:  # noqa: BLE001 — origin serves in place
+                rec["degradations"].append("pod_handoff_fault")
+        resume: Optional[ResumeState] = None
+        while True:
+            rec["hops"] += 1
+            try:
+                kw = {"max_tokens": rec["max_tokens"]}
+                if resume is not None:
+                    kw["_resume"] = resume
+                for item in host.rs.generate_step(rec["prompt"], **kw):
+                    tok = item[0] if isinstance(item, tuple) else item
+                    try:
+                        inject("server.sse_write")
+                    except Exception:  # noqa: BLE001 — the CLIENT vanished;
+                        # closing the stream is their doing, not a drop
+                        rec["outcome"] = "client_aborted"
+                        self.sim.record("client_abort", rid=rid)
+                        return
+                    rec["tokens"].append(int(tok))
+                rec["outcome"] = "completed"
+                self.sim.record("done", n=len(rec["tokens"]), rid=rid)
+                return
+            except QueueFullError:
+                if rec["tokens"]:
+                    # a mid-stream migration target may be full; that sheds
+                    # NEW work, never a started stream — move it elsewhere
+                    host = self._failover(rec, host)
+                    if host is None:
+                        self.sim.record("drop", kind="QueueFullError",
+                                        rid=rid)
+                        return
+                    resume = ResumeState(
+                        prompt=rec["prompt"], history=list(rec["tokens"]),
+                        produced=len(rec["tokens"]),
+                    )
+                    continue
+                rec["outcome"] = "shed"
+                self.sim.record("shed", reason="queue_full", rid=rid)
+                return
+            except ReplicasUnavailableError:
+                if not rec["tokens"]:
+                    rec["outcome"] = "shed"
+                    self.sim.record("shed", reason="unavailable", rid=rid)
+                    return
+                host = self._failover(rec, host)
+                if host is None:
+                    return
+                resume = ResumeState(
+                    prompt=rec["prompt"], history=list(rec["tokens"]),
+                    produced=len(rec["tokens"]),
+                )
+            except Exception as exc:  # noqa: BLE001 — a host died under the
+                # stream: the pod contract is a token-exact drain onto a
+                # survivor, driven here by the origin's request owner
+                host = self._failover(rec, host)
+                if host is None:
+                    self.sim.record(
+                        "drop", kind=type(exc).__name__, rid=rid
+                    )
+                    return
+                resume = ResumeState(
+                    prompt=rec["prompt"], history=list(rec["tokens"]),
+                    produced=len(rec["tokens"]),
+                )
+
+    def _failover(self, rec: dict, current: SimHost) -> Optional[SimHost]:
+        if len(rec["tokens"]) > rec.get("_last_fail_len", -1):
+            # progress since the last failure: fresh failover budget — the
+            # bound exists to stop zero-progress ping-pong, not to cap how
+            # many distinct storms one long stream may live through
+            rec["hops"] = 1
+        rec["_last_fail_len"] = len(rec["tokens"])
+        if rec["hops"] >= self.max_hops:
+            rec["outcome"] = "dropped"
+            return None
+        # seeded spread, not first-live: two storm-hit hosts must not
+        # ping-pong a stream between each other while the rest of the
+        # fleet sits healthy
+        alive = [h for h in self.hosts if h.alive and h is not current]
+        if alive:
+            host = alive[self.sim.rng.stream("failover").randrange(
+                len(alive))]
+            rec["degradations"].append(f"failover:{host.host_id}")
+            return host
+        if current.alive:
+            return current  # single-host fleet: retry in place
+        rec["outcome"] = "dropped"
+        return None
+
+
+# ---------------------------------------------------------------- builders
+def build_fleet(sim: Simulation, *, n_hosts: int, replicas_per_host: int = 2,
+                slots: int = 4, tick_s: float = 0.05,
+                max_replicas: int = 4, heartbeat_timeout_s: float = 5.0,
+                ctrl_interval_s: float = 2.0, pod_interval_s: float = 1.0,
+                horizon_s: float = 60.0,
+                resume_streams: bool = True) -> FleetSim:
+    """Compose ``n_hosts`` production control planes over one hub and
+    schedule their periodic ticks (deterministically staggered). The
+    ``resume_streams=False`` knob exists for deliberately-broken campaigns:
+    it disables the dispatcher's crash-resume, so a mid-stream crash drops
+    the stream — the violation the shrinker demo minimizes."""
+    hub = LoopbackHub(clock=sim.clock)
+    fs = FleetSim(sim=sim, hub=hub)
+    for h in range(n_hosts):
+        reps = [
+            SimReplica(sim, f"h{h}r{j}", slots=slots, tick_s=tick_s)
+            for j in range(replicas_per_host)
+        ]
+        rs = ReplicaSet(
+            list(reps), probe_interval=2.0, resume_streams=resume_streams,
+            clock=sim.clock, sleep=sim.virtual_sleep,
+        )
+        spawned = [replicas_per_host]
+
+        def factory(sim=sim, h=h, spawned=spawned):
+            spawned[0] += 1
+            return SimReplica(sim, f"h{h}r{spawned[0] - 1}",
+                              slots=slots, tick_s=tick_s)
+
+        ctrl = FleetAutoscaler(
+            rs, factory, clock=sim.clock, interval_s=ctrl_interval_s,
+            max_replicas=max_replicas, scale_up_sustain_s=2.0,
+            scale_down_sustain_s=8.0, cooldown_s=4.0, drain_deadline_s=5.0,
+            brownout=BrownoutController(clock=sim.clock, dwell_s=2.0),
+        )
+        transport = hub.register(h)
+        fleet = PodFleet(
+            h, transport, rs, controllers=[ctrl], clock=sim.clock,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+        )
+        host = SimHost(host_id=h, rs=rs, ctrl=ctrl, fleet=fleet,
+                       transport=transport, replicas=reps)
+        fs.hosts.append(host)
+
+        def pod_tick(host=host):
+            if not host.alive:
+                return
+            try:
+                # the gossip heartbeat IS the pod collective: a faulted
+                # exchange means this host misses one publish round — the
+                # heartbeat-loss chaos kind, detected by peers as staleness
+                inject("multihost.exchange", host=host.host_id)
+                host.fleet.tick()
+            except Exception:  # noqa: BLE001 — one lost round, not a death
+                host.heartbeat_misses += 1
+            fs.sample_queued()
+
+        def ctrl_tick(host=host):
+            if not host.alive:
+                return
+            out = host.ctrl.tick()
+            action = out.get("action")
+            if action:
+                sim.record("autoscale", action=action, host=host.host_id)
+
+        # deterministic stagger so 100 hosts don't tick at one timestamp
+        sim.every(pod_interval_s, pod_tick, until=horizon_s,
+                  phase=(h % 10) * pod_interval_s / 10.0)
+        sim.every(ctrl_interval_s, ctrl_tick, until=horizon_s,
+                  phase=0.1 + (h % 10) * ctrl_interval_s / 10.0)
+    return fs
+
+
+# --------------------------------------------------------- arrival processes
+def _mk_prompt(rng, prompt_len: int = 6) -> list:
+    return [rng.randrange(VOCAB) for _ in range(prompt_len)]
+
+
+def drive_arrivals(fs: FleetSim, *, kind: str, duration_s: float,
+                   base_rate: float, max_tokens: int = 12,
+                   surge_factor: float = 10.0,
+                   tenant_hot_share: float = 0.8):
+    """Schedule a synthetic arrival process onto the fleet.
+
+    ``diurnal``   — a sinusoid-shaped wave over ``duration_s`` (one "day").
+    ``herd``      — a thundering herd: the whole load lands in the first
+                    10% of the window, then silence.
+    ``tenant_skew`` — one hot tenant (shared prefix, sticky to one host
+                    cohort) takes ``tenant_hot_share`` of traffic.
+    ``surge``     — steady base load with a ``surge_factor``× step through
+                    the middle third (the 10×-surge replay).
+    """
+    sim = fs.sim
+    rng = sim.rng.stream(f"arrivals:{kind}")
+    place = sim.rng.stream("placement")
+    n_hosts = len(fs.hosts)
+    hot_prompt = _mk_prompt(rng)
+    t, i = 0.0, 0
+    while t < duration_s:
+        rate = base_rate
+        if kind == "diurnal":
+            frac = t / duration_s
+            rate = base_rate * (0.25 + 0.75 * (1 - abs(2 * frac - 1)))
+        elif kind == "herd":
+            rate = base_rate * 10.0 if t < duration_s * 0.1 else 0.0
+        elif kind == "surge":
+            in_surge = duration_s / 3 <= t < 2 * duration_s / 3
+            rate = base_rate * (surge_factor if in_surge else 1.0)
+        if rate <= 0:
+            t += duration_s * 0.05
+            continue
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        rid = f"{kind}-{i}"
+        i += 1
+        hot = kind == "tenant_skew" and rng.random() < tenant_hot_share
+        prompt = list(hot_prompt) if hot else _mk_prompt(rng)
+        host = (place.randrange(max(1, n_hosts // 4)) if hot
+                else place.randrange(n_hosts))
+        delay, shared = t, hot
+
+        def _go(rid=rid, prompt=prompt, host=host, shared=shared,
+                cross=place.random() < 0.2, two=place.random() < 0.2):
+            fs.submit(rid, prompt, max_tokens, host=host, cross_host=cross,
+                      two_phase=two, shared_prefix=shared)
+
+        sim.schedule(delay, _go)
+    return i

@@ -1,0 +1,258 @@
+"""Discrete-event simulation kernel: virtual time, deterministic actors.
+
+The harness has three parts:
+
+- an **event queue** ordered by ``(virtual time, sequence number)`` over a
+  shared :class:`~mlx_sharding_tpu.utils.clock.VirtualClock` — the same
+  clock object is injected into every real control-plane component the
+  fleet simulator instantiates, so breaker probe intervals, brownout
+  dwell, autoscaler hysteresis and heartbeat staleness all advance in
+  lockstep with the simulation;
+- a **deterministic thread-step scheduler**: request streams run real
+  blocking generator code (``ReplicaSet.generate_step`` unmodified) on
+  ordinary Python threads, but only ONE thread ever runs at a time — an
+  actor blocks in :meth:`Simulation.sleep` (virtual seconds, zero wall
+  clock) and hands control back to the event loop via an Event handshake.
+  With a single runnable thread and a totally-ordered event queue, the
+  interleaving is a pure function of the seed;
+- a seeded :class:`SimRng` whose named substreams keep arrival processes,
+  placement choices and chaos schedules independent of each other — adding
+  a draw to one stream never perturbs the others.
+
+Every interesting occurrence is appended to an **event log**;
+:meth:`Simulation.digest` hashes it, and two runs of the same seed must
+produce equal digests (the determinism acceptance gate and the contract
+that makes a chaos repro file trustworthy).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import random
+import threading
+from typing import Callable, Optional
+
+from mlx_sharding_tpu.utils.clock import VirtualClock
+
+
+class SimAborted(BaseException):
+    """Raised inside a parked actor when the simulation is torn down, so
+    mid-stream generators unwind their ``finally`` blocks (slot releases,
+    probe tickets) instead of leaking them into the runtime ledger.
+    BaseException on purpose: serving code that swallows ``Exception``
+    must not be able to swallow the teardown."""
+
+
+class SimRng:
+    """Seeded RNG with named substreams.
+
+    ``stream("arrivals")`` always yields the same :class:`random.Random`
+    for the same (seed, name) pair, derived through blake2b so streams are
+    statistically independent and — the property the shrinker leans on —
+    draws on one stream never shift another stream's sequence."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._streams: dict = {}
+
+    def stream(self, name: str) -> random.Random:
+        r = self._streams.get(name)
+        if r is None:
+            h = hashlib.blake2b(
+                f"{self.seed}:{name}".encode(), digest_size=8
+            ).digest()
+            r = random.Random(int.from_bytes(h, "big"))
+            self._streams[name] = r
+        return r
+
+
+class _Actor:
+    __slots__ = ("name", "go", "yielded", "done", "exc", "thread")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.go = threading.Event()       # loop -> actor: run now
+        self.yielded = threading.Event()  # actor -> loop: parked or done
+        self.done = False
+        self.exc: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
+
+
+class Simulation:
+    """The event loop. Create one per scenario; drive with :meth:`run`.
+
+    ``schedule``/``every`` queue plain callables on the loop thread;
+    ``spawn`` starts an actor (a real thread stepped deterministically);
+    ``sleep`` is the ONLY way simulated code should pass time. The
+    ``virtual_sleep`` bound method doubles as a drop-in ``sleep=`` for
+    components whose wait loops run on the loop thread (``ReplicaSet.drain``):
+    called there, it advances virtual time by pumping due events inline, so
+    in-flight streams genuinely unwind under the waiter."""
+
+    def __init__(self, seed: int = 0):
+        self.clock = VirtualClock()
+        self.rng = SimRng(seed)
+        self.seed = int(seed)
+        self._heap: list = []   # (t, seq, kind, payload)
+        self._seq = 0
+        self._log: list = []
+        self._actors: dict = {}  # thread ident -> _Actor
+        self._aborting = False
+        self._spawned = 0
+
+    # ------------------------------------------------------------ event log
+    def record(self, event: str, **fields):
+        """Append one line to the event log (the digest input). Fields are
+        rendered sorted so dict construction order can't leak in."""
+        tail = " ".join(
+            f"{k}={fields[k]}" for k in sorted(fields)
+        )
+        self._log.append(
+            f"{self.clock.now:.6f} {event}{' ' + tail if tail else ''}"
+        )
+
+    def digest(self) -> str:
+        return hashlib.blake2b(
+            "\n".join(self._log).encode(), digest_size=16
+        ).hexdigest()
+
+    @property
+    def events(self) -> list:
+        return list(self._log)
+
+    # ----------------------------------------------------------- scheduling
+    def now(self) -> float:
+        return self.clock.now
+
+    def _push(self, t: float, kind: str, payload):
+        heapq.heappush(self._heap, (t, self._seq, kind, payload))
+        self._seq += 1
+
+    def schedule(self, delay: float, fn: Callable[[], None]):
+        """Run ``fn`` on the loop thread ``delay`` virtual seconds from
+        now."""
+        if delay < 0:
+            raise ValueError("cannot schedule into the past")
+        self._push(self.clock.now + delay, "call", fn)
+
+    def every(self, interval: float, fn: Callable[[], None], *,
+              until: Optional[float] = None, phase: float = 0.0):
+        """Run ``fn`` every ``interval`` virtual seconds (first firing at
+        ``phase``), rescheduling itself while ``now < until``. A bounded
+        horizon is what lets :meth:`run` drain to empty: past ``until`` the
+        only events left are in-flight actors finishing their streams."""
+        if interval <= 0:
+            raise ValueError("interval must be positive")
+
+        def _tick():
+            fn()
+            if until is None or self.clock.now + interval <= until:
+                self.schedule(interval, _tick)
+
+        self.schedule(phase, _tick)
+
+    # ----------------------------------------------------------------- actors
+    def spawn(self, fn: Callable[[], None], name: str):
+        """Start an actor: ``fn`` runs on its own thread but is stepped by
+        the event loop — it must pass time only via :meth:`sleep`."""
+        self._spawned += 1
+        actor = _Actor(name)
+
+        def _main():
+            actor.go.wait()
+            actor.go.clear()
+            try:
+                if not self._aborting:
+                    fn()
+            except SimAborted:
+                pass
+            except BaseException as e:  # noqa: BLE001 — surfaced by the loop
+                actor.exc = e
+            finally:
+                actor.done = True
+                self._actors.pop(threading.get_ident(), None)
+                actor.yielded.set()
+
+        t = threading.Thread(
+            target=_main, name=f"sim-{name}", daemon=True
+        )
+        actor.thread = t
+        t.start()
+        self._actors[t.ident] = actor
+        self._push(self.clock.now, "resume", actor)
+        return actor
+
+    def sleep(self, dt: float):
+        """Actor-side: park for ``dt`` virtual seconds. The calling thread
+        blocks on an Event (a handoff, not a wall-clock sleep) until the
+        loop reaches the wake-up timestamp."""
+        actor = self._actors.get(threading.get_ident())
+        if actor is None:
+            raise RuntimeError("sleep() called off any actor thread — use "
+                               "virtual_sleep for loop-thread waits")
+        self._push(self.clock.now + max(0.0, dt), "resume", actor)
+        actor.yielded.set()
+        actor.go.wait()
+        actor.go.clear()
+        if self._aborting:
+            raise SimAborted()
+
+    def virtual_sleep(self, dt: float):
+        """Drop-in ``sleep=`` for simulated components. On an actor thread
+        it parks the actor; on the loop thread (a wait loop inside a
+        scheduled event, e.g. a drain waiting for in-flight streams) it
+        advances virtual time by running every event due in the window —
+        which is exactly what lets those streams unwind."""
+        if threading.get_ident() in self._actors:
+            self.sleep(dt)
+            return
+        end = self.clock.now + max(0.0, dt)
+        while self._heap and self._heap[0][0] <= end:
+            self._step()
+        self.clock.set(end)
+
+    def _resume(self, actor: _Actor):
+        actor.yielded.clear()
+        actor.go.set()
+        actor.yielded.wait()
+        if actor.done and actor.exc is not None:
+            exc, actor.exc = actor.exc, None
+            raise RuntimeError(
+                f"sim actor {actor.name!r} died: {exc!r}"
+            ) from exc
+
+    # ------------------------------------------------------------------ loop
+    def _step(self):
+        t, _, kind, payload = heapq.heappop(self._heap)
+        self.clock.set(t)
+        if kind == "call":
+            payload()
+        elif not payload.done:  # "resume" for a finished actor is a no-op
+            self._resume(payload)
+
+    def run(self, until: Optional[float] = None):
+        """Process events in order. ``until=None`` drains the queue —
+        every periodic source must be bounded (see :meth:`every`) and every
+        actor must terminate, which is the quiesce the invariant checkers
+        want. With ``until`` set, stops before the first later event."""
+        while self._heap:
+            if until is not None and self._heap[0][0] > until:
+                break
+            self._step()
+        if until is not None:
+            self.clock.set(until)
+
+    def close(self):
+        """Teardown: abort every parked actor so generators unwind their
+        finally blocks (probe tickets, slot counts) before the runtime
+        leak ledger is checked."""
+        self._aborting = True
+        for _ in range(10_000):  # bounded: each pass retires >= 1 actor
+            pending = [a for a in list(self._actors.values()) if not a.done]
+            if not pending:
+                break
+            a = pending[0]
+            a.yielded.clear()
+            a.go.set()
+            a.yielded.wait()

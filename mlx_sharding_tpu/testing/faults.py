@@ -148,6 +148,43 @@ class Fault:
 _ARMED: dict[str, list[Fault]] = {}
 _ARM_LOCK = threading.Lock()
 
+# parse-failure accounting (see _parse_env): lifetime count of MST_FAULTS
+# entries that were dropped as malformed, exported to /metrics as
+# ``mst_faults_malformed_total`` so a typo'd fault spec in a live
+# deployment is a visible counter, not just a log line at boot
+_MALFORMED = 0
+# strict mode: tests (and MST_FAULTS_STRICT=1 deployments) turn the
+# warning into a raise — a chaos campaign must not silently run with half
+# its schedule dropped
+_STRICT = False
+
+
+class MalformedFaultSpec(ValueError):
+    """A ``MST_FAULTS`` entry failed to parse under strict mode."""
+
+
+def set_strict(enabled: bool) -> None:
+    """Toggle strict parsing of fault specs (tests arm this so a typo in a
+    campaign schedule fails loudly instead of quietly doing nothing)."""
+    global _STRICT
+    with _ARM_LOCK:
+        _STRICT = bool(enabled)
+
+
+def malformed_total() -> int:
+    """Lifetime count of dropped-as-malformed fault specs."""
+    with _ARM_LOCK:
+        return _MALFORMED
+
+
+def armed_sites() -> dict[str, int]:
+    """Currently armed sites -> armed-fault count, for the
+    ``mst_faults_armed{site}`` gauge: a fault left armed in a live
+    deployment (a forgotten MST_FAULTS, a campaign that didn't disarm)
+    should be visible on every scrape, not discovered during an incident."""
+    with _ARM_LOCK:
+        return {site: len(lst) for site, lst in _ARMED.items() if lst}
+
 
 def arm(
     site: str,
@@ -221,8 +258,18 @@ def _parse_env(spec: str):
                     kw["after"] = int(v)
                 elif k == "exc":
                     kw["exc"] = _EXC_NAMES[v]
+                elif k:
+                    raise KeyError(k)  # unknown key: count it, don't guess
             arm(site, **kw)
-        except (KeyError, ValueError):
+        except (KeyError, ValueError) as e:
+            global _MALFORMED
+            with _ARM_LOCK:
+                _MALFORMED += 1
+                strict = _STRICT
+            if strict:
+                raise MalformedFaultSpec(
+                    f"malformed MST_FAULTS entry {part!r}"
+                ) from e
             # a malformed fault spec must never take down serving — faults
             # are a debugging tool, not a dependency
             import logging
@@ -232,5 +279,7 @@ def _parse_env(spec: str):
             )
 
 
+if os.environ.get("MST_FAULTS_STRICT", "").lower() in ("1", "true", "yes"):
+    set_strict(True)
 if os.environ.get("MST_FAULTS"):
     _parse_env(os.environ["MST_FAULTS"])

@@ -213,7 +213,7 @@ class Tracer:
         for cand in reversed(ring):
             if cand.request_id == request_id:
                 return cand.freeze()
-        for _, frozen in reversed(snaps):
+        for _, frozen, _camp in reversed(snaps):
             for f in frozen:
                 if f["request_id"] == request_id:
                     return f
@@ -230,15 +230,30 @@ class Tracer:
         copied (the originals keep recording) into a bounded snapshot list
         keyed by ``reason`` (``fault:<site>``, ``breaker_open``, ``wedge``)."""
         frozen = self.dump()
+        # campaign provenance: when a chaos campaign is active (sim/chaos
+        # sets it), the snapshot carries the campaign's seed and the VIRTUAL
+        # timestamp of the incident — enough to link a production-shaped
+        # post-mortem back to its replayable repro file
+        camp = campaign_stamp()
         with self._lock:
-            self._snapshots.append((reason, frozen))
+            self._snapshots.append((reason, frozen, camp))
             while len(self._snapshots) > MAX_SNAPSHOTS:
                 self._snapshots.pop(0)
-        return {"reason": reason, "traces": frozen}
+        out = {"reason": reason, "traces": frozen}
+        if camp is not None:
+            out["campaign"] = camp
+        return out
 
     def snapshots(self) -> list:
         with self._lock:
-            return [{"reason": r, "traces": f} for r, f in self._snapshots]
+            snaps = list(self._snapshots)
+        out = []
+        for r, f, camp in snaps:
+            entry = {"reason": r, "traces": f}
+            if camp is not None:
+                entry["campaign"] = camp
+            out.append(entry)
+        return out
 
     def stats(self) -> dict:
         with self._lock:
@@ -266,8 +281,12 @@ class Tracer:
         with self._lock:
             snaps = list(self._snapshots)
         out["snapshots"] = [
-            {"reason": r, "requests": [f["request_id"] for f in frozen]}
-            for r, frozen in snaps
+            dict(
+                {"reason": r,
+                 "requests": [f["request_id"] for f in frozen]},
+                **({"campaign": camp} if camp is not None else {}),
+            )
+            for r, frozen, camp in snaps
         ]
         return out
 
@@ -384,6 +403,42 @@ class bind:
 
 
 # ------------------------------------------------------------ post-mortem
+# chaos-campaign provenance: while a seeded campaign is running, every
+# flight-recorder snapshot is stamped with the campaign's identity and the
+# VIRTUAL time of the incident, so a production-shaped post-mortem links
+# straight back to the repro file that replays it bit-identically.
+_CAMPAIGN: Optional[dict] = None
+
+
+def set_campaign(name: Optional[str], seed: Optional[int] = None,
+                 clock=None):
+    """Install (or, with ``name=None``, clear) the active chaos-campaign
+    context. ``clock`` is the campaign's virtual clock; it is read at each
+    snapshot to stamp ``t_virtual``."""
+    global _CAMPAIGN
+    if name is None:
+        _CAMPAIGN = None
+    else:
+        _CAMPAIGN = {"name": str(name), "seed": int(seed or 0),
+                     "clock": clock}
+
+
+def campaign_stamp() -> Optional[dict]:
+    """The JSON-safe provenance dict for the active campaign (None when no
+    campaign is running)."""
+    camp = _CAMPAIGN
+    if camp is None:
+        return None
+    out = {"name": camp["name"], "seed": camp["seed"]}
+    clock = camp.get("clock")
+    if clock is not None:
+        try:
+            out["t_virtual"] = float(clock())
+        except Exception:  # noqa: BLE001 — provenance never breaks a snapshot
+            pass
+    return out
+
+
 def auto_snapshot(reason: str):
     """Freeze the flight recorder on an incident (breaker trip, wedge,
     fault firing). Near-free no-op when tracing is off."""

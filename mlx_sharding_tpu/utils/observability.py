@@ -274,6 +274,32 @@ class ServingMetrics:
             Histogram.render_into(
                 lines, "mst_ttft_seconds", self.ttft_hist.to_dict()
             )
+            # fault-harness visibility: a fault left ARMED in a live
+            # deployment (forgotten MST_FAULTS, a chaos campaign that
+            # didn't disarm) must show on every scrape, as must specs
+            # dropped at parse time. Lazy import + never-500, same as the
+            # engine sections below.
+            fmark = len(lines)
+            try:
+                from mlx_sharding_tpu.testing import faults as _faults
+
+                lines += [
+                    "# TYPE mst_faults_malformed_total counter",
+                    f"mst_faults_malformed_total {_faults.malformed_total()}",
+                    "# TYPE mst_faults_armed gauge",
+                ]
+                armed = _faults.armed_sites()
+                if armed:
+                    lines += [
+                        f'mst_faults_armed{{site="{site}"}} {n}'
+                        for site, n in sorted(armed.items())
+                    ]
+                else:
+                    # a bare # TYPE with no sample is invalid exposition —
+                    # the disarmed steady state is an explicit zero
+                    lines.append("mst_faults_armed 0")
+            except Exception:  # noqa: BLE001 — scrape must not 500
+                del lines[fmark:]
             # any engine accessor can die mid-scrape (replica torn
             # down, pool closing); drop the whole engine section
             # cleanly rather than 500 or emit a half-rendered family
@@ -868,6 +894,10 @@ _HELP = {
     "mst_tick_host_ms": "Host-side scheduler work per tick, ms.",
     "mst_tick_device_blocked_ms":
         "Per-tick wall time blocked on the device, ms.",
+    "mst_faults_armed":
+        "Currently armed fault-injection sites (should be 0 in prod).",
+    "mst_faults_malformed_total":
+        "MST_FAULTS entries dropped as malformed at parse time.",
 }
 
 

@@ -32,4 +32,10 @@ echo "== fixture corpus + resource ledger =="
 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_static_analysis.py tests/test_resource_ledger.py -q
 
+# 4. sim smoke: a tiny seeded chaos campaign (3 hosts, storm + host
+#    kill) through the REAL fleet stack in virtual time — all
+#    invariants must hold. ~10s, zero wall-clock sleeps.
+echo "== chaos campaign smoke =="
+env JAX_PLATFORMS=cpu python -m mlx_sharding_tpu.sim.chaos --smoke
+
 echo "check.sh: all gates passed"

@@ -1,0 +1,12 @@
+"""MST107 (monotonic-bypass form): a class carries an injectable clock but
+its deadline arithmetic reads time.monotonic() directly — the injected
+source is silently bypassed, so virtual-clock tests diverge from prod."""
+import time
+
+
+class LeaseTable:
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+
+    def expired(self, deadline: float) -> bool:
+        return time.monotonic() > deadline

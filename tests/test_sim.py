@@ -1,0 +1,377 @@
+"""Fleet simulator + chaos campaign suite (mlx_sharding_tpu/sim/).
+
+Quick tier: determinism (same seed → identical event-log digests),
+virtual-clock/simkit mechanics, every invariant checker catching a seeded
+violation, the ddmin shrinker reducing a 20-event failing storm to ≤ 3
+events, repro-file round-trip, and the fault-site coverage gate
+cross-checking ``lifecycle.REQUIRED_FAULT_SITES`` against the scenario
+library. The 100-host 10×-surge acceptance campaign is ``slow``-marked.
+
+Everything here runs in virtual time — zero wall-clock sleeps — so the
+hard timeouts are generous bounds on pure CPU work, not waits.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import pytest
+
+from mlx_sharding_tpu.sim.chaos import (
+    SCENARIOS,
+    Campaign,
+    FaultEvent,
+    load_repro,
+    run_campaign,
+    scenario_host_death,
+    scenario_site_storm,
+    scenario_surge_100,
+    shrink,
+    write_repro,
+)
+from mlx_sharding_tpu.sim.fleetsim import (
+    SimReplica,
+    build_fleet,
+    drive_arrivals,
+    token_at,
+)
+from mlx_sharding_tpu.sim.simkit import SimRng, Simulation
+from mlx_sharding_tpu.utils.clock import MONOTONIC, Clock, VirtualClock
+from tests.helpers import hard_timeout
+
+
+@pytest.fixture(autouse=True)
+def _quiet_chaos_logs():
+    # campaigns exercise failure paths that log exceptions on purpose;
+    # keep the suite output readable
+    logging.disable(logging.ERROR)
+    yield
+    logging.disable(logging.NOTSET)
+
+
+# ------------------------------------------------------------ utils/clock
+def test_virtual_clock_is_monotonic_and_injectable():
+    clk = VirtualClock()
+    assert isinstance(clk, Clock)
+    assert isinstance(MONOTONIC, Clock)
+    assert clk() == 0.0
+    clk.advance(1.5)
+    assert clk() == clk.now == 1.5
+    clk.set(1.0)  # no-op: time never runs backward
+    assert clk() == 1.5
+    with pytest.raises(ValueError):
+        clk.advance(-0.1)
+
+
+# ----------------------------------------------------------------- simkit
+def test_sim_rng_streams_are_independent():
+    a, b = SimRng(1), SimRng(1)
+    assert [a.stream("x").random() for _ in range(5)] == [
+        b.stream("x").random() for _ in range(5)
+    ]
+    # a draw on one stream must not shift another
+    c = SimRng(1)
+    c.stream("y").random()
+    assert c.stream("x").random() == SimRng(1).stream("x").random()
+    assert SimRng(1).stream("x").random() != SimRng(2).stream("x").random()
+
+
+@hard_timeout(10)
+def test_sim_event_ordering_and_actor_sleep():
+    sim = Simulation(seed=3)
+    order = []
+    sim.schedule(2.0, lambda: order.append(("call", sim.now())))
+
+    def actor():
+        order.append(("a0", sim.now()))
+        sim.sleep(1.0)
+        order.append(("a1", sim.now()))
+        sim.sleep(3.0)
+        order.append(("a2", sim.now()))
+
+    sim.spawn(actor, name="a")
+    sim.run()
+    assert order == [("a0", 0.0), ("a1", 1.0), ("call", 2.0), ("a2", 4.0)]
+    sim.close()
+
+
+@hard_timeout(10)
+def test_sim_digest_replays_bit_identically():
+    def build(seed):
+        sim = Simulation(seed=seed)
+        rng = sim.rng.stream("load")
+        for i in range(20):
+            t = rng.random() * 5
+
+            def work(i=i):
+                sim.record("evt", i=i)
+
+            sim.schedule(t, work)
+        sim.run()
+        d = sim.digest()
+        sim.close()
+        return d
+
+    assert build(7) == build(7)
+    assert build(7) != build(8)
+
+
+# --------------------------------------------------------------- fleetsim
+@hard_timeout(30)
+def test_small_fleet_serves_deterministically():
+    def run(seed):
+        sim = Simulation(seed=seed)
+        fs = build_fleet(sim, n_hosts=2, horizon_s=10.0)
+        n = drive_arrivals(fs, kind="diurnal", duration_s=8.0,
+                           base_rate=2.0)
+        sim.run()
+        digest = sim.digest()
+        outcomes = sorted(
+            (r["rid"], r["outcome"], tuple(r["tokens"]))
+            for r in fs.requests.values()
+        )
+        sim.close()
+        for h in fs.hosts:
+            h.rs.close()
+        return n, digest, outcomes
+
+    n1, d1, o1 = run(5)
+    n2, d2, o2 = run(5)
+    assert n1 == n2 and d1 == d2 and o1 == o2
+    assert n1 > 0
+    for _, outcome, _toks in o1:
+        assert outcome == "completed"
+
+
+@hard_timeout(30)
+def test_resume_is_token_exact_across_replica_crash():
+    sim = Simulation(seed=9)
+    fs = build_fleet(sim, n_hosts=2, horizon_s=20.0)
+    prompt = [3, 1, 4, 1, 5]
+    fs.submit("r0", prompt, 8, host=0)
+    # crash host 0's engines mid-stream (8 tokens take ~0.4s virtual)
+    sim.schedule(
+        0.17,
+        lambda: [rep.crash() for rep in fs.hosts[0].replicas],
+    )
+    sim.run()
+    rec = fs.requests["r0"]
+    assert rec["outcome"] == "completed"
+    assert rec["tokens"] == [token_at(prompt, i) for i in range(8)]
+    assert any(d.startswith("failover:") for d in rec["degradations"])
+    sim.close()
+    for h in fs.hosts:
+        h.rs.close()
+
+
+# --------------------------------------------------- campaigns: happy path
+@hard_timeout(60)
+def test_site_storm_campaign_green_and_replayable():
+    r1 = run_campaign(scenario_site_storm())
+    r2 = run_campaign(scenario_site_storm())
+    assert r1.ok, r1.violations
+    assert r1.digest == r2.digest
+    assert r1.n_requests > 0
+    assert set(r1.outcomes) <= {"completed", "shed", "client_aborted"}
+
+
+@hard_timeout(60)
+def test_host_death_campaign_never_drops_streams():
+    res = run_campaign(scenario_host_death())
+    assert res.ok, res.violations
+    assert res.outcomes.get("completed", 0) > 0
+
+
+# ------------------------------------------- invariants catch seeded bugs
+@hard_timeout(60)
+def test_no_dropped_streams_catches_disabled_resume():
+    camp = scenario_host_death()
+    camp.resume_streams = False  # the deliberately broken variant
+    res = run_campaign(camp)
+    assert not res.ok
+    assert any(v.startswith("no_dropped_streams:") for v in res.violations)
+
+
+@hard_timeout(60)
+def test_token_exact_catches_corrupted_history(monkeypatch):
+    # corrupt the resume path: a replica that seeds its history one token
+    # short re-emits a duplicate — exactly the class of bug the invariant
+    # exists for
+    orig = SimReplica.generate_step
+
+    def corrupting(self, prompt_tokens, **kw):
+        resume = kw.get("_resume")
+        if resume is not None and resume.history:
+            resume.history = list(resume.history)[:-1]
+        return orig(self, prompt_tokens, **kw)
+
+    monkeypatch.setattr(SimReplica, "generate_step", corrupting)
+    camp = scenario_host_death()
+    res = run_campaign(camp)
+    assert not res.ok
+    assert any(v.startswith("token_exact:") for v in res.violations)
+
+
+@hard_timeout(60)
+def test_ledger_clean_catches_leaked_handle():
+    from mlx_sharding_tpu.analysis import runtime as mst_runtime
+
+    camp = Campaign(name="leaky", seed=3, n_hosts=2, duration_s=4.0,
+                    settle_s=3.0, base_rate=1.0)
+    camp.schedule = [FaultEvent(t=1.0, kind="site", site="scheduler.tick",
+                                exc="runtime", times=1)]
+    orig = run_campaign.__globals__["_apply_event"]
+
+    def leaky(fs, ev):
+        mst_runtime.note_acquire("faults.arm", ("leaked", id(ev)))
+        orig(fs, ev)
+
+    run_campaign.__globals__["_apply_event"] = leaky
+    try:
+        res = run_campaign(camp)
+    finally:
+        run_campaign.__globals__["_apply_event"] = orig
+    assert not res.ok
+    assert any(v.startswith("ledger_clean:") for v in res.violations)
+
+
+@hard_timeout(60)
+def test_convergence_catches_unhealed_breaker():
+    # a breaker storm whose victim never heals and gets no settle traffic:
+    # the breaker opens inside the storm and nothing ever probes it closed
+    camp = Campaign(
+        name="stuck_breaker", seed=13, n_hosts=2, duration_s=6.0,
+        settle_s=0.5, base_rate=2.0, arrival="herd",
+        schedule=[
+            # every dispatch to replica 0 on any host fails, forever
+            FaultEvent(t=0.0, kind="site", site="replica.dispatch",
+                       exc="runtime", times=None, match={"replica": 0}),
+        ],
+        invariants=("convergence",),
+    )
+    res = run_campaign(camp)
+    assert not res.ok
+    assert any(v.startswith("convergence:") for v in res.violations)
+
+
+@hard_timeout(60)
+def test_queued_sane_catches_seeded_negative_gauge():
+    camp = Campaign(name="neg_gauge", seed=3, n_hosts=2, duration_s=4.0,
+                    settle_s=2.0, base_rate=1.0,
+                    invariants=("queued_sane",))
+    import mlx_sharding_tpu.sim.chaos as chaos_mod
+
+    orig_build = chaos_mod.build_fleet
+
+    def sabotaged(sim, **kw):
+        fs = orig_build(sim, **kw)
+        fs.queued_negative = 2  # as if the sampler saw a negative gauge
+        return fs
+
+    chaos_mod.build_fleet = sabotaged
+    try:
+        res = run_campaign(camp)
+    finally:
+        chaos_mod.build_fleet = orig_build
+    assert not res.ok
+    assert any("negative" in v for v in res.violations)
+
+
+# ------------------------------------------------------------- shrinking
+@hard_timeout(120)
+def test_shrinker_reduces_20_event_storm_to_minimal_repro(tmp_path):
+    # 19 harmless site arms + one host_kill, with resume disabled so the
+    # kill drops streams: ddmin must isolate a <= 3 event schedule
+    camp = scenario_host_death(seed=11)
+    camp.resume_streams = False
+    camp.schedule = [
+        FaultEvent(t=2.0 + 0.2 * i, kind="site", site="spec.draft",
+                   exc="fault", times=1)
+        for i in range(19)
+    ] + [FaultEvent(t=7.0, kind="host_kill", host=1)]
+    assert len(camp.schedule) == 20
+    full = run_campaign(camp)
+    assert not full.ok
+
+    shrunk = shrink(camp)
+    assert not shrunk.ok
+    assert len(shrunk.campaign.schedule) <= 3
+    assert any(ev.kind == "host_kill" for ev in shrunk.campaign.schedule)
+
+    # repro file round-trips and replays to the same digest
+    path = tmp_path / "repro.json"
+    write_repro(str(path), shrunk)
+    doc = json.loads(path.read_text())
+    assert doc["format"] == "mst-chaos-repro-v1"
+    replayed = run_campaign(load_repro(str(path)))
+    assert replayed.digest == shrunk.digest
+    assert not replayed.ok
+
+
+# -------------------------------------------------------- coverage gate
+def test_every_required_fault_site_has_a_chaos_scenario():
+    """Registry-drift gate: a newly REQUIRED fault site must be exercised
+    by at least one chaos scenario, or this fails at registration time —
+    the dynamic complement of the MST30x static checks."""
+    from mlx_sharding_tpu.analysis.lifecycle import REQUIRED_FAULT_SITES
+
+    required = {s for sites in REQUIRED_FAULT_SITES.values() for s in sites}
+    covered = set()
+    for factory in SCENARIOS.values():
+        covered |= factory().sites()
+    missing = sorted(required - covered)
+    assert not missing, (
+        f"required fault sites with no chaos scenario arming them: "
+        f"{missing} — add them to a scenario in sim/chaos.py (the storm "
+        "schedules pick up lifecycle.REQUIRED_FAULT_SITES automatically; "
+        "rebuild SCENARIOS or extend one)"
+    )
+
+
+def test_campaign_provenance_stamped_into_snapshots():
+    from mlx_sharding_tpu import tracing
+
+    tracing.configure(mode="on")
+    try:
+        res = run_campaign(
+            Campaign(name="prov", seed=21, n_hosts=2, duration_s=4.0,
+                     settle_s=2.0, base_rate=1.5,
+                     schedule=[FaultEvent(t=1.0, kind="site",
+                                          site="scheduler.tick",
+                                          exc="runtime", times=1)])
+        )
+        assert res.ok, res.violations
+        tr = tracing.get_tracer()
+        snaps = [s for s in tr.snapshots() if "campaign" in s]
+        assert snaps, "no campaign-stamped snapshot recorded"
+        camp = snaps[-1]["campaign"]
+        assert camp["name"] == "prov" and camp["seed"] == 21
+        assert camp["t_virtual"] >= 0.0
+    finally:
+        tracing.configure(mode="off")
+
+
+# ------------------------------------------------------------- slow tier
+@pytest.mark.slow
+@hard_timeout(300)
+def test_surge_100_hosts_acceptance_campaign():
+    """The acceptance criterion verbatim: a seeded 100-host 10×-surge
+    campaign (host deaths + transport kills + fault-site storm) with zero
+    wall-clock sleeps, bit-identical across two runs, zero dropped
+    streams, clean ledger — and the broken variant shrinks to ≤ 3."""
+    r1 = run_campaign(scenario_surge_100())
+    r2 = run_campaign(scenario_surge_100())
+    assert r1.ok, r1.violations
+    assert r1.digest == r2.digest
+    assert r1.n_requests > 500
+
+    broken = scenario_surge_100()
+    broken.resume_streams = False
+    broken.n_hosts = 20  # shrink probes re-run the sim; keep them honest
+    broken.schedule = broken.schedule[:6]
+    res = run_campaign(broken)
+    assert not res.ok
+    shrunk = shrink(broken)
+    assert len(shrunk.campaign.schedule) <= 3
+    assert not shrunk.ok

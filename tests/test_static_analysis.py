@@ -40,6 +40,7 @@ EXPECTED = {
     "mst105_dense_dequant.py": ("MST105", 10, 4),
     "mst106_sync_spill.py": ("MST106", 11, 11),
     "mst107_wall_clock_deadline.py": ("MST107", 7, 22),
+    "mst107_monotonic_bypass.py": ("MST107", 12, 15),
     "mst108_block_migration.py": ("MST108", 8, 10),
     "mst109_demand_import.py": ("MST109", 10, 13),
     "mst110_spawn_upload.py": ("MST110", 10, 15),
