@@ -33,6 +33,7 @@ import hashlib
 import json
 import re
 import sys
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Optional
@@ -196,6 +197,10 @@ class Report:
     files_scanned: int = 0
     cache_hits: int = 0
     cache_misses: int = 0
+    # per-rule-family wall time (ms), per-file passes + global passes
+    rule_timings: dict = field(default_factory=dict)
+    # races.global_check's per-attr table: "Cls.attr" -> roles/lockset/verdict
+    race_verdicts: dict = field(default_factory=dict)
 
 
 # ------------------------------------------------------- per-file facts
@@ -218,16 +223,28 @@ def _checker_digest() -> str:
     return _checker_digest_memo
 
 
-def file_facts(mod: ModuleInfo) -> dict:
+def file_facts(mod: ModuleInfo, timings: Optional[dict] = None) -> dict:
     """Everything the triage pass needs from one file, JSON-safe: the
     module-local findings of every rule family, the suppression table,
-    and the per-file lock facts for the cross-module pass."""
+    and the per-file lock/role/doc facts for the cross-module passes.
+    ``timings`` (family name -> accumulated ms) feeds the per-rule timing
+    block of ``--format json``."""
     from mlx_sharding_tpu.analysis import (
+        docs,
         lifecycle,
         locks,
         resource_lifecycle,
+        thread_roles,
         trace_safety,
     )
+
+    def timed(name, fn, *fn_args):
+        t0 = time.perf_counter()
+        out = fn(*fn_args)
+        if timings is not None:
+            timings[name] = (timings.get(name, 0.0)
+                             + (time.perf_counter() - t0) * 1e3)
+        return out
 
     findings: list[Finding] = []
     for line in mod.bad_suppressions:
@@ -237,16 +254,19 @@ def file_facts(mod: ModuleInfo) -> dict:
             "'# mst: allow(<rule>): <why this is safe>'",
             context=qualname_for_line(mod.tree, line),
         ))
-    findings.extend(trace_safety.check_module(mod))
-    findings.extend(lifecycle.check_module(mod))
-    findings.extend(resource_lifecycle.check_module(mod))
+    findings.extend(timed("trace_safety", trace_safety.check_module, mod))
+    findings.extend(timed("lifecycle", lifecycle.check_module, mod))
+    findings.extend(timed("resource_lifecycle",
+                          resource_lifecycle.check_module, mod))
     return {
         "findings": [f.__dict__.copy() for f in findings],
         "suppressions": {
             str(line): sorted(rules)
             for line, rules in mod.suppressions.items()
         },
-        "lock": locks.module_facts(mod),
+        "lock": timed("locks", locks.module_facts, mod),
+        "roles": timed("thread_roles", thread_roles.module_facts, mod),
+        "doc": timed("docs", docs.module_facts, mod),
     }
 
 
@@ -255,6 +275,8 @@ def _error_facts(errors: list[Finding]) -> dict:
         "findings": [f.__dict__.copy() for f in errors],
         "suppressions": {},
         "lock": {"findings": [], "classes": []},
+        "roles": {"entries": [], "classes": {}},
+        "doc": {"metrics": [], "flags": []},
     }
 
 
@@ -277,20 +299,35 @@ REGEN_HINT = ("regenerate with `python -m mlx_sharding_tpu.analysis "
 
 def analyze_paths(paths: list[str], baseline: Optional[set] = None,
                   cache_path: Optional[Path] = None,
-                  baseline_path: Optional[Path] = None) -> Report:
+                  baseline_path: Optional[Path] = None,
+                  changed: Optional[set] = None) -> Report:
     """Run every rule family over ``paths``; returns the triaged report.
 
     With ``cache_path``, per-file results are reused when the file's
     content hash and the checker's own digest both match — self-scan
     cost becomes proportional to what changed since the last run.
+
+    With ``changed`` (a set of repo-relative posix paths, e.g. from
+    ``git diff --name-only``), any collected file *not* in the set is
+    served straight from the cache without even re-reading it — the
+    ``--changed`` pre-commit path. Files in the set (and files the cache
+    has never seen) go through the normal hash-and-check route.
     """
-    from mlx_sharding_tpu.analysis import locks
+    from mlx_sharding_tpu.analysis import docs, locks, races
 
     report = Report()
+    timings = report.rule_timings
     cache = _load_cache(cache_path)
     records: dict[str, dict] = {}  # display_path -> facts
     for f in collect_files(paths):
         display = f.as_posix()
+        if changed is not None and display not in changed:
+            entry = cache["files"].get(display)
+            if entry is not None:
+                records[display] = entry["facts"]
+                report.cache_hits += 1
+                report.files_scanned += 1
+                continue
         try:
             data = f.read_bytes()
         except OSError as e:
@@ -306,7 +343,8 @@ def analyze_paths(paths: list[str], baseline: Optional[set] = None,
         else:
             mod, errors = parse_module(
                 f, display, source=data.decode("utf-8", errors="replace"))
-            facts = _error_facts(errors) if mod is None else file_facts(mod)
+            facts = (_error_facts(errors) if mod is None
+                     else file_facts(mod, timings))
             cache["files"][display] = {"hash": digest, "facts": facts}
             report.cache_misses += 1
         records[display] = facts
@@ -318,10 +356,29 @@ def analyze_paths(paths: list[str], baseline: Optional[set] = None,
         except OSError:
             pass  # the cache is an optimization, never a failure
 
+    def timed_global(name, fn, *fn_args):
+        t0 = time.perf_counter()
+        out = fn(*fn_args)
+        timings[name] = (timings.get(name, 0.0)
+                         + (time.perf_counter() - t0) * 1e3)
+        return out
+
     # cross-module lock pass (cheap dict work; always recomputed)
-    lock_findings, edges = locks.global_check(
+    lock_findings, edges = timed_global(
+        "locks_global", locks.global_check,
         {p: r["lock"] for p, r in records.items()})
     report.lock_edges = edges
+
+    # cross-module race pass (thread-role propagation + MST501-504)
+    race_findings, verdicts = timed_global(
+        "races_global", races.global_check,
+        {p: r["roles"] for p, r in records.items()})
+    report.race_verdicts = verdicts
+
+    # doc-drift gate (MST005): README tables vs the live inventory
+    doc_findings = timed_global(
+        "docs_global", docs.global_check,
+        {p: r["doc"] for p, r in records.items()}, docs.find_readme(paths))
 
     raw: list[Finding] = [
         Finding(**d)
@@ -329,6 +386,8 @@ def analyze_paths(paths: list[str], baseline: Optional[set] = None,
         for d in r["findings"] + r["lock"]["findings"]
     ]
     raw.extend(lock_findings)
+    raw.extend(race_findings)
+    raw.extend(doc_findings)
 
     # MST002: every suppression must still be earning its keep
     fired_by_path: dict[str, set] = {}
@@ -428,7 +487,28 @@ def main(argv: Optional[list[str]] = None) -> int:
                         f"(default: {DEFAULT_CACHE})")
     parser.add_argument("--no-cache", action="store_true",
                         help="reparse and recheck every file")
+    parser.add_argument("--changed", action="store_true",
+                        help="git-diff-scoped scan: only files changed vs "
+                        "HEAD (plus untracked) are re-checked; everything "
+                        "else is served from the cache without re-hashing")
     args = parser.parse_args(argv)
+
+    changed: Optional[set] = None
+    if args.changed and not args.no_cache:
+        import subprocess
+
+        try:
+            diff = subprocess.run(
+                ["git", "diff", "--name-only", "HEAD"],
+                capture_output=True, text=True, check=True).stdout
+            untracked = subprocess.run(
+                ["git", "ls-files", "--others", "--exclude-standard"],
+                capture_output=True, text=True, check=True).stdout
+            changed = {ln.strip() for ln in
+                       (diff + untracked).splitlines() if ln.strip()}
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"mstcheck: --changed needs git ({e}); full scan",
+                  file=sys.stderr)
 
     baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
     baseline: Optional[set] = None
@@ -440,6 +520,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         args.paths, baseline=baseline,
         cache_path=None if args.no_cache else Path(args.cache),
         baseline_path=baseline_path,
+        changed=changed,
     )
     elapsed_ms = (time.perf_counter() - t0) * 1e3
 
@@ -449,7 +530,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 0
 
     if args.format == "json":
-        from mlx_sharding_tpu.analysis import resources
+        from mlx_sharding_tpu.analysis import resources, thread_roles
 
         print(json.dumps({
             "findings": [f.__dict__ for f in report.findings],
@@ -459,7 +540,11 @@ def main(argv: Optional[list[str]] = None) -> int:
             "cache_hits": report.cache_hits,
             "cache_misses": report.cache_misses,
             "elapsed_ms": round(elapsed_ms, 1),
+            "rule_timings_ms": {k: round(v, 2) for k, v in
+                                sorted(report.rule_timings.items())},
             "resource_registry": resources.registry_table(),
+            "thread_roles": thread_roles.role_table(),
+            "race_verdicts": report.race_verdicts,
         }, indent=2))
     else:
         for f in report.findings:

@@ -1,0 +1,172 @@
+"""MST005 doc-drift gate: README reference tables vs the live code.
+
+The README's metrics reference and CLI flag tables rot silently — a new
+``mst_*`` family or ``--flag`` ships, the table doesn't. This pass makes
+drift a *finding*:
+
+- the per-file half extracts the live inventory: every metric family the
+  exposition code can emit (``# TYPE mst_x <type>`` string literals plus
+  ``Histogram.render_into(lines, "mst_x", ...)`` family arguments) and
+  every ``add_argument("--flag", ...)`` an argparse parser registers;
+- the global half parses the README regions fenced by HTML markers
+
+  .. code-block:: markdown
+
+     <!-- mstcheck:metrics -->            ... | `mst_x` | ... |
+     <!-- /mstcheck:metrics -->
+     <!-- mstcheck:flags path/to/module.py -->   ... | `--flag` | ... |
+     <!-- /mstcheck:flags -->
+
+  and reports any name present in exactly one side. A flags region whose
+  module was not part of this scan is skipped (a ``--changed`` or
+  single-file run must not fabricate drift).
+
+The gate arms only when the scan saw at least one metrics-bearing file
+and a README sits next to the scanned tree — fixture and tmp-dir scans
+never trip it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Optional
+
+from mlx_sharding_tpu.analysis.core import Finding, ModuleInfo, dotted_name
+
+_TYPE_RE = re.compile(r"#\s*TYPE\s+(mst_\w+)\s+(?:counter|gauge|summary|"
+                      r"histogram)")
+_METRIC_TOKEN_RE = re.compile(r"`(mst_\w+)`")
+_FLAG_TOKEN_RE = re.compile(r"`(--[\w][\w-]*)`")
+_METRICS_OPEN = "<!-- mstcheck:metrics -->"
+_METRICS_CLOSE = "<!-- /mstcheck:metrics -->"
+_FLAGS_OPEN_RE = re.compile(r"<!--\s*mstcheck:flags\s+(\S+)\s*-->")
+_FLAGS_CLOSE = "<!-- /mstcheck:flags -->"
+
+
+def module_facts(mod: ModuleInfo) -> dict:
+    """Live inventory of one file: emittable metric families + CLI flags."""
+    metrics: set = set()
+    flags: set = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for m in _TYPE_RE.finditer(node.value):
+                metrics.add(m.group(1))
+        elif isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            leaf = fn.split(".")[-1] if fn else ""
+            if leaf == "render_into":
+                for a in node.args:
+                    if (isinstance(a, ast.Constant)
+                            and isinstance(a.value, str)
+                            and a.value.startswith("mst_")):
+                        metrics.add(a.value)
+            elif leaf == "add_argument":
+                for a in node.args:
+                    if (isinstance(a, ast.Constant)
+                            and isinstance(a.value, str)
+                            and a.value.startswith("--")):
+                        flags.add(a.value)
+    return {"metrics": sorted(metrics), "flags": sorted(flags)}
+
+
+def find_readme(paths: list) -> Optional[Path]:
+    """README.md in (or one level above) the first scanned directory."""
+    for p in paths:
+        base = Path(p)
+        if not base.is_dir():
+            base = base.parent
+        for cand in (base / "README.md", base.parent / "README.md"):
+            if cand.is_file():
+                return cand
+    return None
+
+
+def _drift(rule_path: str, line: int, table: str, missing: list,
+           extra: list) -> list:
+    findings = []
+    if missing:
+        names = ", ".join(f"`{n}`" for n in missing[:8])
+        more = f" (+{len(missing) - 8} more)" if len(missing) > 8 else ""
+        findings.append(Finding(
+            "MST005", rule_path, line, 0,
+            f"doc drift: {table} table is missing {names}{more} — the "
+            "code emits them, the README does not document them",
+            context=table))
+    if extra:
+        names = ", ".join(f"`{n}`" for n in extra[:8])
+        more = f" (+{len(extra) - 8} more)" if len(extra) > 8 else ""
+        findings.append(Finding(
+            "MST005", rule_path, line, 0,
+            f"doc drift: {table} table documents {names}{more} but the "
+            "code no longer emits them — delete the rows or restore the "
+            "code",
+            context=table))
+    return findings
+
+
+def global_check(doc_facts_by_path: dict,
+                 readme: Optional[Path]) -> list:
+    """Compare README marker regions against the scan's live inventory."""
+    live_metrics: set = set()
+    flags_by_path: dict[str, set] = {}
+    for path, facts in doc_facts_by_path.items():
+        live_metrics.update(facts["metrics"])
+        if facts["flags"]:
+            flags_by_path[path] = set(facts["flags"])
+
+    if not live_metrics or readme is None or not readme.is_file():
+        return []  # not a repo-shaped scan: fixture/tmp trees stay silent
+    try:
+        lines = readme.read_text(encoding="utf-8").splitlines()
+    except OSError:
+        return []
+    rule_path = readme.as_posix()
+
+    findings: list = []
+    # ---- metrics region
+    open_line = close_line = None
+    documented: set = set()
+    for i, text in enumerate(lines, 1):
+        if _METRICS_OPEN in text:
+            open_line = i
+        elif _METRICS_CLOSE in text and open_line is not None:
+            close_line = i
+            break
+        elif open_line is not None:
+            documented.update(_METRIC_TOKEN_RE.findall(text))
+    if open_line is None or close_line is None:
+        findings.append(Finding(
+            "MST005", rule_path, 1, 0,
+            f"README has no metrics table marked with {_METRICS_OPEN} … "
+            f"{_METRICS_CLOSE} — the doc-drift gate cannot check the "
+            "metric reference",
+            context="metrics"))
+    else:
+        findings += _drift(rule_path, open_line, "metrics",
+                           sorted(live_metrics - documented),
+                           sorted(documented - live_metrics))
+
+    # ---- flags regions (one per parser-bearing module)
+    region_mod = region_line = None
+    region_flags: set = set()
+    for i, text in enumerate(lines, 1):
+        m = _FLAGS_OPEN_RE.search(text)
+        if m:
+            region_mod, region_line, region_flags = m.group(1), i, set()
+            continue
+        if _FLAGS_CLOSE in text and region_mod is not None:
+            live = [flags_by_path[p] for p in flags_by_path
+                    if p.endswith(region_mod)]
+            if live:  # module not in this scan -> no verdict
+                live_flags = set().union(*live)
+                findings += _drift(
+                    rule_path, region_line, f"flags[{region_mod}]",
+                    sorted(live_flags - region_flags),
+                    sorted(region_flags - live_flags))
+            region_mod = None
+            continue
+        if region_mod is not None:
+            region_flags.update(_FLAG_TOKEN_RE.findall(text))
+    return findings

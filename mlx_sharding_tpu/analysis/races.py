@@ -1,0 +1,201 @@
+"""MST5xx cross-thread shared-state race rules.
+
+Built on the role facts of :mod:`analysis.thread_roles` (which threads run
+which functions) and the same lock vocabulary as the MST20x pass (node
+names are ``ClassName.attr`` or the ``make_lock("...")`` literal). All
+rules are cross-module: they run in the global pass over the per-file
+facts, so the incremental cache stays sound.
+
+- **MST501 unlocked-cross-role-write** — an attribute is written from ≥2
+  thread roles and the accesses share no common lock, with at least one
+  access holding no lock at all. The Eraser verdict: candidate lockset
+  C(v) is empty because nobody locked.
+- **MST502 empty-lockset-intersection** — every access is under *some*
+  lock, but the intersection across roles is empty: two sides each locked
+  a different lock (mutual exclusion in name only).
+- **MST503 bare-container-publication** — a mutable dict/list/set built in
+  ``__init__`` is mutated by one role and returned *bare* (no
+  ``dict(...)``/``list(...)``/``.copy()``) from the public surface: the
+  caller iterates a live container another thread mutates. Copy under the
+  lock instead.
+- **MST504 blocking-under-tick-lock** — a blocking call (lock acquire,
+  queue ``get``, clock sleep, ``wait``/``join``) while holding a lock the
+  tick role also takes: a stall there wedges the decode tick.
+
+A single *concurrent* role (HTTP handlers, sim actors, pod-serve and
+drain workers) counts as two writers — two threads of the same role race
+each other just fine. The ``api`` role (public surface of a thread-owning
+class) is not self-concurrent, and attributes bound to an internally
+synchronized type (``queue.Queue``, ``threading.Event``, …) are exempt:
+the object *is* the lock.
+"""
+
+from __future__ import annotations
+
+from mlx_sharding_tpu.analysis.core import Finding
+from mlx_sharding_tpu.analysis.thread_roles import CONCURRENT_ROLES, propagate
+
+# attributes that are single-word flags by convention: benign
+# single-writer stop/config flags the GIL keeps atomic are still flagged
+# when *written* from 2 roles, but reads alone never count as a writer
+_IGNORED_ATTR_PREFIXES = ("__",)
+# construction/teardown methods whose accesses happen-before/after the
+# threaded phase (threads are started after __init__ returns and joined
+# by close); their accesses do not participate in lockset intersection
+_EXEMPT_FUNCS = {"__init__", "__post_init__", "__del__", "__repr__"}
+
+
+def _fmt_roles(roles: set) -> str:
+    return "{" + ", ".join(sorted(roles)) + "}"
+
+
+def _has_conflict(rsets: list, self_concurrent: frozenset) -> bool:
+    """Two of these accesses can run concurrently on different threads.
+
+    A function's role set lists the *alternative* drivers of that code
+    path, so two accesses whose role sets are comparable (one a subset of
+    the other) are the same driver reached two ways — e.g. the autoscaler
+    loop's ``tick()`` is public (``{api, autoscaler}``) but nobody drives
+    it externally *while* the thread runs it. A conflict needs either two
+    accesses with incomparable role sets (genuinely different threads) or
+    one access from a multi-instance role (two sim actors / two pod-serve
+    workers race each other just fine)."""
+    for i, a in enumerate(rsets):
+        if a & self_concurrent:
+            return True
+        for b in rsets[i + 1:]:
+            if not (a <= b or b <= a):
+                return True
+    return False
+
+
+def global_check(facts_by_path: dict) -> tuple[list, dict]:
+    """(findings, per-attr verdicts) over every file's role facts.
+
+    The verdict table — ``"Cls.attr" -> {roles, lockset, verdict}`` — is
+    what the dynamic lockset recorder's agreement test compares against:
+    an attr observed shared-modified with an empty lockset at runtime must
+    not carry a ``clean`` static verdict.
+    """
+    roles = propagate(facts_by_path)
+    findings: list[Finding] = []
+    verdicts: dict[str, dict] = {}
+
+    # MST504 needs the fleet-wide set of locks the tick role acquires
+    tick_locks: set = set()
+    for (path, cls, func), rset in roles.items():
+        if "tick" in rset:
+            ff = facts_by_path[path]["classes"][cls]["funcs"].get(func)
+            if ff:
+                tick_locks.update(ff["locks_taken"])
+
+    for path in sorted(facts_by_path):
+        facts = facts_by_path[path]
+        for cls in sorted(facts["classes"]):
+            fcls = facts["classes"][cls]
+            # a fresh RequestHandler instance per request: its OWN attrs
+            # never alias across handler threads (shared state it calls
+            # into is analyzed in the callee's class)
+            self_concurrent = CONCURRENT_ROLES
+            if any("RequestHandler" in b for b in fcls.get("bases", ())):
+                self_concurrent = CONCURRENT_ROLES - {"http_handler"}
+            safe_attrs = set(fcls.get("safe_attrs", ()))
+            per_attr: dict[str, list] = {}
+            returns_bare: dict[str, list] = {}  # attr -> [(line, roles)]
+            for func, ff in sorted(fcls["funcs"].items()):
+                rset = roles.get((path, cls, func), set())
+                if func.split(".")[0] in _EXEMPT_FUNCS:
+                    continue
+                if rset:
+                    for attr, write, line, held in ff["accesses"]:
+                        if attr.startswith(_IGNORED_ATTR_PREFIXES) \
+                                or attr in safe_attrs:
+                            continue
+                        per_attr.setdefault(attr, []).append(
+                            (bool(write), line, frozenset(held), rset, func))
+                    for kind, line, held in ff["blocking"]:
+                        hot = sorted(set(held) & tick_locks)
+                        if hot:
+                            findings.append(Finding(
+                                "MST504", path, line, 0,
+                                f"{kind} while holding {hot[0]} — a lock "
+                                f"the tick loop also takes; a stall in "
+                                f"{cls}.{func}() (roles {_fmt_roles(rset)}) "
+                                "wedges the decode tick",
+                                context=f"{cls}.{func}"))
+                if ff["public"]:
+                    for attr, line in ff["returns_bare"]:
+                        returns_bare.setdefault(attr, []).append(
+                            (line, rset or {"api"}))
+
+            for attr in sorted(per_attr):
+                accs = per_attr[attr]
+                writes = [a for a in accs if a[0]]
+                write_roles: set = set()
+                all_roles: set = set()
+                for write, _line, _held, rset, _func in accs:
+                    all_roles |= rset
+                    if write:
+                        write_roles |= rset
+                # the Eraser candidate lockset, over writes (a racy read
+                # of guarded state is MST201's beat, not this rule's)
+                common = None
+                for _write, _line, held, _rset, _func in writes:
+                    common = held if common is None else (common & held)
+                common = common or frozenset()
+                key = f"{cls}.{attr}"
+                racy = (_has_conflict([a[3] for a in writes],
+                                      self_concurrent) and not common)
+                verdict = ("racy" if racy else
+                           "clean" if writes and len(all_roles) > 1
+                           else "single-role")
+                prev = verdicts.get(key)
+                if prev is None or (verdict == "racy"
+                                    and prev["verdict"] != "racy"):
+                    verdicts[key] = {"roles": sorted(all_roles),
+                                     "lockset": sorted(common),
+                                     "verdict": verdict}
+                if racy:
+                    unlocked = sorted((ln, fn) for _w, ln, held, _r, fn
+                                      in writes if not held)
+                    if unlocked:
+                        line, func = unlocked[0]
+                        findings.append(Finding(
+                            "MST501", path, line, 0,
+                            f"'{attr}' is written from roles "
+                            f"{_fmt_roles(write_roles)} with no common "
+                            f"lock — this write in {cls}.{func}() holds "
+                            "no lock at all",
+                            context=f"{cls}.{attr}"))
+                    else:
+                        wsorted = sorted((ln, fn, held) for _w, ln, held,
+                                         _r, fn in writes)
+                        line, func, held = wsorted[0]
+                        findings.append(Finding(
+                            "MST502", path, line, 0,
+                            f"'{attr}' is locked at every write but the "
+                            f"lockset intersection across roles "
+                            f"{_fmt_roles(write_roles)} is empty — "
+                            f"{cls}.{func}() holds "
+                            f"{_fmt_roles(set(held))}, other roles hold "
+                            "different locks (mutual exclusion in name "
+                            "only)",
+                            context=f"{cls}.{attr}"))
+                    continue  # 503 on the same attr would be noise
+
+                if attr in fcls["containers"] and attr in returns_bare \
+                        and writes:
+                    for line, rroles in returns_bare[attr]:
+                        rsets = [a[3] for a in writes] + [frozenset(rroles)]
+                        if _has_conflict(rsets, self_concurrent):
+                            findings.append(Finding(
+                                "MST503", path, line, 0,
+                                f"mutable container '{attr}' (mutated by "
+                                f"roles {_fmt_roles(write_roles)}) is "
+                                f"returned bare from {cls}'s public "
+                                "surface — the caller iterates a live "
+                                "container another thread mutates; "
+                                "return a copy made under the lock",
+                                context=f"{cls}.{attr}"))
+                            break
+    return findings, verdicts

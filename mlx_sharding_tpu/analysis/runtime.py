@@ -11,13 +11,26 @@ exercised by a workload. ``tests/test_lock_order_dynamic.py`` drives the
 resilience-style workload under tracing and asserts the dynamic graph is
 acyclic and never reverses a static edge.
 
-This module imports only ``threading`` so production modules can depend on
-it without cycles or heavyweight imports.
+The same pattern covers shared-state races: :func:`enable_locksets` arms
+an Eraser-style :class:`LocksetRecorder`, and :func:`watch_attrs` swaps an
+instance's class for a shim whose ``__setattr__`` reports every attribute
+write together with the locks the writing thread holds (the
+``_held_stack`` the instrumented locks already maintain) and the writer's
+thread *role* (``analysis.thread_roles`` maps thread names — the registry
+the static MST50x pass propagates). ``tests/test_lockset_dynamic.py``
+drives real workloads under it and asserts the dynamic observations never
+contradict the static per-attribute race verdicts.
+
+This module imports only ``threading`` and ``collections`` at module
+level so production modules can depend on it without cycles or
+heavyweight imports; the role registry is imported lazily when a test
+arms the lockset recorder.
 """
 
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Optional
 
 _TRACE: Optional["LockOrderRecorder"] = None
@@ -143,6 +156,122 @@ def disable_tracing():
     _TRACE = None
 
 
+# --------------------------------------------------- dynamic locksets
+_LOCKSETS: Optional["LocksetRecorder"] = None
+
+
+class LocksetRecorder:
+    """Eraser-style per-attribute candidate-lockset recorder.
+
+    For every watched write it notes the writer's thread role (via the
+    MST50x role registry), its thread ident, and the instrumented locks
+    held. Per ``Cls.attr`` it keeps the Eraser phases: accesses by the
+    first thread alone are the *exclusive* (initialization) phase and
+    refine nothing; once a second thread touches the attr it is *shared*
+    and every further write intersects the candidate lockset C(v). An
+    attr is reported racy when it was written from two roles (or twice
+    from one multi-instance role on distinct threads) and C(v) emptied —
+    the same verdict shape the static pass emits, so the two can be
+    compared key-by-key.
+    """
+
+    def __init__(self):
+        from mlx_sharding_tpu.analysis.thread_roles import (
+            CONCURRENT_ROLES,
+            role_for_thread_name,
+        )
+        self._mu = threading.Lock()
+        self._role_for = role_for_thread_name
+        self._concurrent = CONCURRENT_ROLES
+        self._attrs: dict[str, dict] = {}
+
+    def record(self, cls_name: str, attr: str, *, write: bool = True):
+        if attr.startswith("__"):
+            return
+        ident = threading.get_ident()
+        role = self._role_for(threading.current_thread().name) or "api"
+        held = frozenset(_held_stack())
+        key = f"{cls_name}.{attr}"
+        with self._mu:
+            st = self._attrs.get(key)
+            if st is None:
+                st = self._attrs[key] = {
+                    "first": ident, "shared": False,
+                    "roles": set(), "writers": set(), "lockset": None,
+                }
+            st["roles"].add(role)
+            if ident != st["first"]:
+                st["shared"] = True
+            if write:
+                st["writers"].add((role, ident))
+                if st["shared"]:
+                    st["lockset"] = (held if st["lockset"] is None
+                                     else st["lockset"] & held)
+
+    def observations(self) -> dict:
+        """``Cls.attr`` -> {roles, lockset, racy} for every shared attr."""
+        out = {}
+        with self._mu:
+            for key, st in self._attrs.items():
+                if not st["shared"]:
+                    continue
+                wroles = {r for r, _ in st["writers"]}
+                multi = len(wroles) >= 2 or any(
+                    r in self._concurrent and sum(
+                        1 for wr, _ in st["writers"] if wr == r) >= 2
+                    for r in wroles)
+                lockset = st["lockset"] or frozenset()
+                out[key] = {
+                    "roles": sorted(st["roles"]),
+                    "lockset": sorted(lockset),
+                    "racy": bool(multi and not lockset and st["writers"]),
+                }
+        return out
+
+    def racy(self) -> dict:
+        return {k: v for k, v in self.observations().items() if v["racy"]}
+
+
+# dynamic-subclass cache: base class -> watching shim class
+_WATCHED: dict = {}
+
+
+def watch_attrs(obj):
+    """Swap ``obj``'s class for a shim reporting attribute writes to the
+    lockset recorder. A no-op (returns ``obj`` unchanged) when no
+    recorder is armed, so call sites can wrap unconditionally."""
+    if _LOCKSETS is None:
+        return obj
+    base = type(obj)
+    sub = _WATCHED.get(base)
+    if sub is None:
+
+        def _setattr(self, name, value, _cls=base.__name__):
+            rec = _LOCKSETS
+            if rec is not None:
+                rec.record(_cls, name, write=True)
+            object.__setattr__(self, name, value)
+
+        sub = type(f"_Watched_{base.__name__}", (base,), {
+            "__slots__": (), "__setattr__": _setattr})
+        _WATCHED[base] = sub
+    obj.__class__ = sub
+    return obj
+
+
+def enable_locksets() -> LocksetRecorder:
+    """Arm the dynamic lockset recorder; returns it. Pair with
+    :func:`enable_tracing` so lock acquisitions feed the held stack."""
+    global _LOCKSETS
+    _LOCKSETS = LocksetRecorder()
+    return _LOCKSETS
+
+
+def disable_locksets():
+    global _LOCKSETS
+    _LOCKSETS = None
+
+
 # --------------------------------------------------------- leak ledger
 # Runtime cross-check for the static MST40x verifier, in the same shape
 # as make_lock/_TRACE: a module global that is None in production (the
@@ -164,20 +293,32 @@ class ResourceLedger:
     slot)``, ...). Anomalies — release of a handle that isn't live, or a
     second acquire of a live key — are recorded, never raised, so the
     workload runs to completion and the test reports everything at once.
+    The anomaly log is a bounded ring (``ANOMALY_RING``): a pathological
+    double-release loop keeps the newest entries instead of growing the
+    list without bound; ``anomalies_total`` keeps the true count (and is
+    exported as ``mst_ledger_anomalies_total``).
     """
+
+    ANOMALY_RING = 256
 
     def __init__(self):
         self._mu = threading.Lock()
         self._live: dict[tuple, dict] = {}
         self._acquired: dict[str, int] = {}
         self._released: dict[str, int] = {}
-        self._anomalies: list[str] = []
+        self._anomalies: deque = deque(maxlen=self.ANOMALY_RING)
+        self.anomalies_total = 0
+
+    def _anomaly(self, msg: str):
+        # caller holds self._mu
+        self._anomalies.append(msg)
+        self.anomalies_total += 1
 
     def note_acquire(self, kind: str, key, **meta):
         with self._mu:
             k = (kind, key)
             if k in self._live:
-                self._anomalies.append(
+                self._anomaly(
                     f"double acquire of live handle {kind}:{key!r} {meta!r}")
             self._live[k] = meta
             self._acquired[kind] = self._acquired.get(kind, 0) + 1
@@ -185,7 +326,7 @@ class ResourceLedger:
     def note_release(self, kind: str, key):
         with self._mu:
             if self._live.pop((kind, key), None) is None:
-                self._anomalies.append(
+                self._anomaly(
                     f"release of non-live handle {kind}:{key!r} "
                     "(double release, or release without acquire)")
             self._released[kind] = self._released.get(kind, 0) + 1

@@ -1,0 +1,468 @@
+"""Thread-role inference for the MST5xx cross-thread race rules.
+
+Every lock-discipline contract in the serving stack is really a statement
+about *which threads* touch a piece of state. This module names those
+threads: a **role** is a family of threads with one entry point — the
+continuous-batcher tick loop, the HTTP handler pool, the spill flusher,
+the autoscaler loop, the pod heartbeat, sim actors. The registry below is
+the single vocabulary shared by
+
+- the static half (:mod:`analysis.races`), which seeds roles at
+  ``Thread(target=..., name="...")`` / ``sim.spawn(...)`` / ``do_*``
+  handler sites and propagates them over the call graph, and
+- the dynamic half (:class:`analysis.runtime.LocksetRecorder`), which maps
+  ``threading.current_thread().name`` through the *same* table when it
+  attributes an observed access — so a dynamic observation and a static
+  verdict always speak about the same role.
+
+Per-file extraction walks each class once (statement reachability comes
+from :mod:`analysis.cfg` — code after a ``raise``/``return`` contributes
+no accesses) and summarizes, per function, the ``self._attr`` read/write
+sets with the locks held at each access, the outgoing calls the global
+pass resolves, blocking calls made under a lock, and bare
+``return self._attr`` publications. Nested ``def``s handed to
+``Thread(target=run)`` are separate functions (``"start.run"``): their
+bodies run on the spawned thread's role, not the spawner's.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from mlx_sharding_tpu.analysis import cfg as cfglib
+from mlx_sharding_tpu.analysis.core import ModuleInfo, dotted_name
+from mlx_sharding_tpu.analysis.locks import MUTATORS, _find_locks
+
+# ------------------------------------------------------------------ registry
+# thread-name literal (exact) -> role. Names are the ones the serving
+# modules pass to threading.Thread(name=...); keep in sync with the
+# README's thread-role table (the MST005 doc gate does not check this one,
+# the agreement test in tests/test_lockset_dynamic.py does better: it
+# attributes real observed accesses through it).
+ROLE_BY_THREAD_NAME = {
+    "continuous-batcher": "tick",
+    "kv-spill-flusher": "spill_flusher",
+    "mst-autoscaler": "autoscaler",
+    "mst-pod-fleet": "pod_heartbeat",
+    "mst-pod-transport": "pod_transport",
+    "mst-pod-serve": "pod_serve",
+    "mst-ctrl": "ctrl",
+    "mst-pod-ctrl": "ctrl",
+}
+
+# thread-name prefix -> role (f-string names: f"sim-{name}", "mst-drain-3")
+ROLE_PREFIXES = (
+    ("sim-", "sim_actor"),
+    ("mst-drain", "drain_worker"),
+)
+
+# roles that run MANY concurrent instances: two threads of the same role
+# still race with each other, so one access from such a role conflicts
+# with itself. ``api`` (the public surface of a thread-owning class) is
+# deliberately NOT here — external callers may or may not be concurrent,
+# and claiming they are would flag every one-shot start()/configure().
+# ``http_handler`` self-concurrency is applied per class: a
+# BaseHTTPRequestHandler subclass gets a fresh instance per request, so
+# its *own* attrs never alias; the shared objects its handlers call into
+# do.
+CONCURRENT_ROLES = frozenset({"sim_actor", "http_handler", "pod_serve",
+                              "drain_worker"})
+
+
+def role_for_thread_name(name: Optional[str]) -> Optional[str]:
+    """Role for a live/literal thread name, or None if unregistered."""
+    if not name:
+        return None
+    role = ROLE_BY_THREAD_NAME.get(name)
+    if role:
+        return role
+    for prefix, prole in ROLE_PREFIXES:
+        if name.startswith(prefix):
+            return prole
+    return None
+
+
+# ------------------------------------------------------------ per-file scan
+_CONTAINER_CALLS = {"dict", "list", "set", "defaultdict", "OrderedDict",
+                    "deque", "Counter"}
+# constructors whose instances carry their own synchronization: calling
+# .put()/.get()/.wait() on one is not a data race on the *attribute*, and
+# rebinding happens-before the consumer thread starts (Thread.start is a
+# barrier). MST501/502/503 skip attrs bound to these.
+_THREADSAFE_CALLS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                     "Event", "Condition", "Semaphore", "BoundedSemaphore",
+                     "Barrier"}
+_QUEUE_HINTS = ("queue", "inbox", "mailbox")
+_SLEEP_NAMES = {"sleep", "virtual_sleep"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    d = dotted_name(node)
+    if d and d.startswith("self.") and d.count(".") >= 1:
+        return d.split(".")[1]
+    return None
+
+
+def _thread_name_literal(call: ast.Call) -> Optional[str]:
+    """The name= literal of a Thread(...) call; f-string -> leading text."""
+    for kw in call.keywords:
+        if kw.arg != "name":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return v.value
+        if isinstance(v, ast.JoinedStr) and v.values:
+            head = v.values[0]
+            if isinstance(head, ast.Constant) and isinstance(head.value, str):
+                return head.value  # prefix is enough for ROLE_PREFIXES
+    return None
+
+
+def _is_queue_recv(recv: Optional[str]) -> bool:
+    if not recv:
+        return False
+    leaf = recv.split(".")[-1].lower()
+    return leaf.endswith("_q") or any(h in leaf for h in _QUEUE_HINTS)
+
+
+_SIMPLE_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr,
+                 ast.Return, ast.Raise, ast.Delete, ast.Assert)
+
+
+def _scoped_walk(fn: ast.AST):
+    """ast.walk that does not descend into nested function definitions."""
+    todo = list(ast.iter_child_nodes(fn))
+    while todo:
+        node = todo.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            todo.extend(ast.iter_child_nodes(node))
+
+
+def _unreachable_stmts(fn: ast.AST) -> set:
+    """ids of simple statements the cfg proves unreachable (dead code
+    after return/raise contributes no role facts)."""
+    try:
+        graph = cfglib.build_cfg(fn, may_raise=lambda node: True)
+    except (RecursionError, ValueError):
+        return set()
+    seen, todo = {graph.entry}, [graph.entry]
+    while todo:
+        for dst, _kind in graph.nodes[todo.pop()].succ:
+            if dst not in seen:
+                seen.add(dst)
+                todo.append(dst)
+    reached = {id(graph.nodes[i].stmt) for i in seen
+               if graph.nodes[i].stmt is not None}
+    return {id(n) for n in _scoped_walk(fn)
+            if isinstance(n, _SIMPLE_STMTS) and id(n) not in reached}
+
+
+class _FuncScan:
+    """One function-like body's facts, in cache-ready (JSON list) shape."""
+
+    def __init__(self, public: bool, line: int):
+        self.public = public
+        self.line = line
+        self.accesses: list = []      # [attr, write, line, [held...]]
+        self.calls: list = []         # [recv, callee, line]
+        self.locks_taken: set = set()
+        self.blocking: list = []      # [kind, line, [held...]]
+        self.returns_bare: list = []  # [attr, line]
+
+    def as_dict(self) -> dict:
+        return {
+            "public": self.public,
+            "line": self.line,
+            "accesses": self.accesses,
+            "calls": self.calls,
+            "locks_taken": sorted(self.locks_taken),
+            "blocking": self.blocking,
+            "returns_bare": self.returns_bare,
+        }
+
+
+def _scan_class(mod: ModuleInfo, cls_node: ast.ClassDef) -> tuple[dict, list]:
+    """(class facts, entries) for one class."""
+    cls = cls_node.name
+    locks = _find_locks(cls_node, cls)
+    bases = [dotted_name(b) or "" for b in cls_node.bases]
+    init_types: dict[str, str] = {}
+    containers: set[str] = set()
+    safe_attrs: set[str] = set()
+    funcs: dict[str, _FuncScan] = {}
+    entries: list[dict] = []
+
+    handler = any("RequestHandler" in b for b in bases)
+
+    def classify_assigns(method: ast.AST, is_init: bool):
+        for node in ast.walk(method):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if isinstance(value, ast.Call):
+                    fn = dotted_name(value.func)
+                    leaf = fn.split(".")[-1] if fn else ""
+                    # a lazily-built self._work = Queue() in any method
+                    # still marks the attr internally-synchronized
+                    if leaf in _THREADSAFE_CALLS:
+                        safe_attrs.add(attr)
+                if not is_init:
+                    continue
+                if isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                      ast.ListComp, ast.DictComp,
+                                      ast.SetComp)):
+                    containers.add(attr)
+                elif isinstance(value, ast.Call):
+                    fn = dotted_name(value.func)
+                    leaf = fn.split(".")[-1] if fn else ""
+                    if leaf in _CONTAINER_CALLS:
+                        containers.add(attr)
+                    elif leaf and leaf[0].isupper():
+                        init_types[attr] = leaf
+
+    def resolve_target(arg: ast.AST, enclosing: str) -> list[str]:
+        """Function keys a Thread/spawn target resolves to within this
+        class: ``self._m`` -> ['_m']; nested-def name -> ['outer.name'];
+        a lambda -> the self-methods its body calls."""
+        if isinstance(arg, ast.Lambda):
+            out = []
+            for sub in ast.walk(arg.body):
+                if isinstance(sub, ast.Call):
+                    a = _self_attr(sub.func)
+                    if a is not None:
+                        out.append(a)
+            return out
+        a = _self_attr(arg)
+        if a is not None:
+            return [a]
+        if isinstance(arg, ast.Name):
+            return [f"{enclosing}.{arg.id}"]
+        return []
+
+    def scan_function(fn_node: ast.AST, path: str, public: bool):
+        fs = funcs[path] = _FuncScan(public, fn_node.lineno)
+        nested_here: set = set()
+        dead = _unreachable_stmts(fn_node)
+
+        def scan(node: ast.AST, held: tuple):
+            if id(node) in dead:
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # separate function: runs on whatever thread calls/spawns it
+                nested_here.add(node.name)
+                scan_function(node, f"{path}.{node.name}", False)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                taken = []
+                for item in node.items:
+                    scan(item.context_expr, held)
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr in locks:
+                        taken.append(locks[attr])
+                    elif isinstance(item.context_expr, ast.Subscript):
+                        base = _self_attr(item.context_expr.value)
+                        if base is not None and base in locks:
+                            taken.append(locks[base])
+                fs.locks_taken.update(taken)
+                inner = held + tuple(lk for lk in taken if lk not in held)
+                for stmt in node.body:
+                    scan(stmt, inner)
+                return
+            if isinstance(node, ast.Return) and node.value is not None:
+                attr = _self_attr(node.value)
+                if attr is not None and attr not in locks:
+                    fs.returns_bare.append([attr, node.lineno])
+            if isinstance(node, ast.Call):
+                func = node.func
+                callee, recv = None, ""
+                if isinstance(func, ast.Attribute):
+                    callee = func.attr
+                    recv = dotted_name(func.value) or ""
+                elif isinstance(func, ast.Name):
+                    callee = func.id
+                if callee:
+                    fname = dotted_name(func) or callee
+                    if fname.split(".")[-1] == "Thread" or fname == "Thread":
+                        tname = _thread_name_literal(node)
+                        role = role_for_thread_name(tname)
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                for key in resolve_target(kw.value, path):
+                                    entries.append({
+                                        "cls": cls, "func": key,
+                                        "role": role or
+                                        f"thread:{cls}.{key}",
+                                        "line": node.lineno,
+                                    })
+                    elif callee == "spawn" and node.args:
+                        for key in resolve_target(node.args[0], path):
+                            entries.append({"cls": cls, "func": key,
+                                            "role": "sim_actor",
+                                            "line": node.lineno})
+                    if callee in MUTATORS:
+                        base = _self_attr(func.value) \
+                            if isinstance(func, ast.Attribute) else None
+                        if base is not None and base not in locks:
+                            fs.accesses.append(
+                                [base, 1, node.lineno, list(held)])
+                    if isinstance(func, ast.Attribute) or recv == "":
+                        fs.calls.append([recv, callee, node.lineno])
+                    if held:
+                        kind = None
+                        if callee == "acquire":
+                            kind = "lock acquire"
+                        elif callee in ("wait", "join"):
+                            kind = f"blocking {callee}()"
+                        elif callee == "get" and _is_queue_recv(recv):
+                            kind = "queue get"
+                        elif callee in _SLEEP_NAMES:
+                            kind = "clock sleep"
+                        if kind:
+                            fs.blocking.append([kind, node.lineno, list(held)])
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr not in locks):
+                fs.accesses.append([
+                    node.attr,
+                    1 if isinstance(node.ctx, (ast.Store, ast.Del)) else 0,
+                    node.lineno, list(held)])
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, (ast.Store, ast.Del))):
+                base = _self_attr(node.value)
+                if base is not None and base not in locks:
+                    fs.accesses.append([base, 1, node.lineno, list(held)])
+            for child in ast.iter_child_nodes(node):
+                scan(child, held)
+
+        for stmt in fn_node.body:
+            scan(stmt, ())
+
+        # bare local calls to sibling nested defs resolve right here
+        for c in fs.calls:
+            if c[0] == "" and c[1] in nested_here:
+                c[1] = f"{path}.{c[1]}"
+
+    for method in cls_node.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        classify_assigns(method, method.name == "__init__")
+        public = not method.name.startswith("_")
+        scan_function(method, method.name, public)
+        if handler and method.name.startswith("do_"):
+            entries.append({"cls": cls, "func": method.name,
+                            "role": "http_handler", "line": method.lineno})
+
+    facts = {
+        "bases": bases,
+        "locks": locks,
+        "init_types": init_types,
+        "containers": sorted(containers),
+        "safe_attrs": sorted(safe_attrs),
+        "funcs": {k: v.as_dict() for k, v in funcs.items()},
+    }
+    return facts, entries
+
+
+def module_facts(mod: ModuleInfo) -> dict:
+    """Per-file half: JSON-safe role facts for the incremental cache."""
+    classes: dict[str, dict] = {}
+    entries: list[dict] = []
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef):
+            facts, cls_entries = _scan_class(mod, node)
+            classes[node.name] = facts
+            entries.extend(cls_entries)
+    return {"entries": entries, "classes": classes}
+
+
+# ------------------------------------------------------- global propagation
+def propagate(facts_by_path: dict) -> dict:
+    """Roles per function: ``(path, cls, func) -> set of role names``.
+
+    Seeds at the thread entry points each file reported, adds the ``api``
+    role to the public surface of every thread-owning class (any caller
+    thread may enter there), then closes over the call graph: ``self.m()``
+    stays in-class, ``self.attr.m()`` follows the one-level ``__init__``
+    type inference when the attribute's class name is globally unique,
+    nested defs resolve to their dotted key.
+    """
+    # class name -> [(path, cls)] for cross-class receiver resolution
+    cls_index: dict[str, list] = {}
+    for path, facts in facts_by_path.items():
+        for cls in facts["classes"]:
+            cls_index.setdefault(cls, []).append(path)
+
+    roles: dict[tuple, set] = {}
+    work: list[tuple] = []
+
+    def add(path: str, cls: str, func: str, new_roles: set):
+        fcls = facts_by_path[path]["classes"].get(cls)
+        if fcls is None:
+            return
+        if func not in fcls["funcs"]:
+            # a target like "_serve" may be nested; try dotted suffixes
+            cands = [k for k in fcls["funcs"]
+                     if k == func or k.endswith("." + func)]
+            if len(cands) != 1:
+                return
+            func = cands[0]
+        key = (path, cls, func)
+        cur = roles.setdefault(key, set())
+        missing = new_roles - cur
+        if missing:
+            cur |= missing
+            work.append(key)
+
+    for path, facts in facts_by_path.items():
+        for e in facts["entries"]:
+            add(path, e["cls"], e["func"], {e["role"]})
+        # the public surface of a thread-owning class is reachable from
+        # arbitrary caller threads
+        owning = {e["cls"] for e in facts["entries"]}
+        for cls in owning:
+            fcls = facts["classes"].get(cls)
+            if fcls is None:
+                continue
+            for func, ff in fcls["funcs"].items():
+                if ff["public"] and not func.startswith("do_"):
+                    add(path, cls, func, {"api"})
+
+    for _ in range(100_000):  # bounded fixpoint; each pop shrinks work
+        if not work:
+            break
+        path, cls, func = work.pop()
+        key_roles = roles[(path, cls, func)]
+        fcls = facts_by_path[path]["classes"][cls]
+        ff = fcls["funcs"].get(func)
+        if ff is None:
+            continue
+        for recv, callee, _line in ff["calls"]:
+            if recv == "self":
+                add(path, cls, callee, key_roles)
+            elif recv == "" and "." in callee:
+                add(path, cls, callee, key_roles)  # nested def
+            elif recv.startswith("self.") and recv.count(".") == 1:
+                attr = recv.split(".")[1]
+                tcls = fcls["init_types"].get(attr)
+                if tcls and len(cls_index.get(tcls, ())) == 1:
+                    add(cls_index[tcls][0], tcls, callee, key_roles)
+    return roles
+
+
+def role_table() -> list[dict]:
+    """The registry as rows (for ``--format json`` and the README table)."""
+    rows = [{"thread_name": k, "role": v, "match": "exact"}
+            for k, v in sorted(ROLE_BY_THREAD_NAME.items())]
+    rows += [{"thread_name": p + "*", "role": r, "match": "prefix"}
+             for p, r in ROLE_PREFIXES]
+    return rows

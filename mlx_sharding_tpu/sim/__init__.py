@@ -17,4 +17,9 @@ same seed always produces the same event log (bit-identical digests), so
 any failure a campaign finds is a repro, not an anecdote.
 """
 
-from mlx_sharding_tpu.sim.simkit import SimRng, Simulation  # noqa: F401
+from mlx_sharding_tpu.sim.simkit import (  # noqa: F401
+    SeededScheduleExplorer,
+    SimRng,
+    Simulation,
+    ddmin_trace,
+)

@@ -34,7 +34,9 @@ Invariants (each returns a list of violation strings):
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
+import random
 from dataclasses import dataclass, field, asdict
 from typing import Optional
 
@@ -46,7 +48,11 @@ from mlx_sharding_tpu.sim.fleetsim import (
     drive_arrivals,
     token_at,
 )
-from mlx_sharding_tpu.sim.simkit import Simulation
+from mlx_sharding_tpu.sim.simkit import (
+    SeededScheduleExplorer,
+    Simulation,
+    ddmin_trace,
+)
 from mlx_sharding_tpu.testing import faults
 
 # exception name -> class, reusing the MST_FAULTS vocabulary so a repro
@@ -105,6 +111,15 @@ class Campaign:
     # dispatcher's crash-resume and the driver's cross-host failover), so
     # a mid-stream crash becomes a dropped stream the invariants catch
     resume_streams: bool = True
+    # schedule exploration (all asdict/JSON-safe): ``schedule_seed=None``
+    # keeps the classic totally-ordered scheduler — bit-identical digests
+    # per seed. A non-None seed arms a SeededScheduleExplorer; a non-empty
+    # ``schedule_trace`` replays exactly those forced divergences instead
+    # (the shrunk-repro path)
+    schedule_seed: Optional[int] = None
+    schedule_quantum: float = 0.002
+    schedule_change_points: int = 4
+    schedule_trace: tuple = ()
     invariants: tuple = ("no_dropped_streams", "token_exact",
                          "ledger_clean", "convergence", "queued_sane")
 
@@ -120,6 +135,9 @@ class CampaignResult:
     outcomes: dict       # outcome -> count
     n_requests: int
     n_events: int
+    # divergent scheduler picks this run actually made — what
+    # shrink_schedule() delta-debugs; empty when exploration was off
+    schedule_trace: tuple = ()
 
     @property
     def ok(self) -> bool:
@@ -248,7 +266,22 @@ def run_campaign(camp: Campaign) -> CampaignResult:
     """Execute one campaign in a fresh simulation and judge it. Always
     tears down (disarm + abort actors + close fleets) before returning, so
     campaigns can run back-to-back in one process."""
-    sim = Simulation(seed=camp.seed)
+    explorer = None
+    if camp.schedule_trace:
+        explorer = SeededScheduleExplorer(
+            random.Random(0), quantum=camp.schedule_quantum,
+            replay=[tuple(p) for p in camp.schedule_trace])
+    elif camp.schedule_seed is not None:
+        # derived from (campaign seed, schedule seed) so N exploration
+        # runs of one campaign draw N independent priority orders
+        h = hashlib.blake2b(
+            f"{camp.seed}:schedule:{camp.schedule_seed}".encode(),
+            digest_size=8).digest()
+        explorer = SeededScheduleExplorer(
+            random.Random(int.from_bytes(h, "big")),
+            quantum=camp.schedule_quantum,
+            change_points=camp.schedule_change_points)
+    sim = Simulation(seed=camp.seed, explorer=explorer)
     prev_ledger = mst_runtime._RESOURCES
     ledger = mst_runtime.instrument_resources()
     tracing.set_campaign(camp.name, seed=camp.seed, clock=sim.clock)
@@ -319,6 +352,9 @@ def run_campaign(camp: Campaign) -> CampaignResult:
         campaign=camp, digest=digest, violations=violations,
         outcomes=outcomes, n_requests=len(fs.requests),
         n_events=len(camp.schedule),
+        schedule_trace=(tuple(tuple(p) for p in explorer.trace)
+                        if explorer is not None and not camp.schedule_trace
+                        else camp.schedule_trace),
     )
 
 
@@ -384,6 +420,66 @@ def shrink(camp: Campaign, *, max_runs: int = 200) -> CampaignResult:
     return best
 
 
+# ------------------------------------------------------ schedule exploration
+def _with(camp: Campaign, **over) -> Campaign:
+    """A campaign copy with fields overridden, FaultEvents kept intact."""
+    cand = Campaign(**{**asdict(camp), **over, "schedule": []})
+    cand.schedule = list(camp.schedule)
+    return cand
+
+
+def explore(camp: Campaign, *, n_seeds: int = 32,
+            on_seed=None) -> Optional[CampaignResult]:
+    """Run ``camp`` under ``n_seeds`` randomized schedules.
+
+    Each seed perturbs only the scheduler's choice among events within the
+    exploration quantum (PCT priorities + change points); arrivals, fault
+    timestamps and RNG streams are untouched. On the first failing seed the
+    divergence trace is delta-debugged with :func:`shrink_schedule` and the
+    minimal replay's result is returned — its ``.campaign.schedule_trace``
+    is the repro. Returns ``None`` when every seed holds the invariants.
+    """
+    for s in range(n_seeds):
+        res = run_campaign(_with(camp, schedule_seed=s, schedule_trace=()))
+        if on_seed is not None:
+            on_seed(s, res)
+        if not res.ok:
+            return shrink_schedule(res)
+    return None
+
+
+def shrink_schedule(base: CampaignResult, *,
+                    max_runs: int = 200) -> CampaignResult:
+    """ddmin a failing exploration's divergence trace to a 1-minimal
+    forced-divergence set that still violates one of the same invariants,
+    then return the minimal deterministic replay's result."""
+    camp = base.campaign
+    target = _violated_names(base)
+    runs = [0]
+    cache: dict = {}
+
+    def fails(tr) -> bool:
+        key = tuple(tuple(p) for p in tr)
+        if key in cache:
+            return cache[key]
+        if runs[0] >= max_runs:
+            return False
+        runs[0] += 1
+        # schedule_seed cleared: an empty forced trace must mean "the
+        # default schedule", not "explore again with the same seed"
+        res = run_campaign(_with(camp, schedule_trace=key,
+                                 schedule_seed=None))
+        cache[key] = bool(_violated_names(res) & target)
+        return cache[key]
+
+    # an empty trace failing means the schedule was never the trigger
+    minimal = ([] if fails([])
+               else ddmin_trace(list(base.schedule_trace), fails))
+    return run_campaign(
+        _with(camp, schedule_seed=None,
+              schedule_trace=tuple(tuple(p) for p in minimal)))
+
+
 # -------------------------------------------------------------- repro files
 def write_repro(path: str, result: CampaignResult) -> None:
     camp = result.campaign
@@ -411,6 +507,10 @@ def load_repro(path: str) -> Campaign:
     spec = dict(doc["campaign"])
     schedule = [FaultEvent(**ev) for ev in spec.pop("schedule")]
     spec["invariants"] = tuple(spec["invariants"])
+    # pre-exploration repro files lack the schedule_* fields; JSON also
+    # flattens the trace's tuples into lists — normalize both
+    spec["schedule_trace"] = tuple(
+        tuple(p) for p in spec.get("schedule_trace", ()))
     camp = Campaign(**spec)
     camp.schedule = schedule
     return camp
@@ -528,6 +628,11 @@ def main(argv=None) -> int:
                     help="replay a repro file and re-judge its invariants")
     ap.add_argument("--repro-out", metavar="PATH",
                     help="on failure, shrink and write the repro here")
+    ap.add_argument("--explore", type=int, metavar="N", default=0,
+                    help="additionally run N seeded schedule explorations "
+                         "(PCT-randomized event interleavings); a failing "
+                         "seed is ddmin-shrunk to a minimal forced-"
+                         "divergence repro")
     args = ap.parse_args(argv)
 
     if args.replay:
@@ -549,6 +654,19 @@ def main(argv=None) -> int:
           f"events={res.n_events}")
     print(f"  requests={res.n_requests} outcomes={res.outcomes}")
     print(f"  digest={res.digest}")
+    if res.ok and args.explore > 0:
+        bad = explore(camp, n_seeds=args.explore)
+        if bad is not None:
+            print(f"  schedule exploration: seed "
+                  f"{bad.campaign.schedule_seed} fails; shrunk to "
+                  f"{len(bad.campaign.schedule_trace)} divergence(s)")
+            if args.repro_out:
+                write_repro(args.repro_out, bad)
+                print(f"  repro written to {args.repro_out}")
+            for v in bad.violations:
+                print(f"    {v}")
+            return 1
+        print(f"  schedule exploration: {args.explore} seed(s) green")
     if res.ok:
         print("  invariants: all green")
         return 0

@@ -67,6 +67,114 @@ class SimRng:
         return r
 
 
+class SeededScheduleExplorer:
+    """PCT-style randomized schedule exploration for :class:`Simulation`.
+
+    The default scheduler is totally ordered: the heap pops ``(t, seq)``
+    minima, so one seed is one interleaving. Real fleets are not so
+    polite — two events a few hundred microseconds apart can land in
+    either order. The explorer widens the pop: among the events within
+    ``quantum`` virtual seconds of the heap head (at most ``window`` of
+    them), it picks by per-entity *priority* (an actor name, or the loop
+    for scheduled calls), drawn once per entity from the seeded ``rng``.
+    At a handful of **change points** (the PCT trick) the current
+    top-priority entity is demoted below everyone, forcing the schedules
+    a static priority order can never produce. ``VirtualClock.set``
+    ignores backward jumps, so within-quantum reordering keeps time
+    monotonic.
+
+    Every pick that diverges from the default order is appended to
+    ``trace`` as ``(step, rank)`` — rank into the sorted candidate list.
+    Passing a trace back via ``replay=`` forces exactly those divergences
+    (every other step takes the default event), which makes a failing
+    exploration a deterministic repro and gives :func:`ddmin_trace`
+    something to shrink: the minimal divergence set that still fails IS
+    the race, usually 1–3 choice points.
+    """
+
+    #: change points are drawn over this many steps — enough for every
+    #: sim in the tree; later steps just keep the final priority order
+    HORIZON = 4096
+
+    def __init__(self, rng: random.Random, *, quantum: float = 0.002,
+                 change_points: int = 4, window: int = 8,
+                 replay: Optional[list] = None):
+        self.quantum = float(quantum)
+        self.window = int(window)
+        self.steps = 0
+        self.trace: list = []  # [(step, rank)] divergent picks
+        self._rng = rng
+        self._replay = (None if replay is None
+                        else {int(s): int(r) for s, r in replay})
+        self._prio: dict = {}
+        self._change_at = (frozenset() if replay is not None else frozenset(
+            rng.randrange(self.HORIZON) for _ in range(change_points)))
+
+    @staticmethod
+    def _entity(entry) -> str:
+        _t, _seq, kind, payload = entry
+        return payload.name if kind == "resume" else "loop-call"
+
+    def pick(self, heap: list):
+        """Remove and return the chosen entry; restores heap order."""
+        step, self.steps = self.steps, self.steps + 1
+        head_t = heap[0][0]
+        cands = [e for e in heapq.nsmallest(self.window, heap)
+                 if e[0] <= head_t + self.quantum]
+        rank = 0
+        if len(cands) > 1:
+            if self._replay is not None:
+                rank = min(self._replay.get(step, 0), len(cands) - 1)
+            else:
+                for e in cands:
+                    ent = self._entity(e)
+                    if ent not in self._prio:
+                        self._prio[ent] = self._rng.random()
+                if step in self._change_at:
+                    top = max((self._entity(e) for e in cands),
+                              key=self._prio.__getitem__)
+                    self._prio[top] = -self._rng.random()
+                rank = max(range(len(cands)), key=lambda i: (
+                    self._prio[self._entity(cands[i])], -i))
+                if rank != 0:
+                    self.trace.append((step, rank))
+        if rank == 0:
+            return heapq.heappop(heap)
+        chosen = cands[rank]
+        heap.remove(chosen)
+        heapq.heapify(heap)
+        return chosen
+
+
+def ddmin_trace(trace: list, fails) -> list:
+    """Delta-debug a divergence trace to a 1-minimal failing subset.
+
+    ``fails(subset) -> bool`` must re-run the scenario from scratch with
+    only those forced divergences (determinism makes each probe sound).
+    The same ddmin loop the chaos shrinker uses on fault schedules, small
+    enough to share with schedule traces."""
+    items = list(trace)
+    if not fails(items):
+        return items
+    n = 2
+    while len(items) >= 2:
+        chunk = max(1, len(items) // n)
+        reduced = None
+        for i in range(0, len(items), chunk):
+            cand = items[:i] + items[i + chunk:]
+            if fails(cand):
+                reduced = cand
+                break
+        if reduced is not None:
+            items = reduced
+            n = max(2, n - 1)
+        elif n >= len(items):
+            break
+        else:
+            n = min(len(items), n * 2)
+    return items
+
+
 class _Actor:
     __slots__ = ("name", "go", "yielded", "done", "exc", "thread")
 
@@ -90,10 +198,15 @@ class Simulation:
     called there, it advances virtual time by pumping due events inline, so
     in-flight streams genuinely unwind under the waiter."""
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0,
+                 explorer: Optional[SeededScheduleExplorer] = None):
         self.clock = VirtualClock()
         self.rng = SimRng(seed)
         self.seed = int(seed)
+        # schedule exploration is strictly opt-in: with explorer=None the
+        # pop below is the plain heap minimum and the interleaving is the
+        # same pure function of the seed it always was
+        self.explorer = explorer
         self._heap: list = []   # (t, seq, kind, payload)
         self._seq = 0
         self._log: list = []
@@ -171,6 +284,7 @@ class Simulation:
                 actor.exc = e
             finally:
                 actor.done = True
+                # mst: allow(MST501): loop parks on yielded while an actor runs
                 self._actors.pop(threading.get_ident(), None)
                 actor.yielded.set()
 
@@ -224,7 +338,10 @@ class Simulation:
 
     # ------------------------------------------------------------------ loop
     def _step(self):
-        t, _, kind, payload = heapq.heappop(self._heap)
+        if self.explorer is not None and len(self._heap) > 1:
+            t, _, kind, payload = self.explorer.pick(self._heap)
+        else:
+            t, _, kind, payload = heapq.heappop(self._heap)
         self.clock.set(t)
         if kind == "call":
             payload()

@@ -300,6 +300,21 @@ class ServingMetrics:
                     lines.append("mst_faults_armed 0")
             except Exception:  # noqa: BLE001 — scrape must not 500
                 del lines[fmark:]
+            # leak-ledger health: the bounded anomaly ring keeps only the
+            # newest entries, this counter keeps the true total (zero when
+            # no ledger is instrumented — the production steady state)
+            lmark = len(lines)
+            try:
+                from mlx_sharding_tpu.analysis import runtime as _rt
+
+                led = _rt._RESOURCES
+                lines += [
+                    "# TYPE mst_ledger_anomalies_total counter",
+                    "mst_ledger_anomalies_total "
+                    f"{led.anomalies_total if led is not None else 0}",
+                ]
+            except Exception:  # noqa: BLE001 — scrape must not 500
+                del lines[lmark:]
             # any engine accessor can die mid-scrape (replica torn
             # down, pool closing); drop the whole engine section
             # cleanly rather than 500 or emit a half-rendered family
@@ -898,6 +913,9 @@ _HELP = {
         "Currently armed fault-injection sites (should be 0 in prod).",
     "mst_faults_malformed_total":
         "MST_FAULTS entries dropped as malformed at parse time.",
+    "mst_ledger_anomalies_total":
+        "Resource-ledger anomalies (double acquire/release); the log is "
+        "a bounded ring but this counter never loses an increment.",
 }
 
 

@@ -3,13 +3,20 @@
 # the static-analysis fixture corpus and runtime leak-ledger tests.
 #
 #   scripts/check.sh            # everything (warm mstcheck run is ~10ms)
+#   scripts/check.sh --quick    # sub-minute tier: lint + warm --changed
+#                               # scan + fixture gate + chaos smoke
 #   scripts/check.sh --no-cache # force a full (cold) self-scan
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+QUICK=0
 MSTCHECK_ARGS=()
 for arg in "$@"; do
-    MSTCHECK_ARGS+=("$arg")
+    if [ "$arg" = "--quick" ]; then
+        QUICK=1
+    else
+        MSTCHECK_ARGS+=("$arg")
+    fi
 done
 
 # 1. ruff — optional: the container image does not ship it, and the gate
@@ -23,7 +30,12 @@ fi
 
 # 2. incremental self-scan: per-file results cached by content hash in
 #    .mstcheck-cache.json, invalidated wholesale when the checker changes.
+#    --quick narrows the parse to stale files only; global passes still
+#    see the whole tree through cached facts.
 echo "== mstcheck (incremental self-scan) =="
+if [ "$QUICK" = 1 ]; then
+    MSTCHECK_ARGS+=(--changed)
+fi
 python -m mlx_sharding_tpu.analysis mlx_sharding_tpu/ "${MSTCHECK_ARGS[@]+"${MSTCHECK_ARGS[@]}"}"
 
 # 3. fixture gate + leak ledger: every rule fires on its known-bad
@@ -38,4 +50,8 @@ env JAX_PLATFORMS=cpu python -m pytest \
 echo "== chaos campaign smoke =="
 env JAX_PLATFORMS=cpu python -m mlx_sharding_tpu.sim.chaos --smoke
 
-echo "check.sh: all gates passed"
+if [ "$QUICK" = 1 ]; then
+    echo "check.sh: quick gates passed (<60s tier)"
+else
+    echo "check.sh: all gates passed"
+fi

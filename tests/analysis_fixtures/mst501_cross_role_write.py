@@ -1,0 +1,21 @@
+"""MST501: attribute written from two thread roles with no lock at all."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.level = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="continuous-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def set_level(self, n):
+        self.level = n
+
+    def _loop(self):
+        while True:
+            self.level += 1

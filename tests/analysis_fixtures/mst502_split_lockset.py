@@ -1,0 +1,24 @@
+"""MST502: every write locked, but the role locksets never intersect."""
+import threading
+
+
+class Gauge:
+    def __init__(self):
+        self._fast_lock = threading.Lock()
+        self._slow_lock = threading.Lock()
+        self.total = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="continuous-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def add(self, n):
+        with self._fast_lock:
+            self.total += n
+
+    def _loop(self):
+        with self._slow_lock:
+            self.total += 1

@@ -1,0 +1,20 @@
+"""MST503: a live dict mutated by the tick thread, returned bare."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._counts = {}
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="continuous-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def counts(self):
+        return self._counts
+
+    def _loop(self):
+        self._counts["ticks"] = self._counts.get("ticks", 0) + 1

@@ -1,0 +1,25 @@
+"""MST504: queue get while holding the lock the tick loop also takes."""
+import queue
+import threading
+
+
+class Feeder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._work_q = queue.Queue()
+        self._thread = None
+        self.pending = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="continuous-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def take(self):
+        with self._lock:
+            return self._work_q.get()
+
+    def _loop(self):
+        with self._lock:
+            self.pending += 1

@@ -98,8 +98,31 @@ def test_ledger_records_anomalies_without_raising(ledger):
     ledger.note_release("tier.block", (1, "d"))
     ledger.note_release("tier.block", (1, "d"))   # double release
     assert len(ledger.anomalies()) == 2
+    assert ledger.anomalies_total == 2
     with pytest.raises(AssertionError, match="double release"):
         ledger.assert_clean()
+
+
+def test_anomaly_log_is_a_bounded_ring(ledger):
+    n = ledger.ANOMALY_RING + 50
+    for i in range(n):
+        ledger.note_release("tier.block", ("ghost", i))  # never acquired
+    # the ring keeps only the newest ANOMALY_RING entries...
+    msgs = ledger.anomalies()
+    assert len(msgs) == ledger.ANOMALY_RING
+    assert str(("ghost", n - 1)) in msgs[-1]
+    assert not any(str(("ghost", 0)) in m for m in msgs)
+    # ...but the counter never forgets an increment
+    assert ledger.anomalies_total == n
+
+
+def test_ledger_anomalies_metric_in_exposition(ledger):
+    from mlx_sharding_tpu.utils.observability import ServingMetrics
+
+    ledger.note_release("tier.block", ("ghost", 0))
+    text = ServingMetrics().render()
+    assert "# TYPE mst_ledger_anomalies_total counter" in text
+    assert "mst_ledger_anomalies_total 1" in text
 
 
 def test_note_reset_filters_by_owner(ledger):

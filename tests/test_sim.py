@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 
 import pytest
 
@@ -36,7 +37,12 @@ from mlx_sharding_tpu.sim.fleetsim import (
     drive_arrivals,
     token_at,
 )
-from mlx_sharding_tpu.sim.simkit import SimRng, Simulation
+from mlx_sharding_tpu.sim.simkit import (
+    SeededScheduleExplorer,
+    SimRng,
+    Simulation,
+    ddmin_trace,
+)
 from mlx_sharding_tpu.utils.clock import MONOTONIC, Clock, VirtualClock
 from tests.helpers import hard_timeout
 
@@ -115,6 +121,63 @@ def test_sim_digest_replays_bit_identically():
 
     assert build(7) == build(7)
     assert build(7) != build(8)
+
+
+# ------------------------------------------------- schedule exploration
+def _racy_counter(explorer=None):
+    """Two actors doing a read-modify-write the default schedule
+    happens to serialize; a reordering inside the quantum loses an
+    update.  Returns the final count (6 when race-free, 5 when lost)."""
+    sim = Simulation(seed=0, explorer=explorer)
+    state = {"n": 0}
+
+    def worker(off):
+        sim.sleep(off)
+        for _ in range(3):
+            v = state["n"]
+            sim.sleep(0.0005)
+            state["n"] = v + 1
+            sim.sleep(0.0015)
+
+    for i in range(2):
+        sim.spawn(lambda off=i * 0.001: worker(off), name=f"w{i}")
+    sim.run()
+    n = state["n"]
+    trace = list(explorer.trace) if explorer is not None else []
+    sim.close()
+    return n, trace
+
+
+@hard_timeout(60)
+def test_explorer_catches_and_shrinks_seeded_race():
+    # the default schedule masks the race, deterministically
+    assert _racy_counter()[0] == 6
+    assert _racy_counter()[0] == 6
+
+    caught = None
+    for seed in range(32):
+        n, trace = _racy_counter(SeededScheduleExplorer(random.Random(seed)))
+        if n != 6:
+            caught = (seed, trace)
+            break
+    assert caught is not None, "no explorer seed exposed the lost update"
+    seed, trace = caught
+    assert trace, "a diverging schedule must leave a non-empty trace"
+
+    # replay of the full trace reproduces the failure exactly
+    def fails(t):
+        ex = SeededScheduleExplorer(random.Random(0), replay=list(t))
+        return _racy_counter(ex)[0] != 6
+
+    assert fails(trace)
+
+    # ddmin shrinks to a handful of forced picks, still failing
+    minimal = ddmin_trace(trace, fails)
+    assert len(minimal) <= 3
+    assert fails(minimal)
+
+    # and the empty trace (pure default schedule) stays green
+    assert not fails([])
 
 
 # --------------------------------------------------------------- fleetsim

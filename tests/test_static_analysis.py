@@ -60,6 +60,10 @@ EXPECTED = {
     "mst402_double_release.py": ("MST402", 8, 4),
     "mst403_release_escaped.py": ("MST403", 7, 4),
     "mst404_early_return_leak.py": ("MST404", 7, 0),
+    "mst501_cross_role_write.py": ("MST501", 17, 0),
+    "mst502_split_lockset.py": ("MST502", 20, 0),
+    "mst503_bare_container.py": ("MST503", 17, 0),
+    "mst504_blocking_under_tick_lock.py": ("MST504", 21, 0),
 }
 
 
