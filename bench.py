@@ -1598,6 +1598,268 @@ def measure_pod_fleet(model, params, label: str) -> dict:
     return res
 
 
+def measure_pod_prefix_federation(model, params, label: str) -> dict:
+    """Pod-federated prefix store over a 2-host loopback fabric: each hot
+    system prompt is prefilled exactly once POD-WIDE. Host A serves the
+    hot heads (demoting each prefix to its host tier), inventories gossip
+    on the heartbeat, then host B serves the continuation mix — its local
+    miss consults the pod view and pulls the owner's blob over the fabric
+    (one counted fetch per unique prefix), importing it through the normal
+    store path so only suffix tokens prefill. Reports host-B p50/p99 TTFT,
+    fetch count/bytes, and tokens reused vs executed. A second leg arms
+    the ``pod.prefix_fetch`` fault site: every consult fails, every stream
+    must still complete off the plain-prefill path — zero drops."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.pod import LoopbackHub, PodFleet
+    from mlx_sharding_tpu.prefix_store import PrefixStore
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+    from mlx_sharding_tpu.testing import faults
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return dict(label=label, skipped="needs 2 devices")
+    page = 128
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(29)
+
+    def toks(n: int) -> list:
+        return [int(x) for x in rng.integers(1, vocab - 64, n)]
+
+    hot_heads = [toks(2 * page) for _ in range(2)]
+    suffixes = [toks(page // 2) for _ in range(8)]
+
+    def mk_host(i: int):
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1, devices=devices[i:i + 1]),
+            microbatches=2, max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16,
+            prefill_chunk=128, pool_pages=24, page_size=page,
+        )
+        store = PrefixStore()
+        return ContinuousBatcher(eng, decode_block=8,
+                                 prefix_store=store), store
+
+    b_a, s_a = mk_host(0)
+    b_b, s_b = mk_host(1)
+    hub = LoopbackHub()
+    f_a = PodFleet(0, hub.register(0), b_a, prefix_store=s_a)
+    f_b = PodFleet(1, hub.register(1), b_b, prefix_store=s_b)
+    try:
+        # one prefill per unique prefix pod-wide: the hot heads run ONLY
+        # on host A; stream completion demotes each prefix into A's host
+        # tier, whose inventory rides the next heartbeat
+        for head in hot_heads:
+            for _ in b_a.generate_step(head + toks(8), max_tokens=8):
+                pass
+        f_a.tick()
+        f_b.tick()
+        a_stats = s_a.stats()
+        ttfts = []
+        dropped = 0
+        for i, suf in enumerate(suffixes):
+            prompt = hot_heads[i % len(hot_heads)] + suf
+            t0 = _time.perf_counter()
+            first = None
+            for _tok, _ in b_b.generate_step(prompt, max_tokens=16):
+                if first is None:
+                    first = _time.perf_counter() - t0
+            if first is None:
+                dropped += 1
+            else:
+                ttfts.append(first * 1e3)
+        ttfts.sort()
+        fed = f_b.prefix.stats()
+        st_b = s_b.stats()
+        total_b = sum(len(hot_heads[i % len(hot_heads)]) + len(s)
+                      for i, s in enumerate(suffixes))
+        steady = dict(
+            completed=len(ttfts), dropped_streams=dropped,
+            ttft_p50_ms=round(ttfts[len(ttfts) // 2], 1) if ttfts else None,
+            ttft_p99_ms=round(ttfts[-1], 1) if ttfts else None,
+            fetches=fed["fetches"], fetch_bytes=fed["fetch_bytes"],
+            fetch_ms_p50=fed["fetch_ms_p50"], fallbacks=fed["fallbacks"],
+            prompt_tokens=total_b,
+            tokens_reused=int(st_b.get("tokens_reused", 0)),
+            prefill_tokens_executed=(
+                total_b - int(st_b.get("tokens_reused", 0))),
+            host_a_demotions=int(a_stats.get("demotions", 0)),
+        )
+        # fault leg: a fresh head lives only on A; every consult from B
+        # faults at pod.prefix_fetch and must degrade to plain prefill
+        extra = toks(2 * page)
+        for _ in b_a.generate_step(extra + toks(8), max_tokens=8):
+            pass
+        f_a.tick()
+        f_b.tick()
+        faults.arm("pod.prefix_fetch", exc=faults.FaultError, times=8)
+        try:
+            n = 0
+            for _tok, _ in b_b.generate_step(extra + toks(16),
+                                             max_tokens=8):
+                n += 1
+        finally:
+            faults.disarm()
+        fed2 = f_b.prefix.stats()
+        fault_leg = dict(
+            tokens=n, dropped_streams=int(n == 0),
+            fetch_faults=int(fed2["fallbacks"].get("fetch_fault", 0)),
+        )
+    finally:
+        faults.disarm()
+        f_a.close(close_local=False)
+        f_b.close(close_local=False)
+        b_a.close()
+        b_b.close()
+    res = dict(label=label, steady=steady, fault_leg=fault_leg)
+    log(f"[{label}] pod prefix federation: {steady['fetches']} fetch(es) "
+        f"{steady['fetch_bytes']}B for {len(hot_heads)} hot prefix(es); "
+        f"host-B TTFT p50={steady['ttft_p50_ms']}ms "
+        f"p99={steady['ttft_p99_ms']}ms reused={steady['tokens_reused']} "
+        f"tok; fault leg: {fault_leg['fetch_faults']} fault(s), "
+        f"dropped={fault_leg['dropped_streams']}")
+    return res
+
+
+def measure_kv_share_capacity(model, params, label: str) -> dict:
+    """KVSharer layer-wise KV sharing (arXiv:2410.18517) at fixed pool
+    bytes: calibrate a share map on the fly (most-dissimilar layer pairs
+    merged), then drive the same idle-session mix as the capacity
+    frontier through three pools holding (no more than) the SAME bytes —
+    unshared bf16, shared bf16 (L/G x the pages), and shared int8 +
+    cold-spill (the composed frontier). Peak live sessions is read from
+    public gauges only; the shared pool's byte budget is verified
+    directly off the engine's pool leaves."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.cli.kv_share_calibrate import calibrate_model
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+    cfg = model.config
+    n_layers = cfg.num_hidden_layers
+    d = cfg.head_dim
+    vocab = cfg.vocab_size
+    rng = np.random.default_rng(31)
+    calib = [
+        [int(x) for x in rng.integers(1, vocab - 64, 24)] for _ in range(3)
+    ]
+    share = calibrate_model(model, params, calib,
+                            num_share=max(1, n_layers // 2),
+                            cache_dtype=jnp.bfloat16)
+    groups = share.num_groups
+    page_size = 128
+    pages_base = 4
+    pages_shared = pages_base * n_layers // groups
+    pages_int8_shared = int(pages_shared * (2 * d) / (d + 4))
+    sessions = 12
+    prompts = [
+        [int(x) for x in rng.integers(1, vocab - 64, 8)]
+        for _ in range(sessions)
+    ]
+    spill_kw = dict(spill_bytes=256 << 20, spill_cold_after=2,
+                    kv_prefetch="on")
+
+    def _join_all(threads, budget_s):
+        end = time.monotonic() + budget_s
+        for t in threads:
+            t.join(timeout=max(0.0, end - time.monotonic()))
+
+    def run(kv_dtype: str, pool_pages: int, share_map, spill: bool) -> dict:
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1), microbatches=8,
+            max_seq=MAX_SEQ, cache_dtype=jnp.bfloat16, prefill_chunk=128,
+            pool_pages=pool_pages, page_size=page_size, kv_dtype=kv_dtype,
+            kv_share_map=share_map,
+        )
+        batcher = ContinuousBatcher(
+            eng, decode_block=8, **(spill_kw if spill else {})
+        )
+        stall = threading.Event()
+
+        def consume(p):
+            gen = batcher.generate_step(p, max_tokens=page_size - 16)
+            try:
+                next(gen)
+                stall.wait()
+            finally:
+                gen.close()
+
+        threads = [
+            threading.Thread(target=consume, args=(p,), daemon=True)
+            for p in prompts
+        ]
+        try:
+            for _ in batcher.generate_step(prompts[0], max_tokens=8):
+                pass  # compile
+            for t in threads:
+                t.start()
+            peak = 0
+            last_gain = time.monotonic()
+            deadline = last_gain + 30.0
+            while time.monotonic() < deadline:
+                st = batcher.spill_stats() or {}
+                _, in_use, _ = batcher.page_stats()
+                live = in_use + int(st.get("parked", 0))
+                if live > peak:
+                    peak, last_gain = live, time.monotonic()
+                if peak >= sessions or time.monotonic() - last_gain > 3.0:
+                    break
+                time.sleep(0.002)
+            pool_bytes = sum(
+                leaf.nbytes for leaf in
+                jax.tree.leaves((batcher.cache.k, batcher.cache.v))
+            )
+            ss = eng.kv_share_stats()
+            stall.set()
+            _join_all(threads, 5.0)
+        finally:
+            batcher.close()
+        _join_all(threads, 30.0)
+        return dict(
+            kv_dtype=kv_dtype, pool_pages=pool_pages,
+            pool_bytes=int(pool_bytes), peak_live_sessions=peak,
+            share_groups=(ss or {}).get("groups"),
+            share_bytes_saved=(ss or {}).get("bytes_saved", 0),
+        )
+
+    base = run("bf16", pages_base, None, False)
+    shared = run("bf16", pages_shared, share, False)
+    composed = run("int8", pages_int8_shared, share, True)
+    res = dict(
+        label=label, layers=n_layers, share_groups=groups,
+        share_hash=share.share_hash,
+        pool_bytes_saved_frac=round(1 - groups / n_layers, 3),
+        base_bf16=base, shared_bf16=shared,
+        shared_int8_cold_spill=composed,
+        shared_vs_base=round(
+            shared["peak_live_sessions"]
+            / max(base["peak_live_sessions"], 1), 2),
+        composed_vs_base=round(
+            composed["peak_live_sessions"]
+            / max(base["peak_live_sessions"], 1), 2),
+        equal_bytes=shared["pool_bytes"] <= base["pool_bytes"],
+    )
+    log(f"[{label}] kv-share capacity: {n_layers} layers -> {groups} "
+        f"groups ({res['pool_bytes_saved_frac']:.0%} pool bytes saved); "
+        f"live sessions base={base['peak_live_sessions']} "
+        f"shared={shared['peak_live_sessions']} "
+        f"shared+int8+spill={composed['peak_live_sessions']} "
+        f"({res['composed_vs_base']}x vs base, equal bytes: "
+        f"{res['equal_bytes']})")
+    return res
+
+
 def measure_paged_ragged_vs_gather(model, params, label: str) -> dict:
     """The ragged paged-attention A/B (ISSUE 1 tentpole): mixed-length
     continuous batching decode through the same page pool on both paths.
@@ -2591,6 +2853,17 @@ def main() -> int:
                 detail["pod_fleet_cpu"] = dict(error=repr(e)[:300])
                 log(f"[pod_fleet_cpu] FAILED: {e!r}")
             try:
+                detail["pod_prefix_federation_cpu"] = (
+                    measure_pod_prefix_federation(
+                        m2, p2, "pod_prefix_federation_cpu"
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                detail["pod_prefix_federation_cpu"] = dict(
+                    error=repr(e)[:300]
+                )
+                log(f"[pod_prefix_federation_cpu] FAILED: {e!r}")
+            try:
                 detail["trace_overhead_cpu"] = measure_trace_overhead(
                     m2, p2, "trace_overhead_cpu"
                 )
@@ -2664,6 +2937,19 @@ def main() -> int:
                         error=repr(e)[:300]
                     )
                     log(f"[prefix_reuse_ttft_cpu] FAILED: {e!r}")
+                # layer-wise KV sharing composes with the frontier's
+                # head_dim-64 variant: the int8 leg's page math needs it
+                try:
+                    detail["kv_share_capacity_cpu"] = (
+                        measure_kv_share_capacity(
+                            m3, p3, "kv_share_capacity_cpu"
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001
+                    detail["kv_share_capacity_cpu"] = dict(
+                        error=repr(e)[:300]
+                    )
+                    log(f"[kv_share_capacity_cpu] FAILED: {e!r}")
 
     if not cpu_fallback:
         n_params = param_count(cfg_dict)
@@ -2886,6 +3172,20 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["pod_fleet"] = dict(error=repr(e)[:300])
             log(f"[pod_fleet] FAILED: {e!r}")
+        try:
+            detail["pod_prefix_federation"] = measure_pod_prefix_federation(
+                model, params, "pod_prefix_federation"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["pod_prefix_federation"] = dict(error=repr(e)[:300])
+            log(f"[pod_prefix_federation] FAILED: {e!r}")
+        try:
+            detail["kv_share_capacity"] = measure_kv_share_capacity(
+                model, params, "kv_share_capacity"
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["kv_share_capacity"] = dict(error=repr(e)[:300])
+            log(f"[kv_share_capacity] FAILED: {e!r}")
 
         # HEADLINE (BASELINE.json primary config): DeepSeek-Coder-V2-Lite at
         # its real architecture and scale — 27 layers, 64-expert MoE + 2

@@ -55,6 +55,10 @@ KNOWN_FAULT_SITES = {
     # point — must degrade to the single-host plan (serve-in-place or
     # blockless re-prefill), never a dropped stream
     "pod.handoff",
+    # pod prefix federation (pod.py): the cross-host prefix blob fetch on
+    # a local store miss — must degrade to plain prefill, counted, never
+    # a wrong or dropped stream
+    "pod.prefix_fetch",
     # speculative decoding (scheduler.py / speculative.py): before each
     # round's draft proposals — a faulted draft source must degrade that
     # tick to plain decode, counted, never a wrong or dropped stream
@@ -72,7 +76,7 @@ REQUIRED_FAULT_SITES = {
     "kv_transfer.py": ("cache.export",),
     "disagg.py": ("disagg.handoff",),
     "prefix_store.py": ("cache.prefix_lookup",),
-    "pod.py": ("pod.handoff",),
+    "pod.py": ("pod.handoff", "pod.prefix_fetch"),
 }
 
 

@@ -128,6 +128,21 @@
   consolidated harvest (``_harvest_spec``) already returns — exactly the
   per-round stall adaptive speculation exists to amortize away. An
   MST102 suppression nearby does NOT cover this rule.
+- **MST115 prefix-federation-in-tick** — a pod prefix-federation call
+  (``<...federation/prefix...>.fetch(...)`` / ``.local_info(...)``,
+  ``host_inventory(...)``) or share-map calibration I/O
+  (``calibrate_share_map`` / ``rank_layer_pairs`` /
+  ``layer_kv_signatures`` / ``load_share_map``) inside a tick-hot
+  function. A federation fetch blocks on a cross-host blob transfer
+  bounded only by its timeout, and an inventory walk serializes against
+  the store's flusher lock — either inline in the tick stalls every live
+  slot's decode behind a peer. Calibration is worse still: dense
+  prefills plus whole-KV host marshalling. The discipline: the
+  (non-hot) waiting-queue pass ``_pod_fetch_waiting`` starts the fetch
+  on its own daemon thread and admission only reads the per-request
+  flag; calibration is OFFLINE (``cli/kv_share_calibrate.py``) and
+  serving loads the saved artifact once at startup. An intentional
+  inline consult carries its own ``# mst: allow(MST115): …``.
 - **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
   timeout arithmetic (an expression whose identifiers mention deadline /
   timeout / expiry / until / budget / ttft / retry_after / lease). The wall
@@ -208,6 +223,23 @@ MIGRATION_CALLS = {"export_block", "import_block"}
 # timeout (multihost.py ControlPlane.exchange / PodControlPlane.pod_exchange,
 # and the heartbeat wrappers over them)
 CONTROL_PLANE_CALLS = {"exchange", "heartbeat", "pod_exchange"}
+
+# the pod prefix-federation surface MST115 keeps out of tick-hot
+# functions: fetch() blocks on a cross-host blob transfer (pod.py
+# PodPrefixFederation), local_info()/host_inventory() walk the store's
+# host tier under its lock. fetch/local_info only fire through a
+# federation-ish receiver (dotted name mentioning "federation"/"prefix");
+# host_inventory is distinctive enough to fire anywhere
+PREFIX_FEDERATION_CALLS = {"fetch", "local_info"}
+PREFIX_FEDERATION_HINTS = ("federation", "prefix")
+PREFIX_INVENTORY_CALLS = {"host_inventory"}
+
+# share-map calibration I/O MST115 also forbids in tick-hot functions:
+# each runs dense prefills and/or whole-KV host marshalling (kv_share.py)
+# — calibration is offline (cli/kv_share_calibrate.py); serving loads the
+# saved artifact once at startup
+SHARE_CALIBRATION_CALLS = {"calibrate_share_map", "rank_layer_pairs",
+                           "layer_kv_signatures", "load_share_map"}
 
 # host→device upload calls MST109 polices in tick-hot functions when their
 # argument is a spilled block's page payload (the demand-paged resume)
@@ -624,6 +656,52 @@ def _check_control_plane_in_tick(mod: ModuleInfo) -> list[Finding]:
                 "only by the plane timeout — run it on the pod transport "
                 "thread and let the tick read the gossiped snapshot",
                 context=qualname_for_line(mod.tree, node.lineno),
+            ))
+    return findings
+
+
+def _check_prefix_federation_in_tick(mod: ModuleInfo) -> list[Finding]:
+    """MST115: a pod prefix-federation consult or share-map calibration
+    I/O inside a tick-hot function. ``federation.fetch()`` blocks on a
+    cross-host blob transfer bounded only by its timeout; an inventory
+    walk serializes against the store's flusher lock; calibration runs
+    dense prefills plus whole-KV host marshalling. The discipline: the
+    non-hot waiting-queue pass (``_pod_fetch_waiting``) starts the fetch
+    on its own daemon thread and admission only reads the per-request
+    flag; calibration is offline (``cli/kv_share_calibrate.py``)."""
+    findings = []
+    for fn in _hot_functions(mod):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            last = name.split(".")[-1]
+            if last in SHARE_CALIBRATION_CALLS:
+                why = (f"share-map calibration I/O in hot path {fn.name}(): "
+                       f"{name}() runs dense prefills / whole-KV host "
+                       "marshalling — calibrate offline "
+                       "(cli/kv_share_calibrate.py) and load the saved "
+                       "artifact once at startup")
+            elif last in PREFIX_INVENTORY_CALLS or (
+                "." in name
+                and last in PREFIX_FEDERATION_CALLS
+                and any(h in seg for seg in name.split(".")[:-1]
+                        for h in PREFIX_FEDERATION_HINTS)
+            ):
+                why = (f"pod prefix-federation call in hot path {fn.name}(): "
+                       f"{name}() blocks on a cross-host blob fetch / "
+                       "store-lock inventory walk — start the fetch from the "
+                       "waiting-queue pass on its own thread and let "
+                       "admission read the per-request flag")
+            else:
+                continue
+            findings.append(Finding(
+                "MST115", mod.display_path, node.lineno, node.col_offset,
+                why, context=qualname_for_line(mod.tree, node.lineno),
             ))
     return findings
 
@@ -1082,6 +1160,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_sync_spill(mod)
     findings += _check_block_migration(mod)
     findings += _check_control_plane_in_tick(mod)
+    findings += _check_prefix_federation_in_tick(mod)
     findings += _check_sync_import(mod)
     findings += _check_store_import(mod)
     findings += _check_hot_trace_overhead(mod)

@@ -115,6 +115,11 @@ class KVPageBlock:
     last_tok: int            # next decode input (== history[-1])
     resume_keys: object      # sampler PRNG key row at export
     resume_recent: object    # repetition-penalty recent window at export
+    # KV share-map layout identity (kv_share.KVShareMap.share_hash) of the
+    # pool the pages were lifted from; None == unshared/identity layout.
+    # Joins the fingerprint and is re-checked at import so a block can
+    # never scatter into a pool with a different layer→group layout.
+    share_hash: Optional[str] = None
     checksum: Optional[str] = None
     _host: bool = False
     # device-resident (k_pages, v_pages) staged by prefetch(); consumed by
@@ -208,7 +213,12 @@ class KVPageBlock:
 
     def _fingerprint(self) -> str:
         h = hashlib.blake2b(digest_size=16)
-        h.update(f"{self.n_tokens}:{self.page_size}:{self.last_tok}".encode())
+        head = f"{self.n_tokens}:{self.page_size}:{self.last_tok}"
+        if self.share_hash:
+            # unshared blocks keep the legacy header so their checksums
+            # (and the pod-federated digests derived from them) are stable
+            head += f":share={self.share_hash}"
+        h.update(head.encode())
         for leaf in _leaves((self.k_pages, self.v_pages)):
             h.update(np.ascontiguousarray(leaf).tobytes())
         return h.hexdigest()
@@ -267,6 +277,7 @@ class KVPageBlock:
                 "last_tok": self.last_tok,
                 "resume_keys": self.resume_keys,
                 "resume_recent": self.resume_recent,
+                "share_hash": self.share_hash,
                 "checksum": self.checksum,
             }
         import pickle
@@ -294,6 +305,7 @@ class KVPageBlock:
                 last_tok=int(payload["last_tok"]),
                 resume_keys=payload["resume_keys"],
                 resume_recent=payload["resume_recent"],
+                share_hash=payload.get("share_hash"),
                 checksum=payload["checksum"],
                 _host=True,
             )
@@ -345,6 +357,7 @@ def export_block(
     produced: int,
     resume_keys,
     resume_recent,
+    share_hash: Optional[str] = None,
     gather=None,
     put=None,
 ) -> KVPageBlock:
@@ -384,17 +397,30 @@ def export_block(
         last_tok=int(history[-1]) if history else -1,
         resume_keys=resume_keys,
         resume_recent=resume_recent,
+        share_hash=share_hash,
     )
 
 
-def import_block(cache, block: KVPageBlock, page_ids, *, scatter=None, put=None):
+def import_block(cache, block: KVPageBlock, page_ids, *, share_hash=None,
+                 scatter=None, put=None):
     """Scatter ``block``'s page payloads into pool pages ``page_ids`` of
     ``cache`` and return the updated cache. Validates the block first
-    (checksum + geometry); raises on any problem so the caller can release
-    the pages and fall back to re-prefill. Fault site ``cache.import``
-    models mid-import failure."""
+    (checksum + geometry + share-map layout identity against the pool's
+    ``share_hash``); raises on any problem so the caller can release the
+    pages and fall back to re-prefill. Fault site ``cache.import`` models
+    mid-import failure."""
     inject("cache.import", n_pages=len(page_ids), n_tokens=block.n_tokens)
     block.verify()
+    if block.share_hash != share_hash:
+        # the geometry check below can't see this (a 2-layer-pair share
+        # map halves the pool's layer axis, but two DIFFERENT maps with
+        # the same group count are byte-compatible and silently wrong)
+        raise BlockIntegrityError(
+            f"KV share-map layout mismatch: block was exported under "
+            f"share_hash={block.share_hash!r} but this pool runs "
+            f"{share_hash!r} — re-prefill, or serve both hosts with the "
+            f"same --kv-share-map artifact"
+        )
     reason = block.compatible_with(cache)
     if reason is not None:
         raise BlockIntegrityError(reason)
@@ -552,6 +578,19 @@ class KVSpillTier:
     def contains(self, key) -> bool:
         with self._lock:
             return key in self._blocks
+
+    def keys(self) -> list:
+        """Snapshot of resident keys, MRU-first — the prefix store's pod
+        inventory reads this to gossip what this host can serve."""
+        with self._lock:
+            return list(reversed(self._blocks.keys()))
+
+    def share_hashes(self) -> set:
+        """Distinct ``share_hash`` values across resident blocks — the
+        prefix store's share-map bind check reads this to reject a layout
+        change over blocks exported under another one."""
+        with self._lock:
+            return {b.share_hash for b in self._blocks.values()}
 
     def drop(self, key) -> None:
         self._pop(key)

@@ -424,6 +424,7 @@ class PipelineEngine:
         page_size: Optional[int] = None,
         paged_attention: str = "auto",
         kv_dtype: Optional[str] = None,
+        kv_share_map=None,
         weights: Optional[ResidentWeights] = None,
     ):
         cfg = model.config
@@ -477,6 +478,32 @@ class PipelineEngine:
             raise ValueError(
                 "kv_dtype='int8' requires a paged engine (pool_pages)"
             )
+
+        # KVSharer layer-wise KV sharing (kv_share.KVShareMap): the pool
+        # allocates one physical (k, v) buffer per share-GROUP and every
+        # layer reads/writes through the group indirection. The identity
+        # map keeps the unshared fast paths selected (and hashes to None
+        # so legacy exported blocks compose). Validation against the
+        # engine's LOCAL layer count happens below, once the resident
+        # weights resolve the stage split.
+        if kv_share_map is not None:
+            if not self.paged:
+                raise ValueError(
+                    "kv_share_map requires a paged engine (pool_pages)"
+                )
+            if self.num_stages != 1:
+                raise ValueError(
+                    "kv_share_map requires a pp=1 engine: share groups "
+                    "span the full layer stack, which a stage split cuts"
+                )
+        self.kv_share = kv_share_map
+        self.kv_share_hash = (
+            kv_share_map.share_hash if kv_share_map is not None else None
+        )
+        self._share_active = (
+            kv_share_map is not None and not kv_share_map.is_identity
+        )
+        self.kv_share_bytes_saved = 0  # filled by init_cache_paged
 
         tp_axes = model.tp_layer_axes()
         if self.tp > 1:
@@ -587,6 +614,11 @@ class PipelineEngine:
         self.vocab_parts = weights.vocab_parts
         self.shared_params = weights.shared_params
         self.weight_stream_bytes = weights.weight_bytes
+        if self.kv_share is not None:
+            # the map must cover exactly this engine's local layer stack
+            # (padding from uneven heterogeneous splits counts — reject
+            # rather than guess which stacked slots are real)
+            self.kv_share.validate_for(self.layers_per_stage)
         # resources the engine holds beyond its own arrays (today: the
         # shared-weight lease release) — close() runs each exactly once
         self._close_hooks: list = []
@@ -704,8 +736,11 @@ class PipelineEngine:
             self.num_stages, self.layers_per_stage, self.microbatches,
             self.batch,
         )
+        # KVSharer: the pool's layer axis shrinks to the share-GROUP count —
+        # one physical buffer per group, every layer a logical view
+        L_pool = self.kv_share.num_groups if self._share_active else L
         shape = (
-            S, L, self.pool_pages + 1, B, self.page_size,
+            S, L_pool, self.pool_pages + 1, B, self.page_size,
             self.model.cache_num_heads(),
         )
         sharding = NamedSharding(self.mesh, self._kv_spec)
@@ -731,7 +766,27 @@ class PipelineEngine:
             jnp.full((M + 1, self.slot_pages), self.pool_pages, jnp.int32),
             NamedSharding(self.mesh, P()),
         )
+        if self._share_active:
+            # the allocation that DIDN'T happen: an unshared pool would be
+            # L/G times these leaves (dtype/scale structure identical)
+            pool_bytes = sum(
+                leaf.nbytes for leaf in jax.tree.leaves((cache.k, cache.v))
+            )
+            self.kv_share_bytes_saved = int(
+                pool_bytes * (L - L_pool) / L_pool
+            )
         return cache, table
+
+    def kv_share_stats(self) -> dict:
+        """Observability surface for the ``mst_kv_share_*`` family."""
+        m = self.kv_share
+        return {
+            "enabled": bool(self._share_active),
+            "groups": m.num_groups if m is not None else self.layers_per_stage,
+            "layers": self.layers_per_stage,
+            "bytes_saved": int(self.kv_share_bytes_saved),
+            "share_hash": self.kv_share_hash,
+        }
 
     # ----------------------------------------------------- vocab sharding
     def _vs_embed(self, s, vparts, ids):
@@ -764,17 +819,24 @@ class PipelineEngine:
         """Gather one slot's pages into the contiguous (L, B, S_virt, H, D)
         view run_layers expects. k/v: local pool (L, P+1, B, page, H, D) —
         or the int8 ``{d, s}`` pair, which dequantizes AFTER the gather so
-        the pool→registers traffic is the int8 bytes, not the dense view."""
+        the pool→registers traffic is the int8 bytes, not the dense view.
+        Under a KV share map the pool's leading axis is the GROUP count;
+        the group rows expand to the per-layer view post-dequantize, so
+        pool→registers traffic stays the G-sized bytes."""
 
         def gather(pool):
             g = jnp.take(pool, table_row, axis=1)  # (L, SPG, B, page, H, D)
             g = jnp.moveaxis(g, 1, 2)  # (L, B, SPG, page, H, D)
             return g.reshape(*g.shape[:2], -1, *g.shape[4:])
 
-        return tuple(
+        out = tuple(
             dequantize_kv(jax.tree.map(gather, pool), self.cache_dtype)
             for pool in (k, v)
         )
+        if self._share_active:
+            gids = jnp.asarray(self.kv_share.group_of, jnp.int32)
+            out = tuple(jnp.take(x, gids, axis=0) for x in out)
+        return out
 
     def _paged_writeback(self, pool, buf, table_row, offset, n_pages=1):
         """Scatter the dirty page(s) of a slot's contiguous buffer back into
@@ -788,6 +850,15 @@ class PipelineEngine:
         because the stored max element sits exactly at ±127, pinning the
         recomputed scale)."""
         quant = isinstance(pool, dict)
+        if self._share_active:
+            # only the owner layer's rows persist: reduce the expanded
+            # (L, …) view back to the pool's (G, …) axis before scatter —
+            # non-owner layers attended over the owner's history plus their
+            # own current-tick rows, which are discarded here by design
+            buf = jnp.take(
+                buf, jnp.asarray(self.kv_share.owner_layers, jnp.int32),
+                axis=0,
+            )
         l, b = buf.shape[:2]
         page = self.page_size
         buf6 = buf.reshape(l, b, self.slot_pages, page, *buf.shape[3:])
@@ -1023,6 +1094,50 @@ class PipelineEngine:
             self._smapped_decode = smapped  # shared by the continuous-batching step
         return smapped
 
+    def _scan_layers_shared(self, layer_fn, h, layer_params, k_pool, v_pool,
+                            gids, own, mask=None):
+        """Share-map variant of ``models.base.scan_layers`` for the ragged
+        body: the pool stays GROUP-sized in the scan *carry* (an L-sized
+        xs/ys pool would materialize the very transient the share map
+        exists to avoid). Each layer dynamic-indexes its group's buffer
+        out of the carry; after the layer runs, only the group OWNER's
+        writes persist — a non-owner layer attends over the owner's
+        history plus its own current-tick rows and then discards them,
+        and a masked-out padding layer persists nothing."""
+
+        def body(carry, xs):
+            h, k_pool, v_pool = carry
+            if mask is None:
+                p, gid, keep = xs
+                m_l = None
+            else:
+                p, gid, keep, m_l = xs
+                keep = keep & m_l
+            idx = lambda pool: jax.tree.map(  # noqa: E731
+                lambda x: jax.lax.dynamic_index_in_dim(
+                    x, gid, 0, keepdims=False
+                ),
+                pool,
+            )
+            k_buf, v_buf = idx(k_pool), idx(v_pool)
+            h2, k2, v2 = layer_fn(h, p, k_buf, v_buf)
+            if m_l is not None:
+                h2 = jnp.where(m_l, h2, h)
+            put = lambda pool, new, old: jax.tree.map(  # noqa: E731
+                lambda x, n, o: jax.lax.dynamic_update_index_in_dim(
+                    x, jnp.where(keep, n, o), gid, 0
+                ),
+                pool, new, old,
+            )
+            return (h2, put(k_pool, k2, k_buf), put(v_pool, v2, v_buf)), None
+
+        xs = (
+            (layer_params, gids, own) if mask is None
+            else (layer_params, gids, own, mask)
+        )
+        (h, k_pool, v_pool), _ = jax.lax.scan(body, (h, k_pool, v_pool), xs)
+        return h, k_pool, v_pool
+
     def _build_smapped_ragged(self):
         """T=1 paged decode body attending over the page pool IN PLACE
         (ops/paged_attention.py). Where the gather body materializes every
@@ -1113,8 +1228,15 @@ class PipelineEngine:
 
                 return layer
 
-            # per-group scans over the stacked layer sub-trees, the pool
-            # sliced to each group's layer range (run_layers' layout)
+            # per-group scans over the stacked layer sub-trees: unshared,
+            # the pool slices to each group's layer range (run_layers'
+            # layout, pool as scan xs/ys); under a share map the G-sized
+            # pool rides the scan carry instead and layers dynamic-index
+            # their share-group's buffer out of it
+            share = self._share_active
+            if share:
+                gids_all = jnp.asarray(self.kv_share.group_of, jnp.int32)
+                own_all = jnp.asarray(self.kv_share.owner_mask)
             lo = 0
             k_parts, v_parts = [], []
             for g in model.sp_groups():
@@ -1123,20 +1245,28 @@ class PipelineEngine:
                 stack = layer_params if g is None else layer_params[g]
                 mask_g = masks if g is None else masks[g]
                 n_g = jax.tree.leaves(stack)[0].shape[0]
-                h, k_g, v_g = scan_layers(
-                    make_layer(g), h, stack,
-                    jax.tree.map(lambda x: x[lo : lo + n_g], k),
-                    jax.tree.map(lambda x: x[lo : lo + n_g], v),
-                    mask_g,
-                )
-                k_parts.append(k_g)
-                v_parts.append(v_g)
+                if share:
+                    h, k, v = self._scan_layers_shared(
+                        make_layer(g), h, stack, k, v,
+                        gids_all[lo : lo + n_g], own_all[lo : lo + n_g],
+                        mask_g,
+                    )
+                else:
+                    h, k_g, v_g = scan_layers(
+                        make_layer(g), h, stack,
+                        jax.tree.map(lambda x: x[lo : lo + n_g], k),
+                        jax.tree.map(lambda x: x[lo : lo + n_g], v),
+                        mask_g,
+                    )
+                    k_parts.append(k_g)
+                    v_parts.append(v_g)
                 lo += n_g
-            cat = lambda *xs: (  # noqa: E731
-                jnp.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
-            )
-            k = jax.tree.map(cat, *k_parts)
-            v = jax.tree.map(cat, *v_parts)
+            if not share:
+                cat = lambda *xs: (  # noqa: E731
+                    jnp.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+                )
+                k = jax.tree.map(cat, *k_parts)
+                v = jax.tree.map(cat, *v_parts)
 
             out = jnp.where(active[:, None, None], h, 0).astype(cdt)
             out = jax.lax.psum(out, AXIS_PP)  # identity at S=1; keeps the

@@ -740,6 +740,282 @@ class PodHandoff:
 
 
 # --------------------------------------------------------------------------
+# pod-federated prefix store
+
+# how long a federated fetch waits for the owner's blob before degrading
+# to plain prefill (a host-tier export + one transport round trip)
+PREFIX_FETCH_TIMEOUT_S = 5.0
+
+# how long a digest that missed pod-wide stays negative-cached, so a cold
+# prefix doesn't re-probe the fabric on every admission
+PREFIX_NEG_CACHE_S = 30.0
+
+
+class PodPrefixFederation:
+    """Federates the :class:`~mlx_sharding_tpu.prefix_store.PrefixStore`
+    host tier across the pod, the same way weight digests federate: each
+    host's heartbeat carries its prefix-digest inventory
+    (``PrefixStore.host_inventory``), so a local prefix miss can consult
+    the pod view and — on a remote hit — pull the owner's exported
+    ``KVPageBlock`` (checksummed ``to_bytes`` wire format) into the LOCAL
+    host tier, where the scheduler's ordinary staged-prefetch/demand-
+    import path picks it up. Pod-wide, a hot prefix is prefilled ONCE.
+
+    :meth:`fetch` runs strictly OFF the decode tick (the scheduler calls
+    it from admission's store-consult slow path, never from ``_tick`` —
+    mstcheck MST115 enforces this), fires the ``pod.prefix_fetch`` fault
+    site requester-side before touching the wire, and degrades to plain
+    prefill on EVERY failure, each counted by kind and none able to drop
+    or corrupt a stream:
+
+    - ``fetch_fault`` — injected control failure at the fault site;
+    - ``miss`` — no live peer advertises the digest (negative-cached);
+    - ``stale_inventory`` — only stale heartbeats advertise it, or the
+      owner's tier evicted the block between gossip and fetch;
+    - ``owner_dead`` — the send to the advertised owner failed;
+    - ``timeout`` — the owner went silent past ``fetch_timeout_s``;
+    - ``integrity`` — the blob failed its checksum, page geometry, or
+      KV share-map layout check (kv_share.py) on arrival;
+    - ``host_reject`` — the local tier refused the block (budget).
+    """
+
+    def __init__(self, host_id: int, transport, store, *,
+                 heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
+                 fetch_timeout_s: float = PREFIX_FETCH_TIMEOUT_S,
+                 neg_cache_s: float = PREFIX_NEG_CACHE_S,
+                 clock: Clock = MONOTONIC):
+        self.host_id = host_id
+        self.transport = transport
+        self.store = store
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.fetch_timeout_s = fetch_timeout_s
+        self.neg_cache_s = neg_cache_s
+        self.clock = clock
+        self._lock = make_lock("PodPrefixFederation._lock")
+        self._waiters: dict = {}   # rid -> queue.Queue of (ev, data)
+        self._neg: dict = {}       # digest hex -> clock() expiry
+        self.hits = 0              # pod-view consults that found an owner
+        self.fetches = 0           # blobs imported into the local tier
+        self.fetch_bytes = 0
+        self.blobs_served = 0      # owner side: blobs exported to peers
+        self.bytes_served = 0
+        self.fallbacks: dict = {}
+        self._ms: deque = deque(maxlen=512)
+
+    # ---------------------------------------------------------- accounting
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.fallbacks[kind] = self.fallbacks.get(kind, 0) + 1
+
+    def stats(self) -> dict:
+        try:
+            inventory = len(self.store.host_inventory())
+        except Exception:  # noqa: BLE001 — a sick store reports nothing
+            inventory = 0
+        with self._lock:
+            ms = sorted(self._ms)
+            n = len(ms)
+            return {
+                "inventory_keys": inventory,
+                "hits": self.hits,
+                "fetches": self.fetches,
+                "fetch_bytes": self.fetch_bytes,
+                "blobs_served": self.blobs_served,
+                "bytes_served": self.bytes_served,
+                "fallbacks": dict(self.fallbacks),
+                "fetch_ms_p50": ms[n // 2] if n else None,
+                "fetch_ms_p99": (
+                    ms[min(n - 1, int(round(0.99 * n)))] if n else None
+                ),
+            }
+
+    # ----------------------------------------------------------- heartbeat
+    def local_info(self) -> dict:
+        """This host's prefix heartbeat entry: the host-tier digest
+        inventory plus the geometry peers need to pre-judge compatibility
+        (page size and KV share-map hash both ride the blob check anyway —
+        advertising them just saves a doomed fetch)."""
+        try:
+            return {
+                "keys": self.store.host_inventory(),
+                "page_size": self.store.page_size,
+                "share": self.store.share_hash,
+            }
+        except Exception:  # noqa: BLE001 — advertise nothing, not garbage
+            return {}
+
+    # ------------------------------------------------------------- routing
+    def _owner_for(self, hexd: str):
+        """(owner host, None) for the freshest LIVE peer advertising the
+        digest; (None, fallback kind) otherwise."""
+        try:
+            peers = self.transport.peers()
+        except Exception:  # noqa: BLE001 — no fabric, no federation
+            return None, "miss"
+        local = {
+            "page_size": self.store.page_size,
+            "share": self.store.share_hash,
+        }
+        best = None
+        stale_only = False
+        for host, entry in peers.items():
+            info = (entry.get("info") or {}).get("prefix") or {}
+            if hexd not in (info.get("keys") or ()):
+                continue
+            if info.get("page_size") != local["page_size"] \
+                    or info.get("share") != local["share"]:
+                continue  # incompatible geometry: the fetch would fail
+            age = entry.get("age_s", float("inf"))
+            if age > self.heartbeat_timeout_s:
+                stale_only = True
+                continue
+            if best is None or age < best[0]:
+                best = (age, host)
+        if best is not None:
+            return best[1], None
+        return None, ("stale_inventory" if stale_only else "miss")
+
+    # ------------------------------------------------------------ requester
+    def _neg_cached(self, hexd: str) -> bool:
+        """One lock scope: purge an expired entry, report a live one."""
+        now = self.clock()
+        with self._lock:
+            exp = self._neg.get(hexd)
+            if exp is None:
+                return False
+            if now < exp:
+                return True
+            del self._neg[hexd]
+            return False
+
+    def _neg_add(self, hexd: str) -> None:
+        with self._lock:
+            self._neg[hexd] = self.clock() + self.neg_cache_s
+
+    def fetch(self, digest: bytes) -> bool:
+        """Pull the prefix block for ``digest`` from its pod owner into
+        the LOCAL host tier. True iff the block is now locally resident
+        (the caller re-probes the store and rides the normal import path);
+        False means plain prefill, with the reason counted. Blocking —
+        call it from admission's store-consult slow path, NEVER from the
+        decode tick."""
+        hexd = digest.hex()
+        if self._neg_cached(hexd):
+            self._count("neg_cached")
+            return False
+        try:
+            inject("pod.prefix_fetch", digest=hexd)
+        except Exception:  # noqa: BLE001 — injected control failure
+            self._count("fetch_fault")
+            return False
+        owner, why = self._owner_for(hexd)
+        if owner is None:
+            self._count(why)
+            if why == "miss":
+                self._neg_add(hexd)
+            return False
+        with self._lock:
+            self.hits += 1
+        rid = uuid.uuid4().hex
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._waiters[rid] = q
+        t0 = self.clock()
+        try:
+            try:
+                self.transport.send(
+                    owner, "prefix.fetch",
+                    pickle.dumps({"rid": rid, "digest": digest},
+                                 protocol=pickle.HIGHEST_PROTOCOL),
+                )
+            except Exception:  # noqa: BLE001 — the advertised owner died
+                self._count("owner_dead")
+                return False
+            try:
+                ev, data = q.get(timeout=self.fetch_timeout_s)
+            except queue.Empty:
+                self._count("timeout")
+                return False
+        finally:
+            # mst: allow(MST202): rid is a fresh uuid owned by this call
+            with self._lock:
+                self._waiters.pop(rid, None)
+        if ev != "blob" or not data:
+            # the owner's tier evicted the block after the last heartbeat
+            self._count("stale_inventory")
+            self._neg_add(hexd)
+            return False
+        try:
+            block = KVPageBlock.from_bytes(data)
+        except BlockIntegrityError:
+            self._count("integrity")
+            return False
+        if (self.store.page_size is not None
+                and block.page_size != self.store.page_size) \
+                or block.share_hash != self.store.share_hash:
+            self._count("integrity")
+            return False
+        if not self.store.host_put(digest, block):
+            self._count("host_reject")
+            return False
+        with self._lock:
+            self.fetches += 1
+            self.fetch_bytes += len(data)
+            self._ms.append((self.clock() - t0) * 1000.0)
+        return True
+
+    # ----------------------------------------------------------- receiver
+    def handle(self, src: int, kind: str, payload: bytes) -> bool:
+        """Transport-handler hook. Returns True when the message was a
+        prefix-federation message (consumed)."""
+        if kind == "prefix.fetch":
+            # serve off the transport receive thread: to_bytes of a big
+            # block must not stall the heartbeat loop (handoff discipline)
+            threading.Thread(
+                target=self._serve_fetch, args=(src, payload),
+                name="mst-pod-prefix", daemon=True,
+            ).start()
+            return True
+        if kind in ("prefix.blob", "prefix.miss"):
+            try:
+                rid, data = pickle.loads(payload)
+            except Exception:  # noqa: BLE001 — undecodable reply
+                return True
+            with self._lock:
+                q = self._waiters.get(rid)
+            if q is not None:
+                q.put(("blob" if kind == "prefix.blob" else "miss", data))
+            return True
+        return False
+
+    def _serve_fetch(self, src: int, payload: bytes) -> None:
+        rid = None
+        blob = b""
+        try:
+            msg = pickle.loads(payload)
+            rid = msg["rid"]
+            blk = self.store.host_block(msg["digest"])
+            if blk is not None:
+                blob = blk.to_bytes()
+        except Exception:  # noqa: BLE001 — a serve failure is the
+            blob = b""     # requester's stale_inventory fallback
+        if rid is None:
+            return
+        try:
+            self.transport.send(
+                src,
+                "prefix.blob" if blob else "prefix.miss",
+                pickle.dumps((rid, blob), protocol=pickle.HIGHEST_PROTOCOL),
+            )
+        except Exception:  # noqa: BLE001 — requester's fetch timeout
+            return         # covers a dead return path
+        if blob:
+            with self._lock:
+                self.blobs_served += 1
+                self.bytes_served += len(blob)
+
+
+# --------------------------------------------------------------------------
 # pod autoscaler
 
 
@@ -925,6 +1201,7 @@ class PodFleet:
 
     def __init__(self, host_id: int, transport, local, *,
                  controllers=(), decode_pool=None, registry=None,
+                 prefix_store=None,
                  heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
                  relay_timeout_s: float = RELAY_TIMEOUT_S,
                  interval_s: float = 0.5,
@@ -956,11 +1233,28 @@ class PodFleet:
             heartbeat_timeout_s=heartbeat_timeout_s,
             on_host_death=self._host_died, clock=clock,
         )
+        self.prefix: Optional[PodPrefixFederation] = None
+        if prefix_store is not None:
+            self.attach_prefix_store(prefix_store)
         self.host_deaths = 0
         self._lock = make_lock("PodFleet._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         transport.set_handler(self._on_message)
+
+    def attach_prefix_store(self, store) -> "PodPrefixFederation":
+        """Federate ``store``'s host tier over this pod: its digest
+        inventory rides the heartbeat, and the federation handle lands on
+        ``store.federation`` so the scheduler's store-consult slow path
+        reaches :meth:`PodPrefixFederation.fetch` without knowing about
+        the pod at all."""
+        self.prefix = PodPrefixFederation(
+            self.host_id, self.transport, store,
+            heartbeat_timeout_s=self.autoscaler.heartbeat_timeout_s,
+            clock=self.clock,
+        )
+        store.federation = self.prefix
+        return self.prefix
 
     # ------------------------------------------------------------- serving
     def generate_step(self, prompt_tokens, **kw):
@@ -1018,6 +1312,10 @@ class PodFleet:
         }
         if spec is not None:
             info["spec"] = spec
+        if self.prefix is not None:
+            # prefix-digest inventory rides the same heartbeat the weight
+            # digests do — a miss anywhere consults this pod view
+            info["prefix"] = self.prefix.local_info()
         return info
 
     def tick(self) -> dict:
@@ -1046,6 +1344,8 @@ class PodFleet:
     # ------------------------------------------------------------ messages
     def _on_message(self, src: int, kind: str, payload: bytes) -> None:
         if self.handoff.handle(src, kind, payload):
+            return
+        if self.prefix is not None and self.prefix.handle(src, kind, payload):
             return
         if kind == "weights.teardown":
             self.registry.handle_teardown(payload.decode())
@@ -1091,13 +1391,16 @@ class PodFleet:
                 "fleet": info.get("fleet", {}),
                 "weights": info.get("weights", {}),
             }
-        return {
+        out = {
             "host_id": self.host_id,
             "hosts": hosts,
             "handoff": self.handoff.stats(),
             "autoscaler": self.autoscaler.state(),
             "host_deaths": host_deaths,
         }
+        if self.prefix is not None:
+            out["prefix"] = self.prefix.stats()
+        return out
 
     def close(self, close_local: bool = True) -> None:
         """Stop the pod loop and transport. ``close_local`` follows the

@@ -127,6 +127,16 @@ class PrefixStore:
         self._by_digest: dict = {}
         self._host = KVSpillTier(host_bytes)
         self.page_size: Optional[int] = None
+        # KV share-map layout the attached engines run (None == unshared).
+        # Bound write-once like page_size; host-tier blocks carry the hash
+        # they were exported under and a mismatch at bind time is a
+        # configuration error, not an import-time checksum surprise.
+        self.share_hash: Optional[str] = None
+        self._share_bound = False
+        # pod federation handle (pod.PodFleet.attach_prefix_store sets it):
+        # the scheduler's store-consult slow path calls federation.fetch()
+        # on a local miss; None == single-host store, no pod consult
+        self.federation = None
         # ---------------------------------------------- insertion policy
         self.insert_min_hits = insert_min_hits
         self.insert_burst = insert_burst
@@ -166,6 +176,38 @@ class PrefixStore:
                 f"prefix store is chained at page_size={existing}; an "
                 f"engine with page_size={page} cannot share it"
             )
+
+    def bind_share_hash(self, share_hash: Optional[str]):
+        """Each attaching batcher declares its pool's KV share-map layout
+        hash (``engine.kv_share_hash``; None == unshared/identity). Blocks
+        only compose across identical layouts, so the check runs HERE, at
+        construction — not as a geometry-checksum failure deep in an
+        import at serve time. Write-once: a second engine binding a
+        different layout, or a bind that disagrees with blocks already
+        resident in the host tier, is a configuration error with a
+        remediation hint."""
+        if self._share_bound:
+            if self.share_hash != share_hash:
+                raise ValueError(
+                    f"prefix store is bound to KV share-map hash "
+                    f"{self.share_hash!r}; an engine with share hash "
+                    f"{share_hash!r} cannot share it — serve every attached "
+                    f"engine with the same --kv-share-map artifact"
+                )
+            return
+        stale = {
+            h for h in self._host.share_hashes() if h != share_hash
+        }
+        if stale:
+            raise ValueError(
+                f"prefix store host tier already holds blocks exported "
+                f"under share-map hash(es) {sorted(str(h) for h in stale)} "
+                f"but this engine binds {share_hash!r} — restart with the "
+                f"matching --kv-share-map artifact (or a fresh store) "
+                f"instead of changing KV layouts over resident blocks"
+            )
+        self.share_hash = share_hash
+        self._share_bound = True
 
     def digests_for(self, prompt) -> list:
         """The store's digest chain for ``prompt``: page-aligned chunks,
@@ -363,9 +405,15 @@ class PrefixStore:
         entry.keys = []
 
     def host_put(self, digest: bytes, block: KVPageBlock) -> bool:
-        """Demotion: park an exported prefix block in the host tier under
-        its full-chain digest. Returns the tier's verdict (budget/oversize
-        rejects mean the prefix is simply gone — re-prefilled on next use)."""
+        """Demotion (or a pod-federated fetch): park an exported prefix
+        block in the host tier under its full-chain digest. Returns the
+        tier's verdict (budget/oversize rejects mean the prefix is simply
+        gone — re-prefilled on next use). A block exported under a
+        different share-map layout than the bound one is refused the same
+        way: degraded to re-prefill, never resident-but-unimportable."""
+        if self._share_bound and block.share_hash != self.share_hash:
+            self.count_demote_drop()
+            return False
         ok = self._host.put(digest, block)
         with self._lock:
             if ok:
@@ -376,6 +424,19 @@ class PrefixStore:
 
     def host_contains(self, digest: bytes) -> bool:
         return self._host.contains(digest)
+
+    def host_inventory(self, cap: int = 64) -> list:
+        """Hex digests of host-tier-resident prefix blocks, MRU-first and
+        capped — the pod federation's gossip payload (pod.py rides it on
+        the control-plane heartbeat exactly like WeightStore key digests).
+        Hex, not bytes: heartbeat payloads must stay JSON-serializable."""
+        out = []
+        for key in self._host.keys():
+            if len(out) >= cap:
+                break
+            if isinstance(key, (bytes, bytearray)):
+                out.append(bytes(key).hex())
+        return out
 
     def count_demote_drop(self):
         with self._lock:

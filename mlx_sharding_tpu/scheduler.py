@@ -159,6 +159,12 @@ class _Request:
     _sdigests: Optional[list] = None
     _splan: Optional[tuple] = None
     _please: Optional[object] = None
+    # pod-federated prefix fetch state: None = never consulted, "pending" =
+    # a background fetch is in flight (admission holds the request so the
+    # prefix isn't redundantly prefilled), "done" = resolved either way.
+    # _fits only READS the flag — every federation call runs off the tick
+    # path in _pod_fetch_waiting (MST115)
+    _podfetch: Optional[str] = None
     # over-commit admission state: order ticket (oldest admitted request is
     # never preempted), tokens emitted since the last (re)admission (folded
     # into the prompt on preemption so resume re-prefills them), and the
@@ -597,9 +603,15 @@ class ContinuousBatcher:
         # (block import), and completed prefills register their prefix
         # back. One store is shared by every batcher in the process — the
         # subsystem the slot-local _prefix_index cannot grow into.
+        # the engine's KV share-map layout hash (kv_share.py; None ==
+        # unshared/identity) — stamped into every exported block and
+        # demanded of every imported one, so a layout mismatch fails
+        # closed at the edge instead of scattering wrong-geometry KV
+        self._share_hash = getattr(engine, "kv_share_hash", None)
         self.prefix_store = prefix_store
         if prefix_store is not None:
             prefix_store.bind_page_size(engine.page_size)
+            prefix_store.bind_share_hash(self._share_hash)
         # Admission accounting mode. "reserve" (default) claims a request's
         # whole page need (prompt + max_tokens) up front: deadlock-free by
         # construction, but a request that asks for max_tokens=4096 and emits
@@ -1557,6 +1569,7 @@ class ContinuousBatcher:
             with tracing.bind(req._trace):
                 self.cache = import_block(
                     self.cache, block, pages[:cover],
+                    share_hash=self._share_hash,
                     scatter=self._import_pages, put=self._put,
                 )
             dt = time.perf_counter() - t0
@@ -1651,6 +1664,7 @@ class ContinuousBatcher:
                     n_tokens=len(entry.pages) * self.engine.page_size,
                     prompt=entry.tokens, history=[], produced=0,
                     resume_keys=None, resume_recent=None,
+                    share_hash=self._share_hash,
                     gather=self._export_pages, put=self._put,
                 )
                 store.host_put(digest, block)
@@ -1660,6 +1674,50 @@ class ContinuousBatcher:
                 "prefix demotion export failed (prefix dropped): %s", e
             )
         self._unref_pages(entry.pages)
+
+    def _pod_fetch_waiting(self):
+        """Consult the pod view for head-of-line waiting requests whose
+        prefix missed the LOCAL store (pod.PodPrefixFederation): when a
+        live peer's gossiped inventory advertises the digest, a background
+        worker pulls the owner's exported block into the local host tier
+        — pod-wide, the prefix prefills ONCE — while ``_fits`` holds the
+        request on the ``_podfetch`` flag. Every failure (fault, stale
+        inventory, owner death, timeout, integrity) resolves the flag and
+        the request prefills plain: degraded, never dropped. All
+        federation traffic lives here and in the worker thread, off the
+        tick-hot functions (MST115)."""
+        store = self.prefix_store
+        fed = getattr(store, "federation", None) if store is not None \
+            else None
+        if fed is None or not self._waiting:
+            return
+        for req in self._waiting[:4]:
+            if req.cancelled or req.spilled or req._block is not None \
+                    or req._podfetch is not None:
+                continue
+            digests = self._store_digests(req)
+            if not digests or self._store_lookup(req) is not None:
+                req._podfetch = "done"  # nothing to federate / local hit
+                continue
+            req._podfetch = "pending"
+            threading.Thread(
+                target=self._pod_fetch_one, args=(req, digests[-1]),
+                name="mst-pod-prefix-fetch", daemon=True,
+            ).start()
+
+    def _pod_fetch_one(self, req: _Request, digest: bytes):
+        """Background federation fetch for one waiting request. The
+        federation counts every outcome by kind; this worker only flips
+        the admission gate — on success the next ``_store_lookup`` poll
+        sees the host-tier hit and admission imports it via the ordinary
+        staged-prefetch/demand path."""
+        try:
+            self.prefix_store.federation.fetch(digest)
+        except Exception as e:  # noqa: BLE001 — degrade to plain prefill
+            logging.getLogger(__name__).debug(
+                "pod prefix fetch failed (plain prefill): %s", e
+            )
+        req._podfetch = "done"
 
     def _prefetch_store_waiting(self):
         """Stage host-tier prefix blocks for head-of-line waiting requests
@@ -1988,6 +2046,7 @@ class ContinuousBatcher:
             with tracing.bind(req._trace):
                 self.cache = import_block(
                     self.cache, block, pages[:data_pages],
+                    share_hash=self._share_hash,
                     scatter=self._import_pages, put=self._put,
                 )
             dt = time.perf_counter() - t0
@@ -2357,6 +2416,7 @@ class ContinuousBatcher:
                     prompt=req.prompt, history=req.history,
                     produced=req.produced, resume_keys=req.resume_keys,
                     resume_recent=req.resume_recent,
+                    share_hash=self._share_hash,
                     gather=self._export_pages, put=self._put,
                 )
                 ok = self.spill.put(req, block)
@@ -2567,6 +2627,7 @@ class ContinuousBatcher:
                 if req.spilled and not req.cancelled:
                     self._prefetch_block(req)
                     budget -= 1
+        self._pod_fetch_waiting()
         self._prefetch_store_waiting()
 
     def migrate_out(self, deadline: float = 30.0) -> int:
@@ -2695,6 +2756,7 @@ class ContinuousBatcher:
                         prompt=req.prompt, history=req.history,
                         produced=req.produced, resume_keys=req.resume_keys,
                         resume_recent=req.resume_recent,
+                        share_hash=self._share_hash,
                         gather=self._export_pages, put=self._put,
                     )
                 except Exception as e:
@@ -3226,6 +3288,16 @@ class ContinuousBatcher:
             # A host hit discounts nothing (the import scatters into fresh
             # pages), it just records the plan for _assign_slot. Pure
             # probe: counters resolve once, at admission.
+            if getattr(self.prefix_store, "federation", None) is not None \
+                    and req._podfetch != "done":
+                # pod federation attached: hold the request until the
+                # waiting-queue pass has classified it (None) or its
+                # in-flight fetch lands (pending) so the prefix isn't
+                # redundantly prefilled — the fetch worker flips the flag
+                # on every outcome, and a failed fetch just prefills
+                # plain. Flag read only: the federation itself is never
+                # touched here
+                return False
             req._splan = None
             plan = self._store_lookup(req)
             discount = plan[1] if plan is not None and plan[0] == "device" else 0

@@ -37,6 +37,7 @@ import numpy as np
 from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.generate import TokenLogprobs
+from mlx_sharding_tpu.kv_share import load_share_map
 from mlx_sharding_tpu.resilience import (
     QueueFullError,
     ReplicasUnavailableError,
@@ -162,6 +163,7 @@ class ModelProvider:
         page_size: Optional[int] = None,
         paged_attention: str = "auto",
         kv_dtype: Optional[str] = None,
+        kv_share_map: Optional[str] = None,
         admission_policy: str = "fifo",
         overcommit: bool = False,
         spill_bytes: Optional[int] = None,
@@ -266,6 +268,12 @@ class ModelProvider:
         # KV-pool storage: "int8" stores {codes, per-row-per-head scale}
         # pools at ~half the bytes of bf16 (see cache.quantize_kv_rows)
         self.kv_dtype = kv_dtype
+        # layer-wise KV sharing (kv_share.py, KVSharer): path to a
+        # calibrated share-map artifact; pools allocate one physical
+        # buffer per share GROUP. Loaded once here — a bad artifact fails
+        # at startup, not per-engine-build
+        self.kv_share_map_path = kv_share_map
+        self.kv_share_map = load_share_map(kv_share_map)
         self.admission_policy = admission_policy
         self.overcommit = overcommit
         # host-DRAM spill tier for preempted requests' KV page blocks
@@ -307,6 +315,31 @@ class ModelProvider:
         share: the cache changes the page-allocation sequence, so a
         rank-divergent answer here is a multi-host desync."""
         return bool(self.prompt_cache and self.paged_pool is not None)
+
+    def kv_share_stats(self) -> Optional[dict]:
+        """Layer-wise KV sharing summary for /metrics and /health: the
+        configured map's geometry plus the first live engine's measured
+        pool-bytes saving (every engine binds the same artifact, so one
+        engine's view is the fleet's per-engine view). None when no
+        --kv-share-map is configured — the metric families stay absent."""
+        m = self.kv_share_map
+        if m is None:
+            return None
+        out = {
+            "enabled": not m.is_identity,
+            "groups": m.num_groups,
+            "layers": m.num_layers,
+            "share_hash": m.share_hash,
+            "bytes_saved": 0,
+        }
+        try:
+            eng = getattr(getattr(self, "generator", None), "engine", None)
+            fn = getattr(eng, "kv_share_stats", None)
+            if fn is not None:
+                out["bytes_saved"] = int(fn().get("bytes_saved", 0))
+        except Exception:  # noqa: BLE001 — geometry still renders
+            pass
+        return out
 
     def _shared_weights_on(self, *, weight_bytes: int = 0, want: int = 0,
                            per: int = 0, n_devices: int = 0) -> bool:
@@ -586,6 +619,9 @@ class ModelProvider:
                                 page_size=self.page_size,
                                 paged_attention=self.paged_attention,
                                 kv_dtype=self.kv_dtype,
+                                kv_share_map=self.kv_share_map
+                                if self.paged_pool and self.concurrent > 1
+                                else None,
                             )
                             # retirement releases the ref; the LAST engine
                             # to close frees the store's tree
@@ -606,6 +642,9 @@ class ModelProvider:
                                 page_size=self.page_size,
                                 paged_attention=self.paged_attention,
                                 kv_dtype=self.kv_dtype,
+                                kv_share_map=self.kv_share_map
+                                if self.paged_pool and self.concurrent > 1
+                                else None,
                             )
                         if self.concurrent > 1 and not self.multihost:
                             from mlx_sharding_tpu.scheduler import (
@@ -887,6 +926,11 @@ class ModelProvider:
                 pf = PodFleet(
                     transport.host_id, transport, generator,
                     controllers=list(ctrls),
+                    # federate the prefix store's host tier over the pod:
+                    # its digest inventory rides the heartbeat and a local
+                    # miss can pull the owner's exported block instead of
+                    # re-prefilling (pod.PodPrefixFederation)
+                    prefix_store=pstore,
                 )
                 pf.start()
                 self.pod_fleet = pf
@@ -1042,6 +1086,11 @@ class APIHandler(BaseHTTPRequestHandler):
             if pod is not None:
                 try:
                     payload["pod"] = pod.pod_stats()
+                except Exception:  # noqa: BLE001 — health must render anyway
+                    pass
+            if getattr(self.provider, "kv_share_map", None) is not None:
+                try:
+                    payload["kv_share"] = self.provider.kv_share_stats()
                 except Exception:  # noqa: BLE001 — health must render anyway
                     pass
             ctrl = getattr(gen, "ctrl", None)
@@ -1789,6 +1838,11 @@ def make_server(
                     if getattr(provider, "pod_fleet", None) is not None
                     else None
                 ),
+                kv_share_fn=lambda: (
+                    provider.kv_share_stats()
+                    if getattr(provider, "kv_share_map", None) is not None
+                    else None
+                ),
             ),
             "profile_dir": profile_dir,
             "api_key": api_key,
@@ -1852,6 +1906,17 @@ def main(argv=None):
                              "stores quantized codes plus a per-row-per-head "
                              "float32 scale (~2x the tokens per page of "
                              "bf16); default keeps the cache dtype")
+    parser.add_argument("--kv-share-map", default=None, metavar="PATH",
+                        help="with --paged-pool: layer-wise KV sharing "
+                             "(KVSharer) — path to a calibrated share-map "
+                             "artifact from cli/kv_share_calibrate.py. "
+                             "Pools allocate one physical (k,v) buffer per "
+                             "share GROUP (~25-50%% fewer KV bytes at the "
+                             "calibrated sharing ratio); exported blocks "
+                             "carry the map's hash so mismatched layouts "
+                             "fail closed at import. Composes with "
+                             "--kv-dtype int8, --spill-bytes and "
+                             "--prefix-store")
     parser.add_argument("--admission-policy", choices=("fifo", "first_fit"),
                         default="fifo",
                         help="waiting-line policy when a request doesn't fit "
@@ -2254,6 +2319,14 @@ def main(argv=None):
         parser.error("--paged-attention requires --paged-pool")
     if args.kv_dtype and not args.paged_pool:
         parser.error("--kv-dtype requires --paged-pool")
+    if args.kv_share_map:
+        if not args.paged_pool:
+            parser.error("--kv-share-map requires --paged-pool (sharing "
+                         "deduplicates the paged KV pool's layer axis)")
+        if args.stage_bounds or (args.num_stages or 1) > 1:
+            parser.error("--kv-share-map requires a single-stage engine: "
+                         "share groups span the full layer stack, which a "
+                         "pipeline stage split cuts")
     if args.admission_policy != "fifo" and not args.paged_pool:
         parser.error("--admission-policy requires --paged-pool")
     if args.overcommit and not args.paged_pool:
@@ -2393,6 +2466,7 @@ def main(argv=None):
         decode_block=args.decode_block, paged_pool=args.paged_pool,
         page_size=args.page_size, paged_attention=args.paged_attention,
         kv_dtype=args.kv_dtype,
+        kv_share_map=args.kv_share_map,
         admission_policy=args.admission_policy,
         overcommit=args.overcommit,
         spill_bytes=args.spill_bytes,

@@ -598,10 +598,35 @@ def scenario_surge_100(seed: int = 17, *, n_hosts: int = 100) -> Campaign:
     )
 
 
+def scenario_prefix_owner_death(seed: int = 19) -> Campaign:
+    """The prefix-owner host dies mid-fetch while another host's
+    heartbeats drop (stale inventories keep advertising the dead owner):
+    every pod prefix consult must degrade to plain prefill — streams
+    stay token-exact and none drop."""
+    return Campaign(
+        name="prefix_owner_death", seed=seed, n_hosts=4,
+        duration_s=18.0, arrival="tenant_skew", base_rate=2.5,
+        schedule=[
+            # faults on the fetch control point while the hot tenant is live
+            FaultEvent(t=5.0, kind="site", site="pod.prefix_fetch",
+                       times=4),
+            # the owner of the hot prefix dies mid-storm...
+            FaultEvent(t=6.0, kind="host_kill", host=0),
+            # ...and a peer's gossip stalls, so its inventory view of the
+            # dead owner goes stale instead of being torn down
+            FaultEvent(t=6.5, kind="heartbeat_loss", host=2, exc="drop",
+                       times=3),
+            FaultEvent(t=8.0, kind="site", site="pod.prefix_fetch",
+                       times=2),
+        ],
+    )
+
+
 SCENARIOS = {
     "site_storm": scenario_site_storm,
     "host_death": scenario_host_death,
     "breaker_storm": scenario_breaker_storm,
+    "prefix_owner_death": scenario_prefix_owner_death,
     "surge_100": scenario_surge_100,
 }
 

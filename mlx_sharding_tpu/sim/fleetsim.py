@@ -274,6 +274,14 @@ class FleetSim:
                 inject("cache.prefix_lookup", probe="sim")
             except Exception:  # noqa: BLE001 — degrade to plain prefill
                 rec["degradations"].append("prefix_lookup_fault")
+            # the pod-federated prefix consult: a local miss pulls the
+            # owner's blob over the fabric (PodPrefixFederation.fetch);
+            # any fault there also degrades to plain prefill — the stream
+            # is never wrong and never drops
+            try:
+                inject("pod.prefix_fetch", digest="sim")
+            except Exception:  # noqa: BLE001 — plain prefill
+                rec["degradations"].append("prefix_fetch_fault")
         if two_phase:
             try:
                 inject("disagg.handoff", n_bytes=0)

@@ -50,6 +50,11 @@ Sites wired into the serving stack:
   point in ``PodHandoff.serve_remote``, before any wire work; ctx
   ``n_bytes=<block payload>`` (raise here to force the origin's local
   plan — serve-in-place with the block intact, never a dropped stream)
+- ``pod.prefix_fetch``    — top of ``PodPrefixFederation.fetch``, before
+  the pod-view owner lookup; ctx ``digest=<hex>`` (raise here to prove a
+  sick federation degrades to plain prefill — counted in
+  ``stats()["fallbacks"]["fetch_fault"]``, the stream is never wrong and
+  never drops)
 - ``spec.draft``          — before each speculative round's draft
   proposals (n-gram lookup or draft-engine forward); ctx
   ``engine=id(batcher)`` (raise here to prove a sick draft source

@@ -226,6 +226,10 @@ class ServingMetrics:
     # None on every single-host deployment, which keeps the single-host
     # exposition byte-identical (no host labels, no pod families)
     pod_stats_fn: object = None
+    # zero-arg callable returning the layer-wise KV sharing summary
+    # (kv_share.py; provider.kv_share_stats()) or None when no share map
+    # is configured — unset keeps the exposition free of share families
+    kv_share_fn: object = None
 
     def record_request(
         self,
@@ -801,6 +805,29 @@ class ServingMetrics:
                     f'mst_prefix_store_faults_total{{kind="import"}} '
                     f"{pstats['import_faults']}",
                 ]
+            # layer-wise KV sharing (kv_share.py, KVSharer): share-group
+            # geometry and the pool bytes the calibrated map removed —
+            # only when a share map is configured (kv_share_fn unset keeps
+            # the exposition free of the families)
+            try:
+                share = (
+                    self.kv_share_fn()
+                    if self.kv_share_fn is not None
+                    else None
+                )
+            except Exception:  # noqa: BLE001 — scrapes must never 500
+                share = None
+            if share is not None:
+                lines += [
+                    "# TYPE mst_kv_share_enabled gauge",
+                    f"mst_kv_share_enabled "
+                    f"{int(bool(share.get('enabled')))}",
+                    "# TYPE mst_kv_share_groups gauge",
+                    f"mst_kv_share_groups {share.get('groups', 0)}",
+                    "# TYPE mst_kv_share_bytes_saved gauge",
+                    f"mst_kv_share_bytes_saved "
+                    f"{share.get('bytes_saved', 0)}",
+                ]
             # pod fleet (pod.py): host-labeled size/weights/heartbeat from
             # the gossip view plus handoff and autoscaler counters — only
             # on --pod deployments (pod_stats_fn unset keeps single-host
@@ -887,6 +914,47 @@ class ServingMetrics:
                             f'mst_pod_handoff_ms{{quantile="0.99"}} '
                             f"{ho['ms_p99']:.3f}",
                         ]
+                    # pod-federated prefix store (PodPrefixFederation):
+                    # gossiped inventory size, remote-hit fetch traffic,
+                    # and the by-kind degradations to plain prefill — only
+                    # when the pod federates a store
+                    pp = pod.get("prefix")
+                    if pp is not None:
+                        lines += [
+                            "# TYPE mst_prefix_pod_inventory_keys gauge",
+                            f"mst_prefix_pod_inventory_keys "
+                            f"{pp.get('inventory_keys', 0)}",
+                            "# TYPE mst_prefix_pod_hits_total counter",
+                            f"mst_prefix_pod_hits_total "
+                            f"{pp.get('hits', 0)}",
+                            "# TYPE mst_prefix_pod_fetches_total counter",
+                            f"mst_prefix_pod_fetches_total "
+                            f"{pp.get('fetches', 0)}",
+                            "# TYPE mst_prefix_pod_fetch_bytes_total "
+                            "counter",
+                            f"mst_prefix_pod_fetch_bytes_total "
+                            f"{pp.get('fetch_bytes', 0)}",
+                            "# TYPE mst_prefix_pod_fallbacks_total counter",
+                        ]
+                        pfb = pp.get("fallbacks") or {}
+                        if pfb:
+                            lines += [
+                                f'mst_prefix_pod_fallbacks_total'
+                                f'{{kind="{kind}"}} {pfb[kind]}'
+                                for kind in sorted(pfb)
+                            ]
+                        else:
+                            # a bare # TYPE with no samples is invalid
+                            # exposition — emit the zero explicitly
+                            lines.append("mst_prefix_pod_fallbacks_total 0")
+                        if pp.get("fetch_ms_p50") is not None:
+                            lines += [
+                                "# TYPE mst_prefix_pod_fetch_ms summary",
+                                f'mst_prefix_pod_fetch_ms{{quantile="0.5"}} '
+                                f"{pp['fetch_ms_p50']:.3f}",
+                                f'mst_prefix_pod_fetch_ms{{quantile="0.99"}} '
+                                f"{pp['fetch_ms_p99']:.3f}",
+                            ]
             except Exception:  # noqa: BLE001 — scrapes must never 500
                 del lines[pmark:]
         return "\n".join(_finalize(lines)) + "\n"

@@ -1,0 +1,12 @@
+"""MST115: a pod prefix-federation consult inside a tick-hot function —
+fetch() blocks on a cross-host blob transfer bounded only by its
+timeout, stalling every live slot's decode behind a peer; start it from
+the waiting-queue pass on its own daemon thread and let admission read
+the per-request flag."""
+
+
+# mst: hot-path
+def tick_with_federation_fetch(store, digest):
+    if store.federation.fetch(digest):
+        return True
+    return False

@@ -86,3 +86,24 @@ def test_shard_tool_cli(ckpt, tmp_path):
     # meaningless but the load path must still work end-to-end. It should fail
     # cleanly or produce output; either way no traceback-free crash:
     assert "Traceback" not in r.stderr or r.returncode != 0
+
+
+@pytest.mark.slow  # subprocess CLI sweep — test_generate_cli keeps the quick signal
+def test_kv_share_calibrate_cli(ckpt, tmp_path):
+    """The offline KVSharer calibration path (ISSUE 19): checkpoint in,
+    validated share-map artifact out, loadable by the engine loader."""
+    out = str(tmp_path / "share_map.json")
+    r = _run(
+        ["-m", "mlx_sharding_tpu.cli.kv_share_calibrate", "--model", ckpt,
+         "--num-share", "2", "--output", out]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "2 groups" in r.stdout and "50.0%" in r.stdout
+    doc = json.loads(Path(out).read_text())
+    assert doc["format"] == "mst-kv-share-map-v1"
+    assert doc["num_layers"] == 4 and max(doc["group_of"]) + 1 == 2
+    assert doc["share_hash"]
+    assert doc["meta"]["calibration"]["pairs"]
+    from mlx_sharding_tpu.kv_share import load_share_map
+
+    assert load_share_map(out, num_layers=4).share_hash == doc["share_hash"]

@@ -1,0 +1,294 @@
+"""KVSharer layer-wise KV sharing (ISSUE 19, arXiv:2410.18517).
+
+The load-bearing properties: (1) an identity share map is a no-op — the
+pool layout is byte-identical to unshared (``share_hash is None``) and
+greedy streams are bit-identical with the map on or off; (2) a
+non-identity map physically allocates ONE (k, v) buffer per share group,
+cutting pool bytes by exactly ``1 - groups/layers`` while decode still
+serves every stream; (3) the share-map layout identity (``share_hash``)
+joins every KV export/import integrity check and the prefix store's
+write-once binding, so two hosts with different layouts can never
+exchange byte-compatible-but-wrong blocks; (4) calibration ranks layer
+pairs most-dissimilar-first (KVSharer's safety ordering) and the saved
+artifact round-trips, rejecting hand-edited hashes.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from mlx_sharding_tpu.cache import KVCache
+from mlx_sharding_tpu.config import LlamaConfig
+from mlx_sharding_tpu.kv_share import (
+    KVShareMap,
+    ShareMapError,
+    calibrate_share_map,
+    load_share_map,
+    rank_layer_pairs,
+)
+from mlx_sharding_tpu.kv_transfer import (
+    BlockIntegrityError,
+    export_block,
+    import_block,
+)
+from mlx_sharding_tpu.models.llama import LlamaModel
+from mlx_sharding_tpu.parallel.mesh import make_mesh
+from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+from mlx_sharding_tpu.prefix_store import PrefixStore
+from mlx_sharding_tpu.scheduler import ContinuousBatcher
+
+TINY = dict(vocab_size=256, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2)
+
+PAGE = 8
+PROMPT = [7, 7, 2, 1, 9, 4, 4, 6, 3, 17, 42, 5, 11, 2, 2, 8, 5]
+
+
+# ------------------------------------------------------------- map algebra
+def test_share_map_canonicalizes_group_ids():
+    a = KVShareMap(4, (2, 0, 2, 0))
+    b = KVShareMap(4, (0, 1, 0, 1))
+    assert a == b and a.share_hash == b.share_hash
+    assert a.group_of == (0, 1, 0, 1)
+    assert a.num_groups == 2
+    assert a.owner_layers == (0, 1)
+    assert a.owner_mask == (True, True, False, False)
+    assert a.bytes_saved_fraction() == 0.5
+
+
+def test_identity_map_is_unshared_layout():
+    m = KVShareMap.identity(4)
+    assert m.is_identity
+    assert m.share_hash is None  # legacy blocks compose, no flag-day
+    assert m.bytes_saved_fraction() == 0.0
+    shared = KVShareMap(4, (0, 0, 1, 2))
+    assert not shared.is_identity and shared.share_hash is not None
+
+
+def test_from_pairs_union_find_chains():
+    m = KVShareMap.from_pairs(6, [(0, 3), (3, 5), (1, 4)])
+    assert m.group_of[0] == m.group_of[3] == m.group_of[5]
+    assert m.group_of[1] == m.group_of[4]
+    assert m.num_groups == 3
+    with pytest.raises(ShareMapError):
+        KVShareMap.from_pairs(4, [(0, 9)])
+
+
+def test_validate_for_wrong_stage_split():
+    with pytest.raises(ShareMapError, match="recalibrate"):
+        KVShareMap(4, (0, 0, 1, 2)).validate_for(2)
+
+
+def test_save_load_round_trip_and_tamper_rejection(tmp_path):
+    m = KVShareMap(4, (0, 0, 1, 2), meta={"note": "t"})
+    p = tmp_path / "share.json"
+    m.save(str(p))
+    back = KVShareMap.load(str(p))
+    assert back == m and back.share_hash == m.share_hash
+    assert back.meta["note"] == "t"
+    doc = json.loads(p.read_text())
+    doc["group_of"] = [0, 1, 1, 2]  # hand-edit under the stamped hash
+    p.write_text(json.dumps(doc))
+    with pytest.raises(ShareMapError, match="recalibrate"):
+        KVShareMap.load(str(p))
+    p.write_text("{not json")
+    with pytest.raises(ShareMapError, match="not readable JSON"):
+        KVShareMap.load(str(p))
+    p.write_text(json.dumps({"format": "something-else"}))
+    with pytest.raises(ShareMapError, match="artifact"):
+        KVShareMap.load(str(p))
+    assert load_share_map(None) is None
+    m.save(str(p))
+    assert load_share_map(str(p), num_layers=4) == m
+    with pytest.raises(ShareMapError):
+        load_share_map(str(p), num_layers=8)
+
+
+# -------------------------------------------------------------- calibration
+def _calib_buffers():
+    """(L=4, B=1, S=8, H=2, D=4) dense buffers where layers 0/1 are
+    near-identical and layers 2/3 point opposite ways — the dissimilar
+    (safe-to-share) pairs all involve layer 3."""
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal((1, 8, 2, 4)).astype(np.float32)
+    k = np.stack([base, base + 1e-3, base * 0.5, -base])
+    v = np.stack([base, base + 1e-3, base * 0.5, -base])
+    return k, v
+
+
+def test_rank_layer_pairs_most_dissimilar_first():
+    k, v = _calib_buffers()
+    ranked = rank_layer_pairs(k, v)
+    assert len(ranked) == 6
+    assert all(ranked[i][1] >= ranked[i + 1][1] for i in range(5))
+    # the anti-aligned pairs (all involving layer 3) rank above the
+    # aligned layer-0/1/2 cluster, whose dissimilarity is ~0
+    assert ranked[0][0][1] == 3
+    assert 3 not in ranked[-1][0] and ranked[-1][1] < 1e-3
+
+
+def test_calibrate_merges_dissimilar_pairs_under_group_cap():
+    k, v = _calib_buffers()
+    m = calibrate_share_map(k, v, num_share=1)
+    assert m.num_groups == 3
+    merged = [i for i in range(4) if not m.owner_mask[i]]
+    assert len(merged) == 1  # exactly one layer reads through its group
+    cal = m.meta["calibration"]
+    assert len(cal["pairs"]) == 1 and len(cal["dissimilarity"]) == 1
+    # max_group=2 forces disjoint pairs: 2 merges -> 2 groups of 2
+    m2 = calibrate_share_map(k, v, num_share=2)
+    assert m2.num_groups == 2
+    assert sorted(m2.group_of).count(0) == 2
+    with pytest.raises(ShareMapError):
+        calibrate_share_map(k, v, num_share=4)  # > L-1
+    with pytest.raises(ShareMapError):
+        calibrate_share_map(k, v, num_share=1, max_group=1)
+
+
+# ----------------------------------------------- export/import layout joins
+def _cache_and_block(share_hash=None):
+    shape = (1, 2, 4, 1, PAGE, 2, 4)
+    vals = jnp.arange(np.prod(shape), dtype=jnp.float32).reshape(shape)
+    cache = KVCache(k=vals, v=vals + 1000.0, offset=jnp.zeros((), jnp.int32))
+    block = export_block(
+        cache, [0, 1], page_size=PAGE, n_tokens=2 * PAGE,
+        prompt=PROMPT[:-1], history=[], produced=0,
+        resume_keys=None, resume_recent=None, share_hash=share_hash,
+    ).to_host()
+    return cache, block
+
+
+def test_block_round_trip_preserves_share_hash():
+    _, block = _cache_and_block(share_hash="aa55")
+    back = type(block).from_bytes(block.to_bytes())
+    assert back.share_hash == "aa55"
+
+
+def test_import_rejects_share_layout_mismatch():
+    cache, block = _cache_and_block(share_hash="aa55")
+    with pytest.raises(BlockIntegrityError, match="--kv-share-map"):
+        import_block(cache, block, [0, 1], share_hash=None)
+    with pytest.raises(BlockIntegrityError, match="layout mismatch"):
+        import_block(cache, block, [0, 1], share_hash="bb66")
+    # matching layouts import fine
+    import_block(cache, block, [0, 1], share_hash="aa55")
+
+
+def test_prefix_store_share_hash_binding():
+    store = PrefixStore(host_bytes=1 << 20)
+    store.bind_page_size(PAGE)
+    store.bind_share_hash("aa55")
+    store.bind_share_hash("aa55")  # idempotent re-bind
+    with pytest.raises(ValueError, match="cannot share"):
+        store.bind_share_hash("bb66")
+    # a block exported under another layout is refused (degrades to
+    # re-prefill), never resident-but-unimportable
+    digest = store.digests_for(PROMPT)[-1]
+    _, block = _cache_and_block(share_hash="bb66")
+    assert store.host_put(digest, block) is False
+    assert store.stats()["demote_drops"] == 1
+    _, good = _cache_and_block(share_hash="aa55")
+    assert store.host_put(digest, good) is True
+    store.close()
+
+
+def test_prefix_store_first_bind_rejects_stale_resident_blocks():
+    store = PrefixStore(host_bytes=1 << 20)
+    store.bind_page_size(PAGE)
+    digest = store.digests_for(PROMPT)[-1]
+    _, block = _cache_and_block(share_hash=None)
+    assert store.host_put(digest, block) is True
+    with pytest.raises(ValueError, match="--kv-share-map"):
+        store.bind_share_hash("aa55")
+    store.close()
+
+
+# ------------------------------------------------------------ engine wiring
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = LlamaModel(LlamaConfig(**TINY))
+    params = model.init_params(jax.random.PRNGKey(0), jnp.float32)
+    return model, params
+
+
+def _mk_engine(tiny_model, dev_idx, share_map=None, pool_pages=10):
+    model, params = tiny_model
+    devices = jax.devices()
+    return PipelineEngine(
+        model, params, make_mesh(pp=1, devices=devices[dev_idx:dev_idx + 1]),
+        microbatches=2, max_seq=64, cache_dtype=jnp.float32,
+        prefill_chunk=8, pool_pages=pool_pages, page_size=PAGE,
+        kv_share_map=share_map,
+    )
+
+
+def test_engine_rejects_share_map_without_pool(tiny_model):
+    model, params = tiny_model
+    with pytest.raises(ValueError, match="paged"):
+        PipelineEngine(
+            model, params,
+            make_mesh(pp=1, devices=jax.devices()[:1]),
+            microbatches=2, max_seq=64, cache_dtype=jnp.float32,
+            kv_share_map=KVShareMap(2, (0, 0)),
+        )
+
+
+def test_engine_rejects_share_map_on_stage_split(tiny_model):
+    model, params = tiny_model
+    with pytest.raises(ValueError, match="pp=1"):
+        PipelineEngine(
+            model, params, make_mesh(pp=2, devices=jax.devices()[:2]),
+            microbatches=2, max_seq=64, cache_dtype=jnp.float32,
+            prefill_chunk=8, pool_pages=10, page_size=PAGE,
+            kv_share_map=KVShareMap(2, (0, 0)),
+        )
+
+
+def test_identity_map_greedy_parity_and_stats(tiny_model):
+    """Acceptance: the identity map changes NOTHING — same bytes, same
+    greedy tokens as no map at all."""
+    b_plain = ContinuousBatcher(_mk_engine(tiny_model, 0), decode_block=3)
+    b_ident = ContinuousBatcher(
+        _mk_engine(tiny_model, 1, share_map=KVShareMap.identity(2)),
+        decode_block=3)
+    try:
+        ref = [t for t, _ in b_plain.generate_step(PROMPT, max_tokens=16)]
+        got = [t for t, _ in b_ident.generate_step(PROMPT, max_tokens=16)]
+        assert got == ref
+        s = b_ident.engine.kv_share_stats()
+        assert s["enabled"] is False and s["share_hash"] is None
+        assert s["bytes_saved"] == 0
+    finally:
+        b_plain.close()
+        b_ident.close()
+
+
+def test_shared_map_halves_pool_bytes_and_serves(tiny_model):
+    """Acceptance: a 2-layers-into-1-group map cuts KV pool bytes by 50%
+    (>= the 25% criterion) at identical pool_pages, and decode still
+    completes every stream."""
+    eng_plain = _mk_engine(tiny_model, 2)
+    eng_shared = _mk_engine(tiny_model, 3,
+                            share_map=KVShareMap(2, (0, 0)))
+    b = ContinuousBatcher(eng_shared, decode_block=3)
+    try:
+        s = eng_shared.kv_share_stats()
+        assert s["enabled"] is True and s["groups"] == 1 and s["layers"] == 2
+        assert s["share_hash"] == KVShareMap(2, (0, 0)).share_hash
+        got = [t for t, _ in b.generate_step(PROMPT, max_tokens=16)]
+        assert len(got) == 16
+        # the physical claim, measured on the engines' own pools
+        def pool_bytes(eng):
+            c, _table = eng.init_cache_paged()
+            leaves = jax.tree_util.tree_leaves((c.k, c.v))
+            return sum(x.nbytes for x in leaves)
+        assert pool_bytes(eng_shared) * 2 == pool_bytes(eng_plain)
+        assert s["bytes_saved"] > 0
+    finally:
+        b.close()
+        eng_plain.close()
