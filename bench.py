@@ -1860,6 +1860,152 @@ def measure_kv_share_capacity(model, params, label: str) -> dict:
     return res
 
 
+def measure_kv_compressed_transport(label: str) -> dict:
+    """Compressed-latent KV transport (kv_compress.py): the bytes the
+    fleet actually moves. One KVPageBlock payload is what every
+    byte-moving path ships — disagg phase-2 handoff, KVSpillTier flush,
+    prefix-store demotion, federation blob — so this phase builds the
+    same tiny DeepSeek-V2 in both MLA cache modes (``compressed`` gets
+    the latent codec automatically, ``full`` ships raw per-head pages),
+    populates each paged pool with a real generate, then times and
+    sizes the transport primitives per mode: export+to_host (the
+    handoff/spill/demotion encode), to_bytes (the federation wire),
+    import_block (the decode-side land), and a sync KVSpillTier
+    put/take. A fault leg arms cache.compress on the latent engine and
+    records the counted ship-raw degradation. The headline is the
+    MLA-native byte ratio: same tokens, ~num_heads x fewer bytes on the
+    wire, bit-exactly."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.cache import KVCache
+    from mlx_sharding_tpu.config import DeepseekV2Config
+    from mlx_sharding_tpu.kv_transfer import (
+        KVSpillTier,
+        export_block,
+        import_block,
+    )
+    from mlx_sharding_tpu.models.deepseek_v2 import DeepseekV2Model
+    from mlx_sharding_tpu.parallel.mesh import make_mesh
+    from mlx_sharding_tpu.parallel.pipeline import PipelineEngine
+    from mlx_sharding_tpu.scheduler import ContinuousBatcher
+    from mlx_sharding_tpu.testing import faults
+
+    page_size = 8
+    pool_pages = 10
+    pages = [1, 2, 3, 4]
+    n_tok = len(pages) * page_size
+    reps = 15
+
+    def build(mode: str):
+        cfg = DeepseekV2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=16, num_hidden_layers=4,
+            num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=16,
+            q_lora_rank=None, qk_rope_head_dim=8, qk_nope_head_dim=16,
+            v_head_dim=12, n_routed_experts=4, n_shared_experts=1,
+            num_experts_per_tok=2, first_k_dense_replace=1,
+            mla_cache_mode=mode,
+        )
+        model = DeepseekV2Model(cfg)
+        params = model.init_params(jax.random.PRNGKey(7), jnp.float32)
+        eng = PipelineEngine(
+            model, params, make_mesh(pp=1, devices=jax.devices()[:1]),
+            microbatches=2, max_seq=64, cache_dtype=jnp.float32,
+            prefill_chunk=8, pool_pages=pool_pages, page_size=page_size,
+        )
+        return eng, ContinuousBatcher(eng, decode_block=3)
+
+    def run(mode: str) -> dict:
+        eng, batcher = build(mode)
+        try:
+            prompt = [int(x) for x in
+                      np.random.default_rng(9).integers(1, 100, 24)]
+            for _ in batcher.generate_step(prompt, max_tokens=page_size):
+                pass  # leaves real KV in the pool pages
+            codec = eng.kv_codec
+            cache = batcher.cache
+            kw = dict(page_size=page_size, n_tokens=n_tok,
+                      prompt=prompt[:3], history=[1] * (n_tok - 3),
+                      produced=n_tok - 3, resume_keys=None,
+                      resume_recent=None, codec=codec)
+            dst = KVCache(k=jax.tree.map(jnp.zeros_like, cache.k),
+                          v=jax.tree.map(jnp.zeros_like, cache.v),
+                          offset=jnp.zeros((), jnp.int32))
+            exp_ms, imp_ms, wire_ms, spill_ms = [], [], [], []
+            blk = wire = None
+            tier = KVSpillTier(64 << 20, flush_async=False)
+            for i in range(reps):
+                t0 = time.perf_counter()
+                blk = export_block(cache, pages, **kw).to_host()
+                exp_ms.append((time.perf_counter() - t0) * 1e3)
+                t0 = time.perf_counter()
+                wire = blk.to_bytes()
+                wire_ms.append((time.perf_counter() - t0) * 1e3)
+                t0 = time.perf_counter()
+                import_block(dst, blk, pages, codec=codec)
+                imp_ms.append((time.perf_counter() - t0) * 1e3)
+                t0 = time.perf_counter()
+                tier.put(f"b{i}", export_block(cache, pages, **kw))
+                tier.take(f"b{i}")
+                spill_ms.append((time.perf_counter() - t0) * 1e3)
+            ts = tier.stats()
+            tier.close()
+            res = dict(
+                mode=mode,
+                compress_kind=blk.compress_kind,
+                block_host_bytes=int(blk.nbytes),
+                wire_bytes=len(wire),
+                wire_bytes_per_token=round(len(wire) / n_tok, 1),
+                handoff_export_p50_ms=round(statistics.median(exp_ms), 3),
+                handoff_import_p50_ms=round(statistics.median(imp_ms), 3),
+                federation_wire_p50_ms=round(statistics.median(wire_ms), 3),
+                spill_put_take_p50_ms=round(statistics.median(spill_ms), 3),
+                spill_bytes_compress_saved=int(
+                    ts.get("bytes_compress_saved", 0)),
+            )
+            if codec is not None:
+                # exactness + fault legs ride the latent engine only
+                a = import_block(dst, blk, pages, codec=codec)
+                b = import_block(dst, export_block(
+                    cache, pages, **dict(kw, codec=None)).to_host(), pages)
+                res["bit_exact"] = all(
+                    np.array_equal(np.asarray(x), np.asarray(y))
+                    for x, y in zip(jax.tree.leaves((a.k, a.v)),
+                                    jax.tree.leaves((b.k, b.v))))
+                faults.arm("cache.compress", exc=faults.FaultError, times=1)
+                raw = export_block(cache, pages, **kw).to_host()
+                faults.disarm()
+                res["fault_leg"] = dict(
+                    shipped_kind=raw.compress_kind,  # None: shipped RAW
+                    compress_faults=codec.stats()["compress_faults"],
+                )
+            return res
+        finally:
+            batcher.close()
+
+    latent = run("compressed")
+    full = run("full")
+    ratio = round(full["wire_bytes"] / max(latent["wire_bytes"], 1), 2)
+    res = dict(
+        label=label, tokens_moved=n_tok,
+        compressed=latent, full=full,
+        mla_native_byte_reduction_x=ratio,
+    )
+    log(f"[{label}] kv compressed transport: {n_tok} tokens move "
+        f"{latent['wire_bytes']}B latent vs {full['wire_bytes']}B full "
+        f"({ratio}x fewer bytes), export p50 "
+        f"{latent['handoff_export_p50_ms']}ms vs "
+        f"{full['handoff_export_p50_ms']}ms, bit_exact="
+        f"{latent.get('bit_exact')}, fault leg shipped "
+        f"{latent.get('fault_leg', {}).get('shipped_kind')} (raw) with "
+        f"{latent.get('fault_leg', {}).get('compress_faults')} counted")
+    return res
+
+
 def measure_paged_ragged_vs_gather(model, params, label: str) -> dict:
     """The ragged paged-attention A/B (ISSUE 1 tentpole): mixed-length
     continuous batching decode through the same page pool on both paths.
@@ -2950,6 +3096,16 @@ def main() -> int:
                         error=repr(e)[:300]
                     )
                     log(f"[kv_share_capacity_cpu] FAILED: {e!r}")
+        # compressed-latent transport builds its own tiny DeepSeek-V2
+        # pair (MLA compressed vs full cache modes) — independent of the
+        # llama tiny variants above
+        try:
+            detail["kv_compressed_transport_cpu"] = (
+                measure_kv_compressed_transport("kv_compressed_transport_cpu")
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["kv_compressed_transport_cpu"] = dict(error=repr(e)[:300])
+            log(f"[kv_compressed_transport_cpu] FAILED: {e!r}")
 
     if not cpu_fallback:
         n_params = param_count(cfg_dict)
@@ -3186,6 +3342,13 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             detail["kv_share_capacity"] = dict(error=repr(e)[:300])
             log(f"[kv_share_capacity] FAILED: {e!r}")
+        try:
+            detail["kv_compressed_transport"] = (
+                measure_kv_compressed_transport("kv_compressed_transport")
+            )
+        except Exception as e:  # noqa: BLE001
+            detail["kv_compressed_transport"] = dict(error=repr(e)[:300])
+            log(f"[kv_compressed_transport] FAILED: {e!r}")
 
         # HEADLINE (BASELINE.json primary config): DeepSeek-Coder-V2-Lite at
         # its real architecture and scale — 27 layers, 64-expert MoE + 2

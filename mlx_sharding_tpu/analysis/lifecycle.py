@@ -63,6 +63,11 @@ KNOWN_FAULT_SITES = {
     # round's draft proposals — a faulted draft source must degrade that
     # tick to plain decode, counted, never a wrong or dropped stream
     "spec.draft",
+    # compressed-latent KV transport (kv_compress.py): every codec
+    # encode/decode — a faulted encode ships the block raw (counted), a
+    # faulted decode lands on the consumer's counted re-prefill path;
+    # neither may drop or corrupt a stream
+    "cache.compress",
 }
 # basename -> the inject() sites that file must keep calling (a file can
 # own more than one failure domain — the scheduler carries both the tick
@@ -77,6 +82,7 @@ REQUIRED_FAULT_SITES = {
     "disagg.py": ("disagg.handoff",),
     "prefix_store.py": ("cache.prefix_lookup",),
     "pod.py": ("pod.handoff", "pod.prefix_fetch"),
+    "kv_compress.py": ("cache.compress",),
 }
 
 

@@ -143,6 +143,19 @@
   flag; calibration is OFFLINE (``cli/kv_share_calibrate.py``) and
   serving loads the saved artifact once at startup. An intentional
   inline consult carries its own ``# mst: allow(MST115): …``.
+- **MST116 latent-reconstruct-in-tick** — a compressed-latent KV codec
+  call (``reconstruct_block`` / ``reconstruct_pages`` /
+  ``compress_pages``, kv_compress.py) inside a tick-hot function.
+  Reconstruction materializes the dense per-head pages from rank-r
+  latents — a ``(tokens, r) @ (r, H*D)`` up-projection over every page
+  of every layer, in host numpy — and compression is its transpose;
+  either inline in the tick stalls every live slot's decode behind one
+  block's matmul. The discipline: compression runs inside
+  ``KVPageBlock.to_host`` on the spill flusher / handoff threads, and
+  reconstruction runs in ``prefetch``'s overlapped host→device stage or
+  the consumer's (non-hot) import path — the tick only ever touches
+  already-dense pages. An intentional inline reconstruction carries its
+  own ``# mst: allow(MST116): …``.
 - **MST107 wall-clock-deadline** — ``time.time()`` feeding deadline or
   timeout arithmetic (an expression whose identifiers mention deadline /
   timeout / expiry / until / budget / ttft / retry_after / lease). The wall
@@ -240,6 +253,12 @@ PREFIX_INVENTORY_CALLS = {"host_inventory"}
 # saved artifact once at startup
 SHARE_CALIBRATION_CALLS = {"calibrate_share_map", "rank_layer_pairs",
                            "layer_kv_signatures", "load_share_map"}
+
+# the compressed-latent codec surface MST116 keeps out of tick-hot
+# functions: each call is a dense (tokens, r) x (r, H*D) projection over
+# every page of every layer in host numpy (kv_compress.KVCompressCodec)
+LATENT_RECONSTRUCT_CALLS = {"reconstruct_block", "reconstruct_pages",
+                            "compress_pages"}
 
 # host→device upload calls MST109 polices in tick-hot functions when their
 # argument is a spilled block's page payload (the demand-paged resume)
@@ -706,6 +725,39 @@ def _check_prefix_federation_in_tick(mod: ModuleInfo) -> list[Finding]:
     return findings
 
 
+def _check_latent_reconstruct_in_tick(mod: ModuleInfo) -> list[Finding]:
+    """MST116: a compressed-latent KV codec call inside a tick-hot
+    function. ``reconstruct_block()``/``reconstruct_pages()`` materialize
+    the dense per-head pages from rank-r latents — a ``(tokens, r) @
+    (r, H*D)`` host-numpy up-projection over every page of every layer —
+    and ``compress_pages()`` is its transpose. The discipline: compress
+    in ``to_host`` on the flusher/handoff threads, reconstruct in
+    ``prefetch``'s overlapped stage or the consumer's non-hot import
+    path; the tick only ever touches already-dense pages."""
+    findings = []
+    for fn in _hot_functions(mod):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                break  # nested defs are jit bodies; not host hot-path code
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            if name.split(".")[-1] not in LATENT_RECONSTRUCT_CALLS:
+                continue
+            findings.append(Finding(
+                "MST116", mod.display_path, node.lineno, node.col_offset,
+                f"latent reconstruction in hot path {fn.name}(): {name}() "
+                "materializes dense per-head pages from rank-r latents in "
+                "host numpy — compress in to_host on the flusher/handoff "
+                "threads, reconstruct in prefetch's overlapped stage or "
+                "the consumer's import path, never on the tick thread",
+                context=qualname_for_line(mod.tree, node.lineno),
+            ))
+    return findings
+
+
 def _spawn_hot_functions(mod: ModuleInfo) -> list[ast.FunctionDef]:
     configured = SPAWN_HOT_FUNCS.get(mod.basename, set())
     out = []
@@ -1161,6 +1213,7 @@ def check_module(mod: ModuleInfo) -> list[Finding]:
     findings += _check_block_migration(mod)
     findings += _check_control_plane_in_tick(mod)
     findings += _check_prefix_federation_in_tick(mod)
+    findings += _check_latent_reconstruct_in_tick(mod)
     findings += _check_sync_import(mod)
     findings += _check_store_import(mod)
     findings += _check_hot_trace_overhead(mod)

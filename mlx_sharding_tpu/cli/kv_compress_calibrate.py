@@ -1,0 +1,155 @@
+"""Offline low-rank KV calibration (TPLA-style, arXiv:2508.15881) — emit
+a compress-map artifact for ``--kv-compress-map``.
+
+One dense prefill per calibration prompt, the resulting KV buffers
+flattened to per-layer row matrices ``(tokens, H*D)``, and a truncated
+SVD per layer: the top-``r`` right-singular vectors become the down/up
+projection pair the serving codec (kv_compress.py) applies at every
+KV-transport boundary — spill flushes, prefix-store demotions, disagg
+handoffs, pod-federation blobs. The artifact stamps the per-layer
+relative reconstruction error over the calibration set: that number IS
+the documented parity tolerance for the lossy path (MLA-native models
+need no artifact; their latent export is exact).
+
+When the serving pool runs under a KV share map (``--kv-share-map``),
+pass the SAME artifact here: the pool stores one buffer per share group
+(written by the group's owner layer), so calibration fits one projection
+per GROUP over the owner layer's rows and stamps the share map's hash —
+kv_compress.build_codec refuses a compress map whose ``share_hash``
+doesn't match the live pool, so the two calibrations compose or neither
+loads.
+
+Calibration is OFFLINE by design: dense prefills and whole-buffer
+host marshalling are exactly the traffic mstcheck MST115/MST116 keep out
+of the serving tick.
+
+Usage::
+
+    python -m mlx_sharding_tpu.cli.kv_compress_calibrate \
+        --model path/or/hf-repo --rank 32 \
+        --prompts-file calib.txt --output compress_map.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def calibrate_model(model, params, prompts_ids, *, rank: int,
+                    share_map=None, cache_dtype=None, meta=None):
+    """Core calibration over already-tokenized prompts: one dense prefill
+    each, KV rows concatenated along the sequence axis, one per-layer SVD
+    map out. Importable so tests can calibrate a tiny model without the
+    CLI's checkpoint loading. ``share_map`` (a kv_share.KVShareMap)
+    reduces the layer axis to group owners and stamps ``share_hash``."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_sharding_tpu.kv_compress import (
+        CompressError,
+        calibrate_compress_map,
+    )
+
+    if cache_dtype is None:
+        cache_dtype = jnp.float32
+    ks, vs = [], []
+    total_tokens = 0
+    for ids in prompts_ids:
+        ids = np.asarray(ids, np.int32)
+        if ids.ndim != 1 or ids.size < 2:
+            raise CompressError(
+                "calibration prompts need >= 2 tokens each"
+            )
+        n = int(ids.size)
+        cache = model.make_cache(1, n, cache_dtype)
+        _, cache = model(params, jnp.asarray(ids)[None, :], cache,
+                         n_valid=jnp.asarray(n, jnp.int32))
+        ks.append(np.asarray(cache.k, np.float32)[:, :, :n])
+        vs.append(np.asarray(cache.v, np.float32)[:, :, :n])
+        total_tokens += n
+    k = np.concatenate(ks, axis=2)
+    v = np.concatenate(vs, axis=2)
+    share_hash = None
+    if share_map is not None and not share_map.is_identity:
+        share_map.validate_for(k.shape[0])
+        owners = list(share_map.owner_layers())
+        # the grouped pool holds the owner layer's KV for every member of
+        # its group — fit the projection on what the pool will contain
+        k, v = k[owners], v[owners]
+        share_hash = share_map.share_hash
+    info = dict(meta or {})
+    info.update({
+        "calibration_prompts": len(ks),
+        "calibration_tokens": total_tokens,
+    })
+    return calibrate_compress_map(
+        k, v, rank=rank, share_hash=share_hash, meta=info
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Calibrate a low-rank KV compress map (kv_compress)"
+    )
+    parser.add_argument("--model", required=True,
+                        help="model path or HF repo (same as generate)")
+    parser.add_argument("--rank", type=int, required=True,
+                        help="latent rank r: exported blocks ship "
+                        "(tokens, r) coefficients instead of (tokens, "
+                        "H*D) rows — bytes scale ~ r/(H*D)")
+    parser.add_argument("--kv-share-map", default=None, metavar="PATH",
+                        help="the share-map artifact the serving pool "
+                        "runs under, if any: calibrates per share GROUP "
+                        "and stamps its hash so the artifacts compose")
+    parser.add_argument("--prompts-file", default=None,
+                        help="calibration prompts, one per line (default: "
+                        "a small built-in English mix)")
+    parser.add_argument("--max-prompt-tokens", type=int, default=512)
+    parser.add_argument("--output", required=True,
+                        help="where to write the compress-map .npz "
+                        "artifact")
+    args = parser.parse_args(argv)
+
+    from transformers import AutoTokenizer
+
+    from mlx_sharding_tpu.kv_share import load_share_map
+    from mlx_sharding_tpu.loading import get_model_path, load_model
+
+    if args.prompts_file:
+        with open(args.prompts_file) as f:
+            prompts = [ln.strip() for ln in f if ln.strip()]
+    else:
+        prompts = [
+            "The quick brown fox jumps over the lazy dog.",
+            "In a distant galaxy, explorers charted unknown worlds.",
+            "Summarize the quarterly report in three bullet points.",
+        ]
+    if not prompts:
+        print("no calibration prompts", file=sys.stderr)
+        return 2
+
+    model_path = get_model_path(args.model)
+    model, params = load_model(model_path)
+    tokenizer = AutoTokenizer.from_pretrained(str(model_path))
+    ids = [
+        tokenizer.encode(p)[: args.max_prompt_tokens] for p in prompts
+    ]
+    m = calibrate_model(
+        model, params, [i for i in ids if len(i) >= 2],
+        rank=args.rank, share_map=load_share_map(args.kv_share_map),
+        meta={"model": str(args.model)},
+    )
+    m.save(args.output)
+    cal = m.meta["calibration"]
+    print(
+        f"wrote {args.output}: {m.num_layers} layers, rank {m.rank} over "
+        f"{m.num_heads}x({m.head_dim_k},{m.head_dim_v}) rows, "
+        f"max_rel_err={cal['max_rel_err']:.2e}, "
+        f"compress_hash={m.compress_hash}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

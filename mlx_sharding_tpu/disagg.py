@@ -145,6 +145,7 @@ class DisaggCoordinator:
         self._lock = make_lock("DisaggCoordinator._lock")
         self.handoffs = 0          # completed prefill→decode handoffs
         self.handoff_bytes = 0     # sum of shipped block payloads
+        self.handoffs_compressed = 0  # handoffs shipped as compressed latents
         self.store_skips = 0       # full store hits that skipped phase 1
         self.fallbacks: dict = {}  # degradation counts by kind
         self._ms: deque = deque(maxlen=handoff_window)  # DMA+control ms
@@ -300,16 +301,23 @@ class DisaggCoordinator:
                         state.block = None  # fold re-prefill stays token-exact
                         self._count("block_dropped")
             if target is self.decode:
+                # nbytes reads AFTER to_host: a compressed-latent block
+                # (kv_compress) counts its wire size — what actually moved
                 nbytes = getattr(state.block, "nbytes", 0) or 0
+                compressed = (
+                    getattr(state.block, "compress_kind", None) is not None
+                )
                 ms = (self.clock() - t0) * 1000.0
                 with self._lock:
                     self.handoffs += 1
                     self.handoff_bytes += int(nbytes)
+                    if compressed:
+                        self.handoffs_compressed += 1
                     self._ms.append(ms)
                 self._ms_hist.observe(ms)
                 if tr is not None:
                     tr.add("handoff_transfer", tp0, time.perf_counter(),
-                           bytes=int(nbytes))
+                           bytes=int(nbytes), compressed=compressed)
             elif tr is not None:
                 tr.point("handoff_fault")
             # ---- pod leg: a remote decode host may be less loaded than
@@ -391,6 +399,7 @@ class DisaggCoordinator:
             return {
                 "handoffs": self.handoffs,
                 "bytes_total": self.handoff_bytes,
+                "handoffs_compressed": self.handoffs_compressed,
                 "store_skips": self.store_skips,
                 "fallbacks": dict(self.fallbacks),
                 "ms_p50": _pct(ms, 50),

@@ -1,0 +1,655 @@
+"""Compressed-latent KV transport (TPLA-style, arXiv:2508.15881).
+
+The capacity stack's first three multipliers (int8 pages, cold-slot
+spill, pod-federated prefix store) all shrink or relocate *pool* bytes;
+every byte the fleet *moves* — spill-tier flushes, prefix-store host
+demotions, ``PodPrefixFederation.fetch`` blobs, disagg handoffs, the
+``pod.handoff`` relay — still travels as raw per-head page payloads.
+This module is the layout half of the fix: a codec that rewrites a
+``KVPageBlock``'s page payload into a compact wire form at the host
+boundary (``KVPageBlock.to_host``) and reconstructs it at import, in
+one of two modes:
+
+- **``latent`` (MLA-native, exact)** — DeepSeek-V2's
+  ``mla_cache_mode="compressed"`` pool already stores ONE shared latent
+  "head" per row (``models/deepseek_v2.py``: ``cache_num_heads() == 1``,
+  head dim ``kv_lora_rank + qk_rope_head_dim``) and a dummy all-zero V
+  buffer. The codec ships the latent K payload directly and replaces
+  every dummy-V leaf with a :class:`ZeroLeaf` geometry stub — exact and
+  bit-identical on reconstruction, at ~``num_heads×`` fewer bytes than
+  the decompressed per-head layout the same checkpoint would otherwise
+  move (and strictly fewer than its own raw serialization).
+- **``lowrank`` (calibrated, bounded error)** — for GQA models with no
+  native latent: an offline-calibrated :class:`KVCompressMap` (per-layer
+  SVD down/up projections over the flattened ``H*D`` row axis, emitted
+  by ``cli/kv_compress_calibrate.py``) projects every KV row to ``rank``
+  float16 coefficients at export and reconstructs at import. Opt-in via
+  ``--kv-compress-map`` (+ optional ``--kv-compress-rank`` truncation:
+  SVD bases are nested, so a lower rank is a slice, not a recalibration)
+  and lossy within the reconstruction tolerance stamped into the
+  artifact at calibration time. Greedy streams stay bit-identical
+  whenever the flag is off or the model is MLA-native.
+
+Layout identity: :attr:`KVCompressCodec.compress_hash` joins the block
+fingerprint exactly like ``kv_share.KVShareMap.share_hash`` does — a
+block compressed under one geometry can never reconstruct into a pool
+running another; the import fails closed with a remediation hint and
+the consumer's existing counted re-prefill fallback runs. The same hash
+rides the pod heartbeat's prefix-inventory compatibility check so
+mismatched hosts skip each other before any bytes move.
+
+Failure degradation (fault site ``cache.compress``): a compress fault
+leaves the block raw (counted — the transfer still happens, just fat);
+a reconstruct fault surfaces as the importer's integrity/fault path —
+re-prefill, never a dropped stream.
+
+Asynchrony discipline: compression runs where ``to_host`` runs (the
+spill tier's flusher thread, drain, disagg's consumer thread) and
+reconstruction runs at import/prefetch — never inside a tick-hot
+function. Materializing a dense up-projection on the tick path is an
+mstcheck violation (MST116).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from mlx_sharding_tpu.cache import is_quantized_kv
+from mlx_sharding_tpu.testing.faults import inject
+
+FORMAT = "mst-kv-compress-map-v1"
+
+# wire dtype for low-rank coefficients: the SVD truncation dominates the
+# error budget, so half-precision coefficients cost ~nothing on top and
+# halve the moved bytes again vs f32
+_WIRE_DTYPE = np.float16
+
+
+class CompressError(ValueError):
+    """A compress map/codec failed validation, doesn't fit the pool
+    geometry, or a compress/reconstruct step failed."""
+
+
+class ZeroLeaf:
+    """Geometry stub standing in for an all-zero payload leaf on the
+    wire (the MLA-native dummy V buffer). Not a numpy array on purpose:
+    ``jax.tree`` treats it as an opaque leaf, so it rides the payload
+    pytree through pickling/fingerprinting at ~0 bytes and
+    :meth:`KVCompressCodec.reconstruct_block` re-materializes the zeros
+    exactly (same shape, same dtype) at import."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+
+    def __repr__(self):  # joins the block fingerprint
+        return f"ZeroLeaf(shape={self.shape}, dtype={self.dtype.name})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ZeroLeaf)
+            and self.shape == other.shape
+            and self.dtype == other.dtype
+        )
+
+    def __reduce__(self):
+        return (ZeroLeaf, (self.shape, self.dtype.name))
+
+
+def _as_f32_rows(buf) -> np.ndarray:
+    """Host payload leaf/tree → dense float32 rows ``(..., H, D)``,
+    dequantizing int8 ``{"d", "s"}`` pairs."""
+    if is_quantized_kv(buf):
+        return np.asarray(buf["d"], np.float32) * np.asarray(
+            buf["s"], np.float32
+        )
+    return np.asarray(buf, np.float32)
+
+
+def _latent_geometry_hash(num_heads: int, k_dim: int, v_dim: int) -> str:
+    payload = f"mst-kv-latent-v1:{num_heads}:{k_dim}:{v_dim}"
+    return hashlib.blake2b(payload.encode(), digest_size=16).hexdigest()
+
+
+# ---------------------------------------------------------------- artifact
+@dataclass(frozen=True)
+class KVCompressMap:
+    """Per-layer low-rank KV projection pair, calibrated offline.
+
+    ``k_down``/``v_down`` are ``(L, H*D, r)`` down-projections applied to
+    flattened KV rows at export; ``k_up``/``v_up`` are their ``(L, r,
+    H*D)`` transposes applied at import. ``num_layers`` counts the POOL's
+    layer axis (share groups under a KVSharer map, hence the stamped
+    ``share_hash`` — the two layout artifacts compose or neither loads).
+    """
+
+    num_layers: int
+    rank: int
+    num_heads: int
+    head_dim_k: int
+    head_dim_v: int
+    k_down: np.ndarray
+    k_up: np.ndarray
+    v_down: np.ndarray
+    v_up: np.ndarray
+    share_hash: Optional[str] = None
+    meta: dict = field(default_factory=dict, compare=False, hash=False)
+
+    def __post_init__(self):
+        if self.num_layers < 1 or self.rank < 1:
+            raise CompressError(
+                f"compress map needs num_layers >= 1 and rank >= 1 "
+                f"(got {self.num_layers}, {self.rank})"
+            )
+        fk = self.num_heads * self.head_dim_k
+        fv = self.num_heads * self.head_dim_v
+        want = {
+            "k_down": (self.num_layers, fk, self.rank),
+            "k_up": (self.num_layers, self.rank, fk),
+            "v_down": (self.num_layers, fv, self.rank),
+            "v_up": (self.num_layers, self.rank, fv),
+        }
+        for name, shape in want.items():
+            arr = np.ascontiguousarray(
+                np.asarray(getattr(self, name), np.float32)
+            )
+            if arr.shape != shape:
+                raise CompressError(
+                    f"compress map {name} has shape {arr.shape}, "
+                    f"expected {shape}"
+                )
+            object.__setattr__(self, name, arr)
+        if self.rank >= fk or self.rank >= fv:
+            raise CompressError(
+                f"rank {self.rank} does not compress "
+                f"{self.num_heads}x({self.head_dim_k},{self.head_dim_v}) "
+                f"KV rows — pick rank < H*D"
+            )
+
+    # ------------------------------------------------------------ derived
+    @property
+    def compress_hash(self) -> str:
+        """Layout identity for export/import integrity checks — covers
+        geometry AND matrix bytes, so two maps with the same rank but
+        different calibrations (or a truncated map) never alias."""
+        h = hashlib.blake2b(digest_size=16)
+        h.update(
+            f"{FORMAT}:{self.num_layers}:{self.rank}:{self.num_heads}:"
+            f"{self.head_dim_k}:{self.head_dim_v}:"
+            f"share={self.share_hash}".encode()
+        )
+        for arr in (self.k_down, self.k_up, self.v_down, self.v_up):
+            h.update(np.ascontiguousarray(arr, np.float32).tobytes())
+        return h.hexdigest()
+
+    def truncate(self, rank: int) -> "KVCompressMap":
+        """Slice to a lower rank — SVD bases are nested, so truncation is
+        exact calibration at the smaller rank, no recalibration needed."""
+        if rank == self.rank:
+            return self
+        if not (1 <= rank < self.rank):
+            raise CompressError(
+                f"--kv-compress-rank {rank} must be in [1, {self.rank}] "
+                f"for this artifact (calibrated at rank {self.rank})"
+            )
+        return KVCompressMap(
+            num_layers=self.num_layers,
+            rank=rank,
+            num_heads=self.num_heads,
+            head_dim_k=self.head_dim_k,
+            head_dim_v=self.head_dim_v,
+            k_down=self.k_down[:, :, :rank],
+            k_up=self.k_up[:, :rank, :],
+            v_down=self.v_down[:, :, :rank],
+            v_up=self.v_up[:, :rank, :],
+            share_hash=self.share_hash,
+            meta=dict(self.meta, truncated_from=self.rank),
+        )
+
+    # --------------------------------------------------------- validation
+    def validate_for(
+        self,
+        num_layers: int,
+        num_heads: int,
+        head_dim_k: int,
+        head_dim_v: int,
+        share_hash: Optional[str] = None,
+    ) -> None:
+        """Pool-geometry fit check with a remediation hint."""
+        got = (num_layers, num_heads, head_dim_k, head_dim_v)
+        have = (
+            self.num_layers, self.num_heads,
+            self.head_dim_k, self.head_dim_v,
+        )
+        if got != have:
+            raise CompressError(
+                f"compress map was calibrated for pool geometry "
+                f"(layers, heads, k_dim, v_dim)={have} but this engine's "
+                f"pool is {got} — recalibrate with "
+                f"cli/kv_compress_calibrate.py against this checkpoint, "
+                f"or drop --kv-compress-map"
+            )
+        if share_hash != self.share_hash:
+            raise CompressError(
+                f"compress map was calibrated on a pool with "
+                f"share_hash={self.share_hash!r} but this engine runs "
+                f"{share_hash!r} — the two layout artifacts must be "
+                f"calibrated together (rerun cli/kv_compress_calibrate.py "
+                f"with the same --kv-share-map)"
+            )
+
+    # --------------------------------------------------------------- disk
+    def save(self, path: str) -> None:
+        header = json.dumps({
+            "format": FORMAT,
+            "num_layers": self.num_layers,
+            "rank": self.rank,
+            "num_heads": self.num_heads,
+            "head_dim_k": self.head_dim_k,
+            "head_dim_v": self.head_dim_v,
+            "share_hash": self.share_hash,
+            "compress_hash": self.compress_hash,
+            "meta": self.meta,
+        }, sort_keys=True)
+        with open(path, "wb") as f:
+            np.savez(
+                f,
+                header=np.frombuffer(header.encode(), np.uint8),
+                k_down=self.k_down, k_up=self.k_up,
+                v_down=self.v_down, v_up=self.v_up,
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "KVCompressMap":
+        try:
+            with np.load(path) as z:
+                doc = json.loads(bytes(z["header"]).decode())
+                mats = {
+                    n: np.asarray(z[n], np.float32)
+                    for n in ("k_down", "k_up", "v_down", "v_up")
+                }
+        except Exception as e:  # noqa: BLE001 — any read failure is a bad artifact
+            raise CompressError(
+                f"--kv-compress-map {path!r} is not a readable npz "
+                f"artifact: {e}"
+            ) from e
+        if not isinstance(doc, dict) or doc.get("format") != FORMAT:
+            raise CompressError(
+                f"--kv-compress-map {path!r} is not a {FORMAT} artifact "
+                f"(found format="
+                f"{doc.get('format') if isinstance(doc, dict) else type(doc).__name__!r}) "
+                f"— emit one with cli/kv_compress_calibrate.py"
+            )
+        try:
+            m = cls(
+                num_layers=int(doc["num_layers"]),
+                rank=int(doc["rank"]),
+                num_heads=int(doc["num_heads"]),
+                head_dim_k=int(doc["head_dim_k"]),
+                head_dim_v=int(doc["head_dim_v"]),
+                share_hash=doc.get("share_hash"),
+                meta=dict(doc.get("meta") or {}),
+                **mats,
+            )
+        except CompressError:
+            raise
+        except (KeyError, TypeError, ValueError) as e:
+            raise CompressError(
+                f"--kv-compress-map {path!r} is malformed: {e}"
+            ) from e
+        stamped = doc.get("compress_hash")
+        if stamped is not None and stamped != m.compress_hash:
+            raise CompressError(
+                f"--kv-compress-map {path!r} stamped compress_hash "
+                f"{stamped!r} disagrees with its own projections (hash "
+                f"{m.compress_hash!r}) — the artifact was edited; "
+                f"recalibrate instead of patching it"
+            )
+        return m
+
+
+def load_compress_map(
+    path: Optional[str], rank: Optional[int] = None
+) -> Optional[KVCompressMap]:
+    """Engine-facing loader: ``None`` path → no compression; an explicit
+    ``rank`` truncates the artifact's nested SVD basis to a cheaper
+    operating point."""
+    if not path:
+        if rank is not None:
+            raise CompressError(
+                "--kv-compress-rank needs --kv-compress-map (the rank "
+                "slices a calibrated artifact; there is nothing to "
+                "truncate without one)"
+            )
+        return None
+    m = KVCompressMap.load(path)
+    if rank is not None:
+        m = m.truncate(int(rank))
+    return m
+
+
+# ------------------------------------------------------------- calibration
+def calibrate_compress_map(
+    k,
+    v,
+    *,
+    rank: int,
+    valid_tokens: Optional[int] = None,
+    share_hash: Optional[str] = None,
+    meta: Optional[dict] = None,
+) -> KVCompressMap:
+    """Per-layer truncated SVD over flattened KV rows.
+
+    ``k``/``v`` are dense calibration buffers ``(L, B, S, H, D)``
+    (cache.py layout) after a calibration prefill; ``valid_tokens`` trims
+    right-padding before fitting. Each layer's rows ``(B*S, H*D)`` get an
+    orthonormal rank-``r`` basis from the top right-singular vectors;
+    ``down = V_r`` and ``up = V_r^T``, so reconstruction is the orthogonal
+    projection onto the calibration row space. The per-layer relative
+    reconstruction error over the calibration set is stamped into
+    ``meta["calibration"]`` — the documented parity tolerance."""
+    k = np.asarray(k, np.float32)
+    v = np.asarray(v, np.float32)
+    if k.ndim != 5 or v.ndim != 5:
+        raise CompressError(
+            f"calibration buffers must be (L, B, S, H, D); got "
+            f"k{k.shape} v{v.shape}"
+        )
+    if valid_tokens is not None:
+        k = k[:, :, :valid_tokens]
+        v = v[:, :, :valid_tokens]
+    L, _, _, H, Dk = k.shape
+    Dv = v.shape[-1]
+
+    def fit(buf, feat):
+        downs, ups, errs = [], [], []
+        for layer in range(L):
+            rows = buf[layer].reshape(-1, feat)
+            # economy SVD of the row matrix; V_r spans the best rank-r
+            # row subspace in Frobenius norm (Eckart–Young)
+            _, _, vt = np.linalg.svd(rows, full_matrices=False)
+            basis = vt[:rank].T  # (feat, r)
+            downs.append(basis)
+            ups.append(basis.T)
+            recon = (rows @ basis) @ basis.T
+            denom = max(float(np.linalg.norm(rows)), 1e-12)
+            errs.append(float(np.linalg.norm(rows - recon) / denom))
+        return np.stack(downs), np.stack(ups), errs
+
+    if not (1 <= rank < min(H * Dk, H * Dv)):
+        raise CompressError(
+            f"rank must be in [1, {min(H * Dk, H * Dv) - 1}] for "
+            f"{H}x({Dk},{Dv}) KV rows (got {rank})"
+        )
+    k_down, k_up, k_err = fit(k, H * Dk)
+    v_down, v_up, v_err = fit(v, H * Dv)
+    info = dict(meta or {})
+    info["calibration"] = {
+        "rank": rank,
+        "k_rel_err": k_err,
+        "v_rel_err": v_err,
+        "max_rel_err": max(k_err + v_err),
+        "rows_per_layer": int(np.prod(k.shape[1:3])),
+    }
+    return KVCompressMap(
+        num_layers=L, rank=rank, num_heads=H,
+        head_dim_k=Dk, head_dim_v=Dv,
+        k_down=k_down, k_up=k_up, v_down=v_down, v_up=v_up,
+        share_hash=share_hash, meta=info,
+    )
+
+
+# ------------------------------------------------------------------- codec
+class KVCompressCodec:
+    """Pool-side compress/reconstruct engine for ``KVPageBlock`` payloads.
+
+    Built once per engine (``parallel/pipeline.py``) from the pool's
+    geometry; threaded by the scheduler into every export/import boundary.
+    ``mode`` is ``"latent"`` (MLA-native, exact, auto-detected) or
+    ``"lowrank"`` (calibrated map, opt-in, bounded error). Counters are
+    the ``mst_kv_compress_*`` observability surface; they are updated off
+    the tick path only (flusher/import threads), under ``_lock``."""
+
+    def __init__(
+        self,
+        mode: str,
+        *,
+        compress_map: Optional[KVCompressMap] = None,
+        num_heads: int = 1,
+        head_dim_k: int = 0,
+        head_dim_v: int = 0,
+    ):
+        if mode not in ("latent", "lowrank"):
+            raise CompressError(f"unknown codec mode {mode!r}")
+        if mode == "lowrank" and compress_map is None:
+            raise CompressError("lowrank codec needs a compress map")
+        self.mode = mode
+        self.map = compress_map
+        self.num_heads = int(num_heads)
+        self.head_dim_k = int(head_dim_k)
+        self.head_dim_v = int(head_dim_v)
+        self.compress_hash = (
+            compress_map.compress_hash
+            if mode == "lowrank"
+            else _latent_geometry_hash(num_heads, head_dim_k, head_dim_v)
+        )
+        self._lock = threading.Lock()
+        self.blocks_compressed = 0
+        self.blocks_reconstructed = 0
+        self.compress_faults = 0
+        self.reconstruct_faults = 0
+        self.bytes_raw_total = 0
+        self.bytes_wire_total = 0
+
+    # ---------------------------------------------------------- accounting
+    def _note(self, raw: int, wire: int) -> None:
+        with self._lock:
+            self.blocks_compressed += 1
+            self.bytes_raw_total += int(raw)
+            self.bytes_wire_total += int(wire)
+
+    def note_fault(self, op: str) -> None:
+        with self._lock:
+            if op == "encode":
+                self.compress_faults += 1
+            else:
+                self.reconstruct_faults += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "compress_hash": self.compress_hash,
+                "rank": self.map.rank if self.map is not None else None,
+                "blocks_compressed": self.blocks_compressed,
+                "blocks_reconstructed": self.blocks_reconstructed,
+                "compress_faults": self.compress_faults,
+                "reconstruct_faults": self.reconstruct_faults,
+                "bytes_raw_total": self.bytes_raw_total,
+                "bytes_wire_total": self.bytes_wire_total,
+                "bytes_saved_total": (
+                    self.bytes_raw_total - self.bytes_wire_total
+                ),
+            }
+
+    # ------------------------------------------------------------ compress
+    @staticmethod
+    def _tree_bytes(tree) -> int:
+        import jax
+
+        return sum(
+            0 if isinstance(leaf, ZeroLeaf)
+            else int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(tree)
+        )
+
+    def compress_pages(self, k_pages, v_pages) -> tuple:
+        """Host payload trees → ``(kind, k_wire, v_wire)``. Runs at the
+        ``to_host`` boundary (flusher/drain/handoff threads — never
+        tick-hot). Fault site ``cache.compress`` (op="encode") models a
+        failed compression; the caller keeps the raw payload and counts
+        the degradation — the block still moves, just uncompressed."""
+        import jax
+
+        inject("cache.compress", op="encode", mode=self.mode)
+        raw = self._tree_bytes((k_pages, v_pages))
+        if self.mode == "latent":
+            # the pool ALREADY stores the shared latent in k; v is the
+            # dummy zeros buffer MLA never reads — ship geometry, not bytes
+            k_wire = k_pages
+            v_wire = jax.tree.map(
+                lambda leaf: ZeroLeaf(leaf.shape, np.asarray(leaf).dtype),
+                v_pages,
+            )
+            self._note(raw, self._tree_bytes((k_wire, v_wire)))
+            return "latent", k_wire, v_wire
+        m = self.map
+
+        def down(buf, mats, feat):
+            rows = _as_f32_rows(buf)  # (S, L, P, B, page, H, D)
+            if rows.ndim != 7:
+                raise CompressError(
+                    f"lowrank compress wants 7-D pool page leaves; got "
+                    f"{rows.shape}"
+                )
+            flat = rows.reshape(rows.shape[:5] + (feat,))
+            return np.einsum(
+                "slpbtf,lfr->slpbtr", flat, mats, optimize=True
+            ).astype(_WIRE_DTYPE)
+
+        k_wire = down(k_pages, m.k_down, m.num_heads * m.head_dim_k)
+        v_wire = down(v_pages, m.v_down, m.num_heads * m.head_dim_v)
+        self._note(raw, self._tree_bytes((k_wire, v_wire)))
+        return "lowrank", k_wire, v_wire
+
+    # --------------------------------------------------------- reconstruct
+    def reconstruct_pages(self, kind: str, k_wire, v_wire) -> tuple:
+        """Wire trees → pool-shaped ``(k_pages, v_pages)``. Runs at
+        import/prefetch — materializing the dense up-projection inside a
+        tick-hot function is MST116. Fault site ``cache.compress``
+        (op="decode") models a failed reconstruction; importers land on
+        their counted re-prefill fallback, never a drop."""
+        import jax
+
+        inject("cache.compress", op="decode", mode=self.mode)
+        if kind == "latent":
+            if self.mode != "latent":
+                raise CompressError(
+                    "latent block reached a lowrank codec — layout "
+                    "identity check should have rejected it upstream"
+                )
+            v_pages = jax.tree.map(
+                lambda z: np.zeros(z.shape, z.dtype), v_wire,
+                is_leaf=lambda x: isinstance(x, ZeroLeaf),
+            )
+            with self._lock:
+                self.blocks_reconstructed += 1
+            return k_wire, v_pages
+        if kind != "lowrank" or self.mode != "lowrank":
+            raise CompressError(
+                f"cannot reconstruct kind={kind!r} with a "
+                f"{self.mode} codec"
+            )
+        m = self.map
+
+        def up(wire, mats, heads, dim):
+            coef = np.asarray(wire, np.float32)
+            if coef.ndim != 6 or coef.shape[-1] != m.rank:
+                raise CompressError(
+                    f"lowrank wire leaf has shape {coef.shape}; expected "
+                    f"rank-{m.rank} coefficients"
+                )
+            flat = np.einsum(
+                "slpbtr,lrf->slpbtf", coef, mats, optimize=True
+            )
+            return flat.reshape(flat.shape[:5] + (heads, dim))
+
+        k_pages = up(k_wire, m.k_up, m.num_heads, m.head_dim_k)
+        v_pages = up(v_wire, m.v_up, m.num_heads, m.head_dim_v)
+        with self._lock:
+            self.blocks_reconstructed += 1
+        return k_pages, v_pages
+
+    def reconstruct_block(self, block) -> tuple:
+        """Reconstruct a compressed :class:`KVPageBlock`'s pool payload.
+        The caller may hold the block lock; this reads the payload fields
+        it is handed via the block attributes without re-locking."""
+        return self.reconstruct_pages(
+            block.compress_kind, block.k_pages, block.v_pages
+        )
+
+
+def build_codec(
+    model,
+    *,
+    paged: bool,
+    kv_quant: bool,
+    num_stages: int,
+    pool_layers: int,
+    share_hash: Optional[str] = None,
+    compress_map: Optional[KVCompressMap] = None,
+) -> Optional[KVCompressCodec]:
+    """Engine-side codec selection (``parallel/pipeline.py``).
+
+    MLA-native pools (``mla_cache_mode="compressed"``: one shared latent
+    head) get the exact ``latent`` codec automatically — there is no
+    reason to ever move the dummy V bytes. A calibrated map opts a GQA
+    pool into ``lowrank``; geometry/layout mismatches fail closed at
+    construction with remediation hints, mirroring kv_share's checks."""
+    if not paged:
+        if compress_map is not None:
+            raise CompressError(
+                "--kv-compress-map requires a paged engine (pool_pages): "
+                "compression rides the KVPageBlock export path"
+            )
+        return None
+    hd = model.cache_head_dim()
+    k_dim, v_dim = (hd, hd) if not isinstance(hd, (tuple, list)) else hd
+    heads = model.cache_num_heads()
+    mla_native = (
+        heads == 1
+        and getattr(model.config, "mla_cache_mode", None) == "compressed"
+    )
+    if mla_native:
+        if compress_map is not None:
+            raise CompressError(
+                "--kv-compress-map is redundant on an MLA-native pool "
+                "(mla_cache_mode='compressed' already stores the latent; "
+                "export ships it exactly) — drop the flag"
+            )
+        return KVCompressCodec(
+            "latent", num_heads=heads, head_dim_k=k_dim, head_dim_v=v_dim
+        )
+    if compress_map is None:
+        return None
+    if num_stages != 1:
+        raise CompressError(
+            "--kv-compress-map requires a pp=1 engine: the per-layer "
+            "projections span the full layer stack, which a stage split "
+            "cuts"
+        )
+    if kv_quant:
+        raise CompressError(
+            "--kv-compress-map composes with bf16 pools only: int8 pages "
+            "already halve row bytes and a dequant→project→requant trip "
+            "compounds both error terms — pick one of --kv-dtype int8 or "
+            "--kv-compress-map"
+        )
+    compress_map.validate_for(
+        pool_layers, heads, k_dim, v_dim, share_hash=share_hash
+    )
+    return KVCompressCodec(
+        "lowrank",
+        compress_map=compress_map,
+        num_heads=heads,
+        head_dim_k=k_dim,
+        head_dim_v=v_dim,
+    )

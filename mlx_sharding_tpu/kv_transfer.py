@@ -71,6 +71,7 @@ from mlx_sharding_tpu.analysis.runtime import (
     note_reset,
 )
 from mlx_sharding_tpu.cache import export_pool_pages, import_pool_pages
+from mlx_sharding_tpu.kv_compress import ZeroLeaf
 from mlx_sharding_tpu.testing.faults import inject
 
 logger = logging.getLogger(__name__)
@@ -120,11 +121,26 @@ class KVPageBlock:
     # Joins the fingerprint and is re-checked at import so a block can
     # never scatter into a pool with a different layer→group layout.
     share_hash: Optional[str] = None
+    # Compressed-latent wire form (kv_compress.KVCompressCodec): when
+    # set, k_pages/v_pages hold the WIRE payload — the MLA latent with
+    # ZeroLeaf stubs for the dummy V ("latent", exact) or rank-r float16
+    # coefficients ("lowrank", calibrated) — and compress_hash names the
+    # codec geometry that can reconstruct it. Both join the fingerprint;
+    # import re-checks them so a block can never reconstruct under a
+    # different layout.
+    compress_kind: Optional[str] = None
+    compress_hash: Optional[str] = None
     checksum: Optional[str] = None
     _host: bool = False
     # device-resident (k_pages, v_pages) staged by prefetch(); consumed by
-    # payload() at import so the scatter never marshals host numpy
+    # payload() at import so the scatter never marshals host numpy. For a
+    # compressed block the staged tuple is the RECONSTRUCTED pool form —
+    # prefetch pays the up-projection off-tick so import never does.
     _staged: Optional[tuple] = None
+    # the exporting engine's codec (kv_compress.KVCompressCodec); rides
+    # the in-process block so the flusher's to_host can compress, never
+    # serialized — from_bytes receivers pass their own codec at import
+    _codec: object = None
     _lock: object = field(default_factory=lambda: make_lock("KVPageBlock._lock"), repr=False)
 
     @property
@@ -136,7 +152,8 @@ class KVPageBlock:
         """Payload size used against the spill budget (KV pages dominate;
         the sampler rows are a few hundred bytes and are not counted)."""
         return int(sum(
-            int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            0 if isinstance(leaf, ZeroLeaf)
+            else int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
             for leaf in _leaves((self.k_pages, self.v_pages))  # mst: allow(MST201): shapes/dtypes invariant across the to_host swap
         ))
 
@@ -148,24 +165,38 @@ class KVPageBlock:
     def is_prefetched(self) -> bool:
         return self._staged is not None  # mst: allow(MST201): racy read is gauge-grade; importers re-read under payload()'s lock
 
-    def prefetch(self, put=None) -> "KVPageBlock":
+    def prefetch(self, put=None, codec=None) -> "KVPageBlock":
         """Stage the host-resident page payload back onto the device ahead
         of a scheduled import (the PRESERVE-style overlap, arXiv:2501.08192):
         ``jax.device_put`` only DISPATCHES the host→device DMA, so the copy
         rides alongside the decode block in flight and the admission-time
         page scatter consumes already-device-resident arrays. Idempotent; a
         block the flusher hasn't copied to host yet needs no staging (its
-        payload never left the device). Fault site ``cache.prefetch`` models
-        a failed/refused stage — callers catch, count, and degrade to the
-        demand import (then to re-prefill), never a dropped stream."""
+        payload never left the device). A compressed block reconstructs its
+        pool-form payload here — off the tick path — so the import scatter
+        never materializes an up-projection (MST116). Fault site
+        ``cache.prefetch`` models a failed/refused stage — callers catch,
+        count, and degrade to the demand import (then to re-prefill),
+        never a dropped stream."""
         inject("cache.prefetch", n_bytes=self.nbytes)
         putfn = put if put is not None else jax.device_put
         with self._lock:
             if not self._host or self._staged is not None:
                 return self
+            if self.compress_kind is not None:
+                dec = codec if codec is not None else self._codec
+                if dec is None:
+                    # nothing local can reconstruct it; the demand import
+                    # (which carries the pool's codec) will
+                    return self
+                # a reconstruct fault propagates: the caller counts a
+                # prefetch fault and the demand path retries at import
+                k_pages, v_pages = dec.reconstruct_block(self)
+            else:
+                k_pages, v_pages = self.k_pages, self.v_pages
             self._staged = (
-                jax.tree.map(putfn, self.k_pages),
-                jax.tree.map(putfn, self.v_pages),
+                jax.tree.map(putfn, k_pages),
+                jax.tree.map(putfn, v_pages),
             )
         return self
 
@@ -201,6 +232,23 @@ class KVPageBlock:
             k, v = jax.device_get((self.k_pages, self.v_pages))
             self.k_pages = jax.tree.map(np.asarray, k)
             self.v_pages = jax.tree.map(np.asarray, v)
+            if self._codec is not None:
+                # compress at the host boundary — every downstream mover
+                # (spill tier, prefix demotion, federation blob, handoff
+                # wire) sees the wire form. A fault/codec failure leaves
+                # the block raw: counted degradation, the bytes still move
+                try:
+                    kind, kw, vw = self._codec.compress_pages(
+                        self.k_pages, self.v_pages
+                    )
+                    self.k_pages, self.v_pages = kw, vw
+                    self.compress_kind = kind
+                    self.compress_hash = self._codec.compress_hash
+                except Exception:  # noqa: BLE001 — degrade to raw, never lose the block
+                    self._codec.note_fault("encode")
+                    logger.warning(
+                        "KV compress failed; block ships raw", exc_info=True
+                    )
             if self.resume_keys is not None:
                 self.resume_keys = np.asarray(self.resume_keys)
             if self.resume_recent is not None:
@@ -218,9 +266,17 @@ class KVPageBlock:
             # unshared blocks keep the legacy header so their checksums
             # (and the pod-federated digests derived from them) are stable
             head += f":share={self.share_hash}"
+        if self.compress_kind:
+            # compressed blocks fingerprint their WIRE payload, so the
+            # checksum verifies on arrival without a codec; the kind and
+            # codec geometry are bound in so a relabeled payload fails
+            head += f":compress={self.compress_kind}:{self.compress_hash}"
         h.update(head.encode())
         for leaf in _leaves((self.k_pages, self.v_pages)):
-            h.update(np.ascontiguousarray(leaf).tobytes())
+            if isinstance(leaf, ZeroLeaf):
+                h.update(repr(leaf).encode())
+            else:
+                h.update(np.ascontiguousarray(leaf).tobytes())
         return h.hexdigest()
 
     def verify(self) -> None:
@@ -278,6 +334,8 @@ class KVPageBlock:
                 "resume_keys": self.resume_keys,
                 "resume_recent": self.resume_recent,
                 "share_hash": self.share_hash,
+                "compress_kind": self.compress_kind,
+                "compress_hash": self.compress_hash,
                 "checksum": self.checksum,
             }
         import pickle
@@ -306,6 +364,8 @@ class KVPageBlock:
                 resume_keys=payload["resume_keys"],
                 resume_recent=payload["resume_recent"],
                 share_hash=payload.get("share_hash"),
+                compress_kind=payload.get("compress_kind"),
+                compress_hash=payload.get("compress_hash"),
                 checksum=payload["checksum"],
                 _host=True,
             )
@@ -322,28 +382,38 @@ class KVPageBlock:
         """``None`` if this block's pages can be scattered into ``cache``'s
         pool; else a reason string. Catches cross-mode imports (int8 block
         into a bf16 pool and vice versa — the leaf trees differ) and any
-        per-leaf geometry mismatch outside the pool axis."""
+        per-leaf geometry mismatch outside the pool axis. Compressed
+        blocks are judged on their RECONSTRUCTED payload — import decodes
+        first and calls :func:`pages_compatible` directly."""
         with self._lock:  # consistent payload view vs a racing to_host()
-            ours = jax.tree.structure((self.k_pages, self.v_pages))
-            theirs = jax.tree.structure((cache.k, cache.v))
-            if ours != theirs:
-                return (
-                    f"KV storage mode mismatch: block {ours} vs pool {theirs}"
-                )
-            for blk, pool in zip(
-                _leaves((self.k_pages, self.v_pages)),
-                _leaves((cache.k, cache.v)),
-            ):
-                bs, ps = tuple(blk.shape), tuple(pool.shape)
-                if len(bs) != len(ps) or bs[:2] != ps[:2] or bs[3:] != ps[3:]:
-                    return (
-                        f"page geometry mismatch: block leaf {bs} vs pool {ps}"
-                    )
-                if np.dtype(blk.dtype) != np.dtype(pool.dtype):
-                    return (
-                        f"dtype mismatch: block {blk.dtype} vs pool {pool.dtype}"
-                    )
-        return None
+            return pages_compatible(self.k_pages, self.v_pages, cache)
+
+
+def pages_compatible(k_pages, v_pages, cache, check_dtype=True) -> Optional[str]:
+    """``None`` if the payload trees can be scattered into ``cache``'s
+    pool; else a reason string. ``check_dtype=False`` is the lossy-lowrank
+    import path: reconstruction yields float32 rows that the scatter casts
+    into the pool dtype (the payload was never bit-exact to begin with)."""
+    ours = jax.tree.structure((k_pages, v_pages))
+    theirs = jax.tree.structure((cache.k, cache.v))
+    if ours != theirs:
+        return (
+            f"KV storage mode mismatch: block {ours} vs pool {theirs}"
+        )
+    for blk, pool in zip(
+        _leaves((k_pages, v_pages)),
+        _leaves((cache.k, cache.v)),
+    ):
+        bs, ps = tuple(blk.shape), tuple(pool.shape)
+        if len(bs) != len(ps) or bs[:2] != ps[:2] or bs[3:] != ps[3:]:
+            return (
+                f"page geometry mismatch: block leaf {bs} vs pool {ps}"
+            )
+        if check_dtype and np.dtype(blk.dtype) != np.dtype(pool.dtype):
+            return (
+                f"dtype mismatch: block {blk.dtype} vs pool {pool.dtype}"
+            )
+    return None
 
 
 def export_block(
@@ -358,6 +428,7 @@ def export_block(
     resume_keys,
     resume_recent,
     share_hash: Optional[str] = None,
+    codec=None,
     gather=None,
     put=None,
 ) -> KVPageBlock:
@@ -366,8 +437,11 @@ def export_block(
     block holds device arrays until someone calls :meth:`to_host`.
 
     ``gather`` lets the batcher pass its jitted ``export_pool_pages``;
-    ``put`` its device-placement hook. Fault site ``cache.export`` fires
-    before any device work so an injected failure leaves the cache
+    ``put`` its device-placement hook; ``codec`` the pool's
+    ``kv_compress.KVCompressCodec`` — the block carries it so whoever
+    flushes it to host (the spill tier's flusher, drain, a handoff)
+    compresses the payload at that boundary. Fault site ``cache.export``
+    fires before any device work so an injected failure leaves the cache
     untouched."""
     inject("cache.export", n_pages=len(page_ids), n_tokens=n_tokens)
     ids = np.asarray(list(page_ids), np.int32)
@@ -398,17 +472,20 @@ def export_block(
         resume_keys=resume_keys,
         resume_recent=resume_recent,
         share_hash=share_hash,
+        _codec=codec,
     )
 
 
 def import_block(cache, block: KVPageBlock, page_ids, *, share_hash=None,
-                 scatter=None, put=None):
+                 codec=None, scatter=None, put=None):
     """Scatter ``block``'s page payloads into pool pages ``page_ids`` of
     ``cache`` and return the updated cache. Validates the block first
-    (checksum + geometry + share-map layout identity against the pool's
-    ``share_hash``); raises on any problem so the caller can release the
-    pages and fall back to re-prefill. Fault site ``cache.import`` models
-    mid-import failure."""
+    (checksum + geometry + share-map and compress layout identities
+    against the pool's ``share_hash``/``codec``); raises on any problem
+    so the caller can release the pages and fall back to re-prefill.
+    A compressed block reconstructs here (or consumes the prefetch-staged
+    reconstruction); fault sites ``cache.import`` / ``cache.compress``
+    model mid-import and mid-reconstruct failure."""
     inject("cache.import", n_pages=len(page_ids), n_tokens=block.n_tokens)
     block.verify()
     if block.share_hash != share_hash:
@@ -421,20 +498,49 @@ def import_block(cache, block: KVPageBlock, page_ids, *, share_hash=None,
             f"{share_hash!r} — re-prefill, or serve both hosts with the "
             f"same --kv-share-map artifact"
         )
-    reason = block.compatible_with(cache)
-    if reason is not None:
-        raise BlockIntegrityError(reason)
+    if block.compress_kind is not None:
+        want = codec.compress_hash if codec is not None else None
+        if block.compress_hash != want:
+            raise BlockIntegrityError(
+                f"KV compress layout mismatch: block carries a "
+                f"{block.compress_kind!r} payload under compress_hash="
+                f"{block.compress_hash!r} but this pool's codec is "
+                f"{want!r} — re-prefill, or serve both hosts with the "
+                f"same model/--kv-compress-map geometry"
+            )
     if len(page_ids) != block.n_pages:
         raise BlockIntegrityError(
             f"import wants {len(page_ids)} pages for a {block.n_pages}-page block"
         )
+    # prefetch-staged device copies when present (the overlapped path —
+    # already reconstructed for compressed blocks); otherwise the raw
+    # payload, reconstructed here — host numpy here IS the demand import
+    if block.compress_kind is not None and not block.is_prefetched:
+        try:
+            k_pages, v_pages = codec.reconstruct_block(block)
+        except Exception as e:  # noqa: BLE001 — fault or codec failure, same fallback
+            codec.note_fault("decode")
+            raise BlockIntegrityError(
+                f"compressed block reconstruction failed: {e}"
+            ) from e
+        reason = pages_compatible(
+            k_pages, v_pages, cache,
+            check_dtype=block.compress_kind == "latent",
+        )
+    elif block.compress_kind is not None:
+        k_pages, v_pages = block.payload()
+        reason = pages_compatible(
+            k_pages, v_pages, cache, check_dtype=False,
+        )
+    else:
+        reason = block.compatible_with(cache)
+        k_pages, v_pages = block.payload()
+    if reason is not None:
+        raise BlockIntegrityError(reason)
     ids = np.asarray(list(page_ids), np.int32)
     if put is not None:
         ids = put(ids)
     fn = scatter if scatter is not None else import_pool_pages
-    # prefetch-staged device copies when present (the overlapped path);
-    # otherwise the raw payload — host numpy here IS the demand import
-    k_pages, v_pages = block.payload()
     tr = tracing.current()
     if tr is not None:
         with tr.timed("kv_import", pages=len(page_ids),
@@ -462,7 +568,14 @@ class KVSpillTier:
             raise ValueError("spill budget must be a positive byte count")
         self.budget_bytes = budget_bytes
         self._blocks: "OrderedDict[object, KVPageBlock]" = OrderedDict()
+        # bytes each resident block is currently charged against the
+        # budget. A block's nbytes SHRINKS when the flusher's to_host
+        # compresses it (kv_compress), so accounting must remember what
+        # was charged at insert and re-charge after the flush — reading
+        # blk.nbytes at pop time would leak the difference forever.
+        self._sizes: dict = {}
         self._bytes = 0
+        self.bytes_compress_saved = 0
         self._lock = make_lock("KVSpillTier._lock")
         self.evictions = 0
         # rejects split by reason (the aggregate stays for back-compat):
@@ -494,9 +607,10 @@ class KVSpillTier:
 
     def _flush_loop(self):
         while True:
-            blk = self._flush_q.get()
-            if blk is None:
+            item = self._flush_q.get()
+            if item is None:
                 return
+            key, blk = item
             try:
                 blk.to_host()
             except Exception:
@@ -504,6 +618,24 @@ class KVSpillTier:
                 # still works while the arrays are alive, and verify() has
                 # no checksum to mismatch — degraded, not broken
                 logger.exception("KV spill flush failed; block stays on device")
+            else:
+                self._reaccount(key, blk)
+
+    def _reaccount(self, key, blk: KVPageBlock) -> None:
+        """Re-charge a flushed block at its post-compression size — the
+        compressed-latent wire form counts fewer bytes against the budget,
+        so the tier holds proportionally more blocks (the transfer
+        multiplier doubles as a capacity multiplier)."""
+        nb = blk.nbytes
+        with self._lock:
+            if self._blocks.get(key) is not blk:
+                return  # dropped/replaced while flushing
+            old = self._sizes.get(key, nb)
+            if nb != old:
+                self._sizes[key] = nb
+                self._bytes += nb - old
+                if nb < old:
+                    self.bytes_compress_saved += old - nb
 
     # ------------------------------------------------------------- LRU map
     def put(self, key, block: KVPageBlock) -> bool:
@@ -522,23 +654,25 @@ class KVSpillTier:
                 return False
             old = self._blocks.pop(key, None)
             if old is not None:
-                self._bytes -= old.nbytes
+                self._bytes -= self._sizes.pop(key, old.nbytes)
                 note_release("tier.block", (id(self), key))
             while self._bytes + nb > self.budget_bytes and self._blocks:
                 ek, evicted = self._blocks.popitem(last=False)
-                self._bytes -= evicted.nbytes
+                self._bytes -= self._sizes.pop(ek, evicted.nbytes)
                 self.evictions += 1
                 note_release("tier.block", (id(self), ek))
             self._blocks[key] = block
+            self._sizes[key] = nb
             self._bytes += nb
             note_acquire("tier.block", (id(self), key), nbytes=nb)
             self.bytes_spilled_total += nb
             if self._flush_async:
                 self._ensure_flusher()
         if self._flush_async:
-            self._flush_q.put(block)
+            self._flush_q.put((key, block))
         else:
             block.to_host()
+            self._reaccount(key, block)
         return True
 
     def _pop(self, key) -> Optional[KVPageBlock]:
@@ -547,7 +681,7 @@ class KVSpillTier:
         with self._lock:
             blk = self._blocks.pop(key, None)
             if blk is not None:
-                self._bytes -= blk.nbytes
+                self._bytes -= self._sizes.pop(key, blk.nbytes)
                 note_release("tier.block", (id(self), key))
             return blk
 
@@ -592,12 +726,22 @@ class KVSpillTier:
         with self._lock:
             return {b.share_hash for b in self._blocks.values()}
 
+    def compress_hashes(self) -> set:
+        """Distinct ``compress_hash`` values across resident blocks — the
+        prefix store's compress bind check reads this the same way. A
+        still-raw block (flusher hasn't compressed it yet, or no codec)
+        contributes None, which is always bind-compatible: raw payloads
+        import anywhere their geometry fits."""
+        with self._lock:
+            return {b.compress_hash for b in self._blocks.values()}
+
     def drop(self, key) -> None:
         self._pop(key)
 
     def clear(self) -> None:
         with self._lock:
             self._blocks.clear()
+            self._sizes.clear()
             self._bytes = 0
             tid = id(self)
             note_reset("tier.block", lambda k: k[0] == tid)
@@ -623,6 +767,8 @@ class KVSpillTier:
                 "misses": self.misses,
                 "hit_rate": (self.hits / lookups) if lookups else 0.0,
                 "bytes_spilled_total": self.bytes_spilled_total,
+                # budget headroom reclaimed by compressed-latent flushes
+                "bytes_compress_saved": self.bytes_compress_saved,
             }
 
     def close(self) -> None:

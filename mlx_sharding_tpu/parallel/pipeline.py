@@ -425,6 +425,7 @@ class PipelineEngine:
         paged_attention: str = "auto",
         kv_dtype: Optional[str] = None,
         kv_share_map=None,
+        kv_compress_map=None,
         weights: Optional[ResidentWeights] = None,
     ):
         cfg = model.config
@@ -619,6 +620,29 @@ class PipelineEngine:
             # (padding from uneven heterogeneous splits counts — reject
             # rather than guess which stacked slots are real)
             self.kv_share.validate_for(self.layers_per_stage)
+        # Compressed-latent KV transport (kv_compress.py): MLA-native
+        # pools get the exact latent codec automatically; a calibrated
+        # map opts a GQA pool into bounded-error lowrank. The codec rides
+        # every KVPageBlock export so spill flushes, prefix demotions,
+        # federation blobs, and handoff wires all move the compact form.
+        from mlx_sharding_tpu.kv_compress import build_codec
+
+        pool_layers = (
+            self.kv_share.num_groups if self._share_active
+            else self.layers_per_stage
+        )
+        self.kv_codec = build_codec(
+            model,
+            paged=self.paged,
+            kv_quant=self.kv_quant,
+            num_stages=self.num_stages,
+            pool_layers=pool_layers,
+            share_hash=self.kv_share_hash,
+            compress_map=kv_compress_map,
+        )
+        self.kv_compress_hash = (
+            self.kv_codec.compress_hash if self.kv_codec is not None else None
+        )
         # resources the engine holds beyond its own arrays (today: the
         # shared-weight lease release) — close() runs each exactly once
         self._close_hooks: list = []
@@ -787,6 +811,11 @@ class PipelineEngine:
             "bytes_saved": int(self.kv_share_bytes_saved),
             "share_hash": self.kv_share_hash,
         }
+
+    def kv_compress_stats(self) -> Optional[dict]:
+        """Observability surface for the ``mst_kv_compress_*`` family —
+        None when no codec is active (flag off, non-MLA model)."""
+        return self.kv_codec.stats() if self.kv_codec is not None else None
 
     # ----------------------------------------------------- vocab sharding
     def _vs_embed(self, s, vparts, ids):

@@ -840,6 +840,7 @@ class PodPrefixFederation:
                 "keys": self.store.host_inventory(),
                 "page_size": self.store.page_size,
                 "share": self.store.share_hash,
+                "compress": self.store.compress_hash,
             }
         except Exception:  # noqa: BLE001 — advertise nothing, not garbage
             return {}
@@ -855,16 +856,23 @@ class PodPrefixFederation:
         local = {
             "page_size": self.store.page_size,
             "share": self.store.share_hash,
+            "compress": self.store.compress_hash,
         }
         best = None
         stale_only = False
+        layout_only = False
         for host, entry in peers.items():
             info = (entry.get("info") or {}).get("prefix") or {}
             if hexd not in (info.get("keys") or ()):
                 continue
             if info.get("page_size") != local["page_size"] \
-                    or info.get("share") != local["share"]:
-                continue  # incompatible geometry: the fetch would fail
+                    or info.get("share") != local["share"] \
+                    or info.get("compress") != local["compress"]:
+                # incompatible geometry (page size / share map / compress
+                # layout): the fetch would fail the blob check — skip
+                # before any bytes move
+                layout_only = True
+                continue
             age = entry.get("age_s", float("inf"))
             if age > self.heartbeat_timeout_s:
                 stale_only = True
@@ -873,7 +881,9 @@ class PodPrefixFederation:
                 best = (age, host)
         if best is not None:
             return best[1], None
-        return None, ("stale_inventory" if stale_only else "miss")
+        if stale_only:
+            return None, "stale_inventory"
+        return None, ("layout_mismatch" if layout_only else "miss")
 
     # ------------------------------------------------------------ requester
     def _neg_cached(self, hexd: str) -> bool:
@@ -911,7 +921,9 @@ class PodPrefixFederation:
         owner, why = self._owner_for(hexd)
         if owner is None:
             self._count(why)
-            if why == "miss":
+            if why in ("miss", "layout_mismatch"):
+                # a mismatched layout is as durable as a miss: the peer
+                # would need a restart with new maps to become compatible
                 self._neg_add(hexd)
             return False
         with self._lock:
@@ -954,6 +966,12 @@ class PodPrefixFederation:
                 and block.page_size != self.store.page_size) \
                 or block.share_hash != self.store.share_hash:
             self._count("integrity")
+            return False
+        if block.compress_hash is not None \
+                and block.compress_hash != self.store.compress_hash:
+            # the owner lied (or re-calibrated) since its last heartbeat:
+            # the latent layout cannot be reconstructed here
+            self._count("layout_mismatch")
             return False
         if not self.store.host_put(digest, block):
             self._count("host_reject")

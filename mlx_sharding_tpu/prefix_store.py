@@ -133,6 +133,13 @@ class PrefixStore:
         # configuration error, not an import-time checksum surprise.
         self.share_hash: Optional[str] = None
         self._share_bound = False
+        # Compressed-latent codec layout the attached engines run
+        # (kv_compress.py; None == raw transport). Same write-once
+        # discipline: host-tier blocks compress under ONE geometry and the
+        # pod heartbeat gossips this hash so mismatched peers skip each
+        # other before any fetch moves bytes.
+        self.compress_hash: Optional[str] = None
+        self._compress_bound = False
         # pod federation handle (pod.PodFleet.attach_prefix_store sets it):
         # the scheduler's store-consult slow path calls federation.fetch()
         # on a local miss; None == single-host store, no pod consult
@@ -208,6 +215,39 @@ class PrefixStore:
             )
         self.share_hash = share_hash
         self._share_bound = True
+
+    def bind_compress_hash(self, compress_hash: Optional[str]):
+        """Each attaching batcher declares its pool's compressed-latent
+        codec layout (``engine.kv_compress_hash``; None == raw). Same
+        write-once contract as :meth:`bind_share_hash`: blocks compressed
+        under one geometry can only reconstruct under the same one, so a
+        mismatch is a construction error with a remediation hint, not an
+        import-time integrity surprise. Raw resident blocks (hash None)
+        are always compatible — they import anywhere their geometry fits."""
+        if self._compress_bound:
+            if self.compress_hash != compress_hash:
+                raise ValueError(
+                    f"prefix store is bound to KV compress hash "
+                    f"{self.compress_hash!r}; an engine with compress hash "
+                    f"{compress_hash!r} cannot share it — serve every "
+                    f"attached engine with the same model/--kv-compress-map "
+                    f"geometry"
+                )
+            return
+        stale = {
+            h for h in self._host.compress_hashes()
+            if h is not None and h != compress_hash
+        }
+        if stale:
+            raise ValueError(
+                f"prefix store host tier already holds blocks compressed "
+                f"under hash(es) {sorted(str(h) for h in stale)} but this "
+                f"engine binds {compress_hash!r} — restart with the "
+                f"matching --kv-compress-map artifact (or a fresh store) "
+                f"instead of changing KV layouts over resident blocks"
+            )
+        self.compress_hash = compress_hash
+        self._compress_bound = True
 
     def digests_for(self, prompt) -> list:
         """The store's digest chain for ``prompt``: page-aligned chunks,
@@ -412,6 +452,15 @@ class PrefixStore:
         different share-map layout than the bound one is refused the same
         way: degraded to re-prefill, never resident-but-unimportable."""
         if self._share_bound and block.share_hash != self.share_hash:
+            self.count_demote_drop()
+            return False
+        if (
+            self._compress_bound
+            and block.compress_hash is not None
+            and block.compress_hash != self.compress_hash
+        ):
+            # compressed under a geometry no attached engine can
+            # reconstruct — parking it would be resident-but-unimportable
             self.count_demote_drop()
             return False
         ok = self._host.put(digest, block)

@@ -608,10 +608,17 @@ class ContinuousBatcher:
         # demanded of every imported one, so a layout mismatch fails
         # closed at the edge instead of scattering wrong-geometry KV
         self._share_hash = getattr(engine, "kv_share_hash", None)
+        # the engine's compressed-latent codec + layout hash
+        # (kv_compress.py; None == raw transport) — every export carries
+        # the codec so host-boundary flushes compress, every import
+        # reconstructs under the matching layout or fails closed
+        self._kv_codec = getattr(engine, "kv_codec", None)
+        self._compress_hash = getattr(engine, "kv_compress_hash", None)
         self.prefix_store = prefix_store
         if prefix_store is not None:
             prefix_store.bind_page_size(engine.page_size)
             prefix_store.bind_share_hash(self._share_hash)
+            prefix_store.bind_compress_hash(self._compress_hash)
         # Admission accounting mode. "reserve" (default) claims a request's
         # whole page need (prompt + max_tokens) up front: deadlock-free by
         # construction, but a request that asks for max_tokens=4096 and emits
@@ -1569,7 +1576,7 @@ class ContinuousBatcher:
             with tracing.bind(req._trace):
                 self.cache = import_block(
                     self.cache, block, pages[:cover],
-                    share_hash=self._share_hash,
+                    share_hash=self._share_hash, codec=self._kv_codec,
                     scatter=self._import_pages, put=self._put,
                 )
             dt = time.perf_counter() - t0
@@ -1664,7 +1671,7 @@ class ContinuousBatcher:
                     n_tokens=len(entry.pages) * self.engine.page_size,
                     prompt=entry.tokens, history=[], produced=0,
                     resume_keys=None, resume_recent=None,
-                    share_hash=self._share_hash,
+                    share_hash=self._share_hash, codec=self._kv_codec,
                     gather=self._export_pages, put=self._put,
                 )
                 store.host_put(digest, block)
@@ -1744,7 +1751,7 @@ class ContinuousBatcher:
                 continue
             budget -= 1
             try:
-                block.prefetch(put=self._put)
+                block.prefetch(put=self._put, codec=self._kv_codec)
                 with self._admission_lock:
                     self.prefetches += 1
             except Exception as e:
@@ -1768,7 +1775,7 @@ class ContinuousBatcher:
                 or block.is_prefetched:
             return False
         try:
-            block.prefetch(put=self._put)
+            block.prefetch(put=self._put, codec=self._kv_codec)
             with self._admission_lock:
                 self.prefetches += 1
             return True
@@ -2046,7 +2053,7 @@ class ContinuousBatcher:
             with tracing.bind(req._trace):
                 self.cache = import_block(
                     self.cache, block, pages[:data_pages],
-                    share_hash=self._share_hash,
+                    share_hash=self._share_hash, codec=self._kv_codec,
                     scatter=self._import_pages, put=self._put,
                 )
             dt = time.perf_counter() - t0
@@ -2416,7 +2423,7 @@ class ContinuousBatcher:
                     prompt=req.prompt, history=req.history,
                     produced=req.produced, resume_keys=req.resume_keys,
                     resume_recent=req.resume_recent,
-                    share_hash=self._share_hash,
+                    share_hash=self._share_hash, codec=self._kv_codec,
                     gather=self._export_pages, put=self._put,
                 )
                 ok = self.spill.put(req, block)
@@ -2599,7 +2606,7 @@ class ContinuousBatcher:
         try:
             tr = req._trace
             t0 = time.perf_counter() if tr is not None else 0.0
-            block.prefetch(put=self._put)
+            block.prefetch(put=self._put, codec=self._kv_codec)
             if tr is not None:
                 tr.add("prefetch", t0, time.perf_counter(),
                        pages=block.n_pages)
@@ -2756,7 +2763,7 @@ class ContinuousBatcher:
                         prompt=req.prompt, history=req.history,
                         produced=req.produced, resume_keys=req.resume_keys,
                         resume_recent=req.resume_recent,
-                        share_hash=self._share_hash,
+                        share_hash=self._share_hash, codec=self._kv_codec,
                         gather=self._export_pages, put=self._put,
                     )
                 except Exception as e:

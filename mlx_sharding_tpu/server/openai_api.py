@@ -37,6 +37,7 @@ import numpy as np
 from mlx_sharding_tpu import tracing
 from mlx_sharding_tpu.analysis.runtime import make_lock
 from mlx_sharding_tpu.generate import TokenLogprobs
+from mlx_sharding_tpu.kv_compress import load_compress_map
 from mlx_sharding_tpu.kv_share import load_share_map
 from mlx_sharding_tpu.resilience import (
     QueueFullError,
@@ -164,6 +165,8 @@ class ModelProvider:
         paged_attention: str = "auto",
         kv_dtype: Optional[str] = None,
         kv_share_map: Optional[str] = None,
+        kv_compress_map: Optional[str] = None,
+        kv_compress_rank: Optional[int] = None,
         admission_policy: str = "fifo",
         overcommit: bool = False,
         spill_bytes: Optional[int] = None,
@@ -274,6 +277,14 @@ class ModelProvider:
         # at startup, not per-engine-build
         self.kv_share_map_path = kv_share_map
         self.kv_share_map = load_share_map(kv_share_map)
+        # compressed-latent KV transport (kv_compress.py): path to a
+        # calibrated low-rank artifact (GQA models) — MLA-native models
+        # compress without one. Loaded once here, same startup-failure
+        # contract as the share map; --kv-compress-rank truncates the
+        # nested SVD basis to a cheaper operating point
+        self.kv_compress_map_path = kv_compress_map
+        self.kv_compress_map = load_compress_map(
+            kv_compress_map, kv_compress_rank)
         self.admission_policy = admission_policy
         self.overcommit = overcommit
         # host-DRAM spill tier for preempted requests' KV page blocks
@@ -340,6 +351,36 @@ class ModelProvider:
         except Exception:  # noqa: BLE001 — geometry still renders
             pass
         return out
+
+    def kv_compress_stats(self) -> Optional[dict]:
+        """Compressed-latent KV transport summary for /metrics and
+        /health: the live engine codec's counters (blocks, faults, bytes
+        raw vs wire) when one is bound — which covers MLA-native models
+        that compress WITHOUT a configured map — else the configured
+        artifact's geometry, else None (metric families stay absent)."""
+        try:
+            eng = getattr(getattr(self, "generator", None), "engine", None)
+            fn = getattr(eng, "kv_compress_stats", None)
+            live = fn() if fn is not None else None
+        except Exception:  # noqa: BLE001 — fall back to map geometry
+            live = None
+        if live is not None:
+            return live
+        m = self.kv_compress_map
+        if m is None:
+            return None
+        return {
+            "mode": "lowrank",
+            "compress_hash": m.compress_hash,
+            "rank": m.rank,
+            "blocks_compressed": 0,
+            "blocks_reconstructed": 0,
+            "compress_faults": 0,
+            "reconstruct_faults": 0,
+            "bytes_raw_total": 0,
+            "bytes_wire_total": 0,
+            "bytes_saved_total": 0,
+        }
 
     def _shared_weights_on(self, *, weight_bytes: int = 0, want: int = 0,
                            per: int = 0, n_devices: int = 0) -> bool:
@@ -622,6 +663,9 @@ class ModelProvider:
                                 kv_share_map=self.kv_share_map
                                 if self.paged_pool and self.concurrent > 1
                                 else None,
+                                kv_compress_map=self.kv_compress_map
+                                if self.paged_pool and self.concurrent > 1
+                                else None,
                             )
                             # retirement releases the ref; the LAST engine
                             # to close frees the store's tree
@@ -643,6 +687,9 @@ class ModelProvider:
                                 paged_attention=self.paged_attention,
                                 kv_dtype=self.kv_dtype,
                                 kv_share_map=self.kv_share_map
+                                if self.paged_pool and self.concurrent > 1
+                                else None,
+                                kv_compress_map=self.kv_compress_map
                                 if self.paged_pool and self.concurrent > 1
                                 else None,
                             )
@@ -1093,6 +1140,12 @@ class APIHandler(BaseHTTPRequestHandler):
                     payload["kv_share"] = self.provider.kv_share_stats()
                 except Exception:  # noqa: BLE001 — health must render anyway
                     pass
+            try:
+                kc = self.provider.kv_compress_stats()
+                if kc is not None:
+                    payload["kv_compress"] = kc
+            except Exception:  # noqa: BLE001 — health must render anyway
+                pass
             ctrl = getattr(gen, "ctrl", None)
             if ctrl is not None:
                 # a timed-out collective marks the plane dead (multihost.py
@@ -1843,6 +1896,7 @@ def make_server(
                     if getattr(provider, "kv_share_map", None) is not None
                     else None
                 ),
+                kv_compress_fn=lambda: provider.kv_compress_stats(),
             ),
             "profile_dir": profile_dir,
             "api_key": api_key,
@@ -1917,6 +1971,24 @@ def main(argv=None):
                              "fail closed at import. Composes with "
                              "--kv-dtype int8, --spill-bytes and "
                              "--prefix-store")
+    parser.add_argument("--kv-compress-map", default=None, metavar="PATH",
+                        help="with --paged-pool: compressed-latent KV "
+                             "transport (kv_compress.py) — path to a "
+                             "calibrated low-rank artifact from "
+                             "cli/kv_compress_calibrate.py. Exported KV "
+                             "page blocks (spill, prefix demotion, disagg "
+                             "handoff, pod federation) ship rank-r latent "
+                             "coefficients instead of full per-head pages; "
+                             "bounded-error, opt-in. MLA-native models "
+                             "(DeepSeek-v2 compressed cache mode) compress "
+                             "exactly WITHOUT this flag. Requires "
+                             "float/bf16 pools (not --kv-dtype int8)")
+    parser.add_argument("--kv-compress-rank", type=int, default=None,
+                        metavar="R",
+                        help="with --kv-compress-map: truncate the "
+                             "artifact's nested SVD basis to rank R (a "
+                             "cheaper operating point than the calibrated "
+                             "rank; more reconstruction error)")
     parser.add_argument("--admission-policy", choices=("fifo", "first_fit"),
                         default="fifo",
                         help="waiting-line policy when a request doesn't fit "
@@ -2327,6 +2399,21 @@ def main(argv=None):
             parser.error("--kv-share-map requires a single-stage engine: "
                          "share groups span the full layer stack, which a "
                          "pipeline stage split cuts")
+    if args.kv_compress_map:
+        if not args.paged_pool:
+            parser.error("--kv-compress-map requires --paged-pool "
+                         "(compression rides the paged KV transport path)")
+        if args.kv_dtype == "int8":
+            parser.error("--kv-compress-map is incompatible with "
+                         "--kv-dtype int8: dequantize->project->requantize "
+                         "compounds quantization error past the artifact's "
+                         "calibrated bound")
+        if args.stage_bounds or (args.num_stages or 1) > 1:
+            parser.error("--kv-compress-map requires a single-stage "
+                         "engine: the calibration spans the full layer "
+                         "stack, which a pipeline stage split cuts")
+    if args.kv_compress_rank is not None and not args.kv_compress_map:
+        parser.error("--kv-compress-rank requires --kv-compress-map")
     if args.admission_policy != "fifo" and not args.paged_pool:
         parser.error("--admission-policy requires --paged-pool")
     if args.overcommit and not args.paged_pool:
@@ -2467,6 +2554,8 @@ def main(argv=None):
         page_size=args.page_size, paged_attention=args.paged_attention,
         kv_dtype=args.kv_dtype,
         kv_share_map=args.kv_share_map,
+        kv_compress_map=args.kv_compress_map,
+        kv_compress_rank=args.kv_compress_rank,
         admission_policy=args.admission_policy,
         overcommit=args.overcommit,
         spill_bytes=args.spill_bytes,

@@ -622,11 +622,36 @@ def scenario_prefix_owner_death(seed: int = 19) -> Campaign:
     )
 
 
+def scenario_compress_fault_handoff(seed: int = 23) -> Campaign:
+    """The compressed-latent codec (kv_compress.py, site
+    ``cache.compress``) faults mid-handoff while the handoff control
+    points themselves stay flaky: encode faults must ship blocks RAW
+    (counted, never lost), decode faults must land on the counted
+    re-prefill path — zero dropped streams, ledger clean, token-exact."""
+    return Campaign(
+        name="compress_fault_handoff", seed=seed, n_hosts=4,
+        duration_s=18.0, arrival="surge", base_rate=2.5,
+        schedule=[
+            # the codec faults first on encode (export side, mid-spill /
+            # mid-handoff)...
+            FaultEvent(t=4.5, kind="site", site="cache.compress", times=3),
+            # ...then the handoff control point itself wobbles...
+            FaultEvent(t=6.0, kind="site", site="disagg.handoff", times=2),
+            FaultEvent(t=6.5, kind="site", site="pod.handoff", times=2),
+            # ...and the codec faults again while resumes are in flight
+            # (the decode/reconstruct leg: counted re-prefill, no drops)
+            FaultEvent(t=8.0, kind="site", site="cache.compress", times=3),
+            FaultEvent(t=9.0, kind="site", site="cache.import", times=2),
+        ],
+    )
+
+
 SCENARIOS = {
     "site_storm": scenario_site_storm,
     "host_death": scenario_host_death,
     "breaker_storm": scenario_breaker_storm,
     "prefix_owner_death": scenario_prefix_owner_death,
+    "compress_fault_handoff": scenario_compress_fault_handoff,
     "surge_100": scenario_surge_100,
 }
 

@@ -55,6 +55,12 @@ Sites wired into the serving stack:
   sick federation degrades to plain prefill — counted in
   ``stats()["fallbacks"]["fetch_fault"]``, the stream is never wrong and
   never drops)
+- ``cache.compress``      — top of every compressed-latent KV encode and
+  decode (``kv_compress.KVCompressCodec``); ctx ``op="encode"`` (raise
+  to prove a faulted compressor ships the block RAW — counted, never
+  lost) or ``op="decode"`` (raise to prove a faulted reconstruction
+  lands on the consumer's counted re-prefill path — never a wrong or
+  dropped stream)
 - ``spec.draft``          — before each speculative round's draft
   proposals (n-gram lookup or draft-engine forward); ctx
   ``engine=id(batcher)`` (raise here to prove a sick draft source

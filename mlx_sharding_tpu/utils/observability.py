@@ -230,6 +230,10 @@ class ServingMetrics:
     # (kv_share.py; provider.kv_share_stats()) or None when no share map
     # is configured — unset keeps the exposition free of share families
     kv_share_fn: object = None
+    # zero-arg callable returning the compressed-latent KV transport
+    # summary (kv_compress.py; provider.kv_compress_stats()) or None when
+    # no codec is active — unset keeps compress families absent
+    kv_compress_fn: object = None
 
     def record_request(
         self,
@@ -827,6 +831,43 @@ class ServingMetrics:
                     "# TYPE mst_kv_share_bytes_saved gauge",
                     f"mst_kv_share_bytes_saved "
                     f"{share.get('bytes_saved', 0)}",
+                ]
+            # compressed-latent KV transport (kv_compress.py): blocks and
+            # bytes moved compressed vs raw plus the counted degradation
+            # legs — only when a codec is active (MLA-native or a loaded
+            # low-rank map; kv_compress_fn returning None keeps the
+            # exposition free of the families)
+            try:
+                comp = (
+                    self.kv_compress_fn()
+                    if self.kv_compress_fn is not None
+                    else None
+                )
+            except Exception:  # noqa: BLE001 — scrapes must never 500
+                comp = None
+            if comp is not None:
+                mode = str(comp.get("mode", "latent"))
+                lines += [
+                    "# TYPE mst_kv_compress_enabled gauge",
+                    f'mst_kv_compress_enabled{{mode="{mode}"}} 1',
+                    "# TYPE mst_kv_compress_blocks_total counter",
+                    f'mst_kv_compress_blocks_total{{op="compress"}} '
+                    f"{comp.get('blocks_compressed', 0)}",
+                    f'mst_kv_compress_blocks_total{{op="reconstruct"}} '
+                    f"{comp.get('blocks_reconstructed', 0)}",
+                    "# TYPE mst_kv_compress_faults_total counter",
+                    f'mst_kv_compress_faults_total{{op="encode"}} '
+                    f"{comp.get('compress_faults', 0)}",
+                    f'mst_kv_compress_faults_total{{op="decode"}} '
+                    f"{comp.get('reconstruct_faults', 0)}",
+                    "# TYPE mst_kv_compress_bytes_total counter",
+                    f'mst_kv_compress_bytes_total{{kind="raw"}} '
+                    f"{comp.get('bytes_raw_total', 0)}",
+                    f'mst_kv_compress_bytes_total{{kind="wire"}} '
+                    f"{comp.get('bytes_wire_total', 0)}",
+                    "# TYPE mst_kv_compress_bytes_saved gauge",
+                    f"mst_kv_compress_bytes_saved "
+                    f"{comp.get('bytes_saved_total', 0)}",
                 ]
             # pod fleet (pod.py): host-labeled size/weights/heartbeat from
             # the gossip view plus handoff and autoscaler counters — only

@@ -1,0 +1,11 @@
+"""MST116: dense latent reconstruction inside a tick-hot function —
+reconstruct_block() materializes the full per-head pages from rank-r
+latents (a host-numpy up-projection over every page of every layer),
+stalling every live slot's decode behind one block's matmul; reconstruct
+in prefetch's overlapped stage or the consumer's import path instead."""
+
+
+# mst: hot-path
+def tick_with_latent_reconstruct(codec, block):
+    pages = codec.reconstruct_block(block)
+    return pages

@@ -56,6 +56,7 @@ EXPECTED = {
     "mst113_control_plane_in_tick.py": ("MST113", 10, 21),
     "mst114_spec_policy_sync.py": ("MST114", 6, 15),
     "mst115_prefix_federation_in_tick.py": ("MST115", 10, 7),
+    "mst116_latent_reconstruct_in_tick.py": ("MST116", 10, 12),
     "mst002_dead_suppression.py": ("MST002", 5, 0),
     "mst401_exception_leak.py": ("MST401", 6, 0),
     "mst402_double_release.py": ("MST402", 8, 4),
